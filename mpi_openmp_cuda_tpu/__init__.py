@@ -1,0 +1,41 @@
+"""mpi_openmp_cuda_tpu — TPU-native framework with the capabilities of the
+reference nmiz1987/MPI-OPENMP-CUDA (see SURVEY.md).
+
+A distributed batch sequence-alignment scorer: for each candidate sequence in
+a batch, find the best (offset n, mutant k) hyphen-insertion placement
+against one long sequence under the $/%/#/space substitution-group scoring
+scheme, and report ``#i: score: S, n: N, k: K`` per candidate.
+
+The reference's three parallelism tiers map to TPU idioms:
+
+* MPI Bcast/Scatter/Gather  -> jax.sharding Mesh: replicated constants,
+  batch-axis sharding over ICI/DCN (parallel/).
+* OpenMP host loops         -> host-side numpy vectorisation + vmap (io/, ops/).
+* CUDA constant-memory + shared-memory-atomics kernel
+                            -> Pallas TPU kernel with a pure-XLA fallback,
+  using diagonal prefix sums to vectorise the candidate grid the reference
+  iterates serially (ops/).
+"""
+
+from .models.classmat import build_class_matrix, classify_pair
+from .models.encoding import decode, encode, encode_normalized, normalize
+from .ops.oracle import brute_force_best, prefix_best, score_batch_oracle
+from .ops.values import signed_weights, value_table
+from .utils import constants
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "build_class_matrix",
+    "classify_pair",
+    "encode",
+    "encode_normalized",
+    "normalize",
+    "decode",
+    "brute_force_best",
+    "prefix_best",
+    "score_batch_oracle",
+    "signed_weights",
+    "value_table",
+    "constants",
+]

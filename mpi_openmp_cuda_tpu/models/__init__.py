@@ -1,0 +1,1 @@
+"""models subpackage of mpi_openmp_cuda_tpu."""

@@ -1,0 +1,1 @@
+"""parallel subpackage of mpi_openmp_cuda_tpu."""

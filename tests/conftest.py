@@ -1,0 +1,40 @@
+"""Test harness configuration.
+
+Forces the JAX CPU backend with 8 virtual devices BEFORE jax is imported
+anywhere, so multi-chip sharding tests run on any machine — the fake-backend
+idiom the reference's "run real MPI on two machines" test story lacks
+(SURVEY §4).  Real-TPU runs go through bench.py / __graft_entry__.py, which
+do not import this file.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+REFERENCE_DIR = os.environ.get("REFERENCE_DIR", "/root/reference")
+
+
+def reference_fixture(name: str) -> str:
+    """Path to a reference stdin fixture (input1.txt..input6.txt), or skip."""
+    path = os.path.join(REFERENCE_DIR, name)
+    if not os.path.exists(path):
+        pytest.skip(f"reference fixture {name} not available at {path}")
+    return path
+
+
+@pytest.fixture
+def rng() -> np.random.Generator:
+    return np.random.default_rng(0)

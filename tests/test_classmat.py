@@ -1,0 +1,130 @@
+"""Unit tests for the domain core: groups, class matrix, encoding, values.
+
+Oracle facts come from the spec's classification rules (SURVEY A.1):
+'$' identical > '%' conservative > '#' semi-conservative > space.
+"""
+
+import numpy as np
+import pytest
+
+from mpi_openmp_cuda_tpu.models.classmat import build_class_matrix, classify_pair
+from mpi_openmp_cuda_tpu.models.encoding import (
+    InvalidSequenceError,
+    decode,
+    encode,
+    encode_normalized,
+    normalize,
+    pad_to,
+)
+from mpi_openmp_cuda_tpu.models.groups import (
+    CONSERVATIVE_GROUPS,
+    SEMI_CONSERVATIVE_GROUPS,
+)
+from mpi_openmp_cuda_tpu.ops.values import signed_weights, value_table
+from mpi_openmp_cuda_tpu.utils.constants import (
+    ALPHABET_SIZE,
+    CLASS_DOLLAR,
+    CLASS_HASH,
+    CLASS_PERCENT,
+    CLASS_SPACE,
+)
+
+
+def test_group_tables_match_spec_counts():
+    assert len(CONSERVATIVE_GROUPS) == 9
+    assert len(SEMI_CONSERVATIVE_GROUPS) == 11
+
+
+def test_class_matrix_shape_and_dtype():
+    mat = build_class_matrix()
+    assert mat.shape == (ALPHABET_SIZE, ALPHABET_SIZE)
+    assert mat.dtype == np.int8
+    assert set(np.unique(mat)) <= {
+        CLASS_DOLLAR,
+        CLASS_PERCENT,
+        CLASS_HASH,
+        CLASS_SPACE,
+    }
+
+
+def test_class_matrix_symmetric():
+    mat = build_class_matrix()
+    assert (mat == mat.T).all()
+
+
+def test_diagonal_is_dollar():
+    mat = build_class_matrix()
+    for a in range(1, ALPHABET_SIZE):
+        assert mat[a, a] == CLASS_DOLLAR
+
+
+@pytest.mark.parametrize(
+    "a,b,cls",
+    [
+        ("A", "A", CLASS_DOLLAR),
+        ("N", "D", CLASS_PERCENT),  # NDEQ
+        ("H", "Y", CLASS_PERCENT),  # HY
+        ("M", "F", CLASS_PERCENT),  # MILF
+        ("S", "P", CLASS_HASH),  # STPA
+        ("F", "V", CLASS_HASH),  # FVLIM
+        ("C", "S", CLASS_HASH),  # CSA
+        ("A", "B", CLASS_SPACE),
+        ("W", "Z", CLASS_SPACE),
+    ],
+)
+def test_classify_pairs(a, b, cls):
+    assert classify_pair(a, b) == cls
+
+
+def test_precedence_percent_over_hash():
+    # S and A share semi-conservative groups (SAG, CSA, STPA, ...) AND the
+    # conservative group STA -> must classify '%', not '#'.
+    assert classify_pair("S", "A") == CLASS_PERCENT
+    # N and K: conservative NEQK/NHQK and semi STNK/NEQHRK -> '%'.
+    assert classify_pair("N", "K") == CLASS_PERCENT
+
+
+def test_every_semi_pair_is_hash_or_better():
+    mat = build_class_matrix()
+    for group in SEMI_CONSERVATIVE_GROUPS:
+        for a in group:
+            for b in group:
+                cls = classify_pair(a, b)
+                assert cls <= CLASS_HASH, (a, b, cls)
+
+
+def test_encode_roundtrip():
+    assert decode(encode("HELLOWORLD")) == "HELLOWORLD"
+    assert encode("A")[0] == 1 and encode("Z")[0] == 26
+
+
+def test_normalize_uppercases():
+    assert normalize("  abcXYz\n") == "ABCXYZ"
+    assert decode(encode_normalized("psHlsPsGe")) == "PSHLSPSGE"
+
+
+def test_encode_rejects_non_alpha():
+    with pytest.raises(InvalidSequenceError):
+        encode("AB-C")
+
+
+def test_pad_to():
+    padded = pad_to(encode("ABC"), 8)
+    assert padded.shape == (8,)
+    assert list(padded[:3]) == [1, 2, 3]
+    assert (padded[3:] == 0).all()
+    with pytest.raises(InvalidSequenceError):
+        pad_to(encode("ABCD"), 3)
+
+
+def test_signed_weights_and_value_table():
+    w = [10, 2, 3, 4]
+    sw = signed_weights(w)
+    assert list(sw) == [10, -2, -3, -4]
+    val = value_table(w)
+    a, n, d = encode("A")[0], encode("N")[0], encode("D")[0]
+    s, p = encode("S")[0], encode("P")[0]
+    assert val[a, a] == 10  # '$'
+    assert val[n, d] == -2  # '%'
+    assert val[s, p] == -3  # '#'
+    assert val[a, encode("B")[0]] == -4  # space

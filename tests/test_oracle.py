@@ -1,0 +1,78 @@
+"""Oracle tests: brute-force vs prefix-sum equivalence + spec worked examples.
+
+The two numpy oracles are independent implementations of SURVEY Appendix A;
+agreement on random inputs (including tie-heavy low-entropy alphabets) is
+the foundation the accelerated paths are tested against.
+"""
+
+import numpy as np
+import pytest
+
+from mpi_openmp_cuda_tpu.models.encoding import encode
+from mpi_openmp_cuda_tpu.ops.oracle import (
+    brute_force_best,
+    equal_length_score,
+    prefix_best,
+)
+from mpi_openmp_cuda_tpu.utils.constants import INT32_MIN
+
+W = [10, 2, 3, 4]  # the spec PDF's example weights
+
+
+def test_pdf_hello_world_example():
+    # Spec PDF p.5: Seq1=HELLOWORLD, Seq2=OWRL -> optimum n=4, k=2.
+    seq1, seq2 = encode("HELLOWORLD"), encode("OWRL")
+    score, n, k = prefix_best(seq1, seq2, W)
+    assert (n, k) == (4, 2)
+    assert score == 4 * W[0]  # OW-RL all '$' matches
+    assert brute_force_best(seq1, seq2, W) == (score, n, k)
+
+
+def test_equal_length_direct_path():
+    seq1, seq2 = encode("APQRS"), encode("APQRS")
+    assert prefix_best(seq1, seq2, W) == (5 * W[0], 0, 0)
+    seq2b = encode("APQRB")
+    s = equal_length_score(seq1, seq2b, W)
+    assert prefix_best(seq1, seq2b, W) == (s, 0, 0)
+
+
+def test_longer_seq2_yields_int_min():
+    assert prefix_best(encode("ABC"), encode("ABCD"), W) == (INT32_MIN, 0, 0)
+    assert brute_force_best(encode("ABC"), encode("ABCD"), W) == (INT32_MIN, 0, 0)
+
+
+def test_k0_is_hyphen_after_end():
+    # Seq1=ABCD, Seq2=ABC: n=0,k=0 places ABC- over ABCD -> 3 matches.
+    score, n, k = prefix_best(encode("ABCD"), encode("ABC"), W)
+    assert (score, n, k) == (3 * W[0], 0, 0)
+
+
+def test_tie_break_first_candidate_wins():
+    # Seq1 with two identical optimal placements: the earlier offset must win.
+    seq1, seq2 = encode("ABABAB"), encode("AB")
+    score, n, k = prefix_best(seq1, seq2, W)
+    assert (n, k) == (0, 0)
+    assert brute_force_best(seq1, seq2, W) == (score, n, k)
+
+
+@pytest.mark.parametrize("alphabet", [4, 26])
+@pytest.mark.parametrize("trial", range(8))
+def test_property_prefix_matches_brute_force(alphabet, trial):
+    rng = np.random.default_rng(hash((alphabet, trial)) % (2**32))
+    l1 = int(rng.integers(2, 40))
+    l2 = int(rng.integers(1, l1 + 1))
+    seq1 = rng.integers(1, alphabet + 1, size=l1)
+    seq2 = rng.integers(1, alphabet + 1, size=l2)
+    weights = [int(x) for x in rng.integers(0, 12, size=4)]
+    assert prefix_best(seq1, seq2, weights) == brute_force_best(
+        seq1, seq2, weights
+    )
+
+
+def test_negative_score_regime():
+    # Heavy space weight (input3 style) -> negative optima still searched correctly.
+    rng = np.random.default_rng(7)
+    seq1 = rng.integers(1, 27, size=30)
+    seq2 = rng.integers(1, 27, size=10)
+    w = [2, 2, 1, 10]
+    assert prefix_best(seq1, seq2, w) == brute_force_best(seq1, seq2, w)
