@@ -1,0 +1,6 @@
+"""Entry point: ``python -m mpi_openmp_cuda_tpu < input.txt``."""
+
+from .io.cli import main
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,148 @@
+"""CLI driver (reference parity: L4/L5 orchestration, main.c main()).
+
+``python -m mpi_openmp_cuda_tpu < input.txt`` reproduces the reference's
+``mpiexec -np 2 ./final < input.txt`` contract: results on stdout in the
+exact ``#i: score: S, n: N, k: K`` format, diagnostics on stderr, non-zero
+exit on any failure (the C11 fail-stop stance).  Optional flags extend the
+contract without breaking it (SURVEY §5 config tier).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from ..ops.dispatch import AlignmentScorer
+from ..utils.profiling import PhaseTimer
+from .parse import load_problem
+from .printer import print_results, write_json_sidecar
+
+
+def build_arg_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="mpi_openmp_cuda_tpu",
+        description="TPU-native batch sequence-alignment scorer "
+        "(stdin/stdout contract of the MPI+OpenMP+CUDA reference).",
+    )
+    p.add_argument(
+        "--input",
+        default=None,
+        help="input file (default: stdin, like the reference's './final < input.txt')",
+    )
+    p.add_argument(
+        "--backend",
+        choices=("xla", "pallas", "oracle"),
+        default="xla",
+        help="compute path: pure-XLA (default), Pallas TPU kernel, or host numpy oracle",
+    )
+    p.add_argument(
+        "--mesh",
+        default=None,
+        help="shard the batch over N devices, e.g. '--mesh 8' or '--mesh batch:8' "
+        "(default: no sharding, single device)",
+    )
+    p.add_argument(
+        "--distributed",
+        action="store_true",
+        help="call jax.distributed.initialize() first (multi-host, the runOn2 analogue)",
+    )
+    p.add_argument(
+        "--json",
+        default=None,
+        metavar="PATH",
+        help="also write results as a JSON sidecar file",
+    )
+    p.add_argument(
+        "--journal",
+        default=None,
+        metavar="PATH",
+        help="per-sequence result journal enabling resume after preemption",
+    )
+    p.add_argument(
+        "--profile",
+        action="store_true",
+        help="print per-phase wall-clock timings to stderr",
+    )
+    return p
+
+
+class FeatureUnavailableError(RuntimeError):
+    pass
+
+
+def _feature_import(what: str, importer):
+    """Import a lazily-loaded subsystem with a clear error if absent."""
+    try:
+        return importer()
+    except ModuleNotFoundError as e:
+        raise FeatureUnavailableError(
+            f"{what} is not available in this build ({e.name} missing)"
+        ) from e
+
+
+def _build_sharding(mesh_arg: str | None):
+    if mesh_arg is None:
+        return None
+
+    def _imp():
+        from ..parallel.sharding import BatchSharding
+
+        return BatchSharding
+
+    spec = mesh_arg.split(":")
+    n = int(spec[-1])
+    return _feature_import("--mesh batch sharding", _imp).over_devices(n)
+
+
+def run(argv: list[str] | None = None) -> int:
+    args = build_arg_parser().parse_args(argv)
+    timer = PhaseTimer(enabled=args.profile)
+    try:
+        if args.distributed:
+            with timer.phase("distributed_init"):
+
+                def _imp():
+                    from ..parallel.distributed import initialize_distributed
+
+                    return initialize_distributed
+
+                _feature_import("--distributed multi-host init", _imp)()
+        with timer.phase("parse"):
+            problem = load_problem(args.input)
+        with timer.phase("setup"):
+            scorer = AlignmentScorer(
+                backend=args.backend, sharding=_build_sharding(args.mesh)
+            )
+        journal = None
+        if args.journal:
+
+            def _imp():
+                from ..utils.journal import ResultJournal
+
+                return ResultJournal
+
+            journal = _feature_import("--journal resume", _imp)(args.journal)
+        with timer.phase("score"):
+            if journal is not None:
+                results = journal.score_with_resume(scorer, problem)
+            else:
+                results = scorer.score_codes(
+                    problem.seq1_codes, problem.seq2_codes, problem.weights
+                )
+        with timer.phase("print"):
+            print_results(results)
+            if args.json:
+                write_json_sidecar(
+                    results, args.json, meta={"backend": args.backend}
+                )
+        timer.report()
+        return 0
+    except BrokenPipeError:
+        return 1
+    except Exception as e:  # fail-stop: diagnose on stderr, nonzero exit (C11)
+        print(f"mpi_openmp_cuda_tpu: error: {e}", file=sys.stderr)
+        return 1
+
+
+def main() -> None:
+    sys.exit(run())

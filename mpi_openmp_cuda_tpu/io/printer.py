@@ -1,0 +1,40 @@
+"""Result printer (reference parity: C8, main.c:199-211).
+
+Byte-identical output contract: one line per Seq2, in input order:
+``#i: score: S, n: N, k: K``.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from typing import Iterable, Sequence, TextIO
+
+
+def format_result(i: int, score: int, n: int, k: int) -> str:
+    return f"#{i}: score: {score}, n: {n}, k: {k}"
+
+
+def print_results(
+    results: Iterable[Sequence[int]], out: TextIO | None = None
+) -> None:
+    out = out or sys.stdout
+    for i, (score, n, k) in enumerate(results):
+        print(format_result(i, int(score), int(n), int(k)), file=out)
+
+
+def write_json_sidecar(
+    results: Iterable[Sequence[int]], path: str, meta: dict | None = None
+) -> None:
+    """Optional structured sidecar (§5 observability); stdout stays canonical."""
+    payload = {
+        "results": [
+            {"index": i, "score": int(s), "n": int(n), "k": int(k)}
+            for i, (s, n, k) in enumerate(results)
+        ],
+    }
+    if meta:
+        payload["meta"] = meta
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(payload, f, indent=2)
+        f.write("\n")

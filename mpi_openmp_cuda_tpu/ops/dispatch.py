@@ -1,0 +1,164 @@
+"""Batch dispatch: shape bucketing, padding, backend selection (C6 + C14).
+
+The reference splits the Seq2 batch into fixed-stride 2000-byte records
+(main.c:110-121) and launches one kernel per sequence in a serial,
+synchronising host loop (cudaFunctions.cu:204-220).  Here the batch is
+padded into a rectangular [B, L2P] array once, shapes are rounded up to a
+small set of buckets (so XLA compiles a handful of programs, not one per
+problem), and the whole batch is scored in one jitted call — chunked
+internally to bound live memory.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+
+import numpy as np
+
+from ..models.encoding import encode_normalized, pad_to
+from ..utils.constants import BUF_SIZE_SEQ1, BUF_SIZE_SEQ2
+from .oracle import score_batch_oracle
+from .values import value_table
+
+# Shape buckets: multiples of the TPU lane width keep tiles aligned; the
+# bucket floor bounds recompilation for tiny inputs.
+_LANE = 128
+
+# Max live elements per intermediate array inside one chunk
+# (~64 MiB of int32 at the default). Tunable via AlignmentScorer.
+DEFAULT_CHUNK_BUDGET = 16 * 1024 * 1024
+
+
+def round_up(x: int, mult: int) -> int:
+    return max(mult, mult * math.ceil(x / mult))
+
+
+@dataclass(frozen=True)
+class PaddedBatch:
+    """A rectangular, bucket-padded encoding of one scoring problem."""
+
+    seq1ext: np.ndarray  # [L1P + L2P + 1] int32
+    len1: int
+    seq2: np.ndarray  # [B, L2P] int32
+    len2: np.ndarray  # [B] int32
+    l1p: int
+    l2p: int
+
+    @property
+    def batch_size(self) -> int:
+        return self.seq2.shape[0]
+
+
+def pad_problem(
+    seq1_codes: np.ndarray,
+    seq2_codes: list[np.ndarray],
+    *,
+    lane: int = _LANE,
+) -> PaddedBatch:
+    """Encode a ragged problem into bucket-padded rectangular arrays."""
+    len1 = int(seq1_codes.size)
+    if len1 > BUF_SIZE_SEQ1:
+        raise ValueError(f"Seq1 length {len1} exceeds BUF_SIZE_SEQ1={BUF_SIZE_SEQ1}")
+    for idx, codes in enumerate(seq2_codes):
+        if codes.size > BUF_SIZE_SEQ2:
+            raise ValueError(
+                f"Seq2[{idx}] length {codes.size} exceeds BUF_SIZE_SEQ2={BUF_SIZE_SEQ2}"
+            )
+    l1p = round_up(len1, lane)
+    max_l2 = max((c.size for c in seq2_codes), default=1)
+    l2p = round_up(max_l2, lane)
+    seq1ext = np.zeros(l1p + l2p + 1, dtype=np.int32)
+    seq1ext[:len1] = seq1_codes
+    rows = np.stack(
+        [pad_to(c, l2p).astype(np.int32) for c in seq2_codes]
+    ) if seq2_codes else np.zeros((0, l2p), dtype=np.int32)
+    lens = np.array([c.size for c in seq2_codes], dtype=np.int32)
+    return PaddedBatch(seq1ext, len1, rows, lens, l1p, l2p)
+
+
+def choose_chunk(batch: PaddedBatch, budget: int) -> int:
+    """Chunk size bounding per-chunk grid memory; power of two for bucketing."""
+    per_pair = batch.l1p * batch.l2p
+    cb = max(1, budget // max(per_pair, 1))
+    cb = 1 << (cb.bit_length() - 1)  # floor to power of two
+    return min(cb, max(1, 1 << (batch.batch_size - 1).bit_length()))
+
+
+class AlignmentScorer:
+    """Front door to the accelerated scoring paths (the C2 offload ABI's
+    Python-side equivalent).
+
+    backend: 'xla' (default, works everywhere), 'pallas' (TPU kernel),
+    or 'oracle' (host numpy — the always-correct reference path).
+    """
+
+    def __init__(
+        self,
+        backend: str = "xla",
+        chunk_budget: int = DEFAULT_CHUNK_BUDGET,
+        sharding=None,
+    ):
+        if backend not in ("xla", "pallas", "oracle"):
+            raise ValueError(f"unknown backend {backend!r}")
+        self.backend = backend
+        self.chunk_budget = chunk_budget
+        self.sharding = sharding  # parallel.BatchSharding or None
+
+    # -- code-level API ----------------------------------------------------
+    def score_codes(
+        self, seq1_codes: np.ndarray, seq2_codes: list[np.ndarray], weights
+    ) -> np.ndarray:
+        """Returns [B, 3] int32 array of (score, n, k) rows, input order."""
+        if not seq2_codes:
+            return np.zeros((0, 3), dtype=np.int32)
+        if self.backend == "oracle":
+            return np.array(
+                score_batch_oracle(seq1_codes, seq2_codes, weights), dtype=np.int32
+            )
+        batch = pad_problem(seq1_codes, seq2_codes)
+        val_flat = value_table(weights).astype(np.int32).reshape(-1)
+        if self.sharding is not None:
+            return self.sharding.score(batch, val_flat, backend=self.backend)
+        return self._score_local(batch, val_flat)
+
+    def _score_local(self, batch: PaddedBatch, val_flat: np.ndarray) -> np.ndarray:
+        import jax.numpy as jnp
+
+        if self.backend == "pallas":
+            try:
+                from .pallas_scorer import score_batch_pallas
+            except ModuleNotFoundError as e:
+                raise RuntimeError(
+                    "backend 'pallas' is not available in this build"
+                ) from e
+
+            return np.asarray(
+                score_batch_pallas(batch, jnp.asarray(val_flat))
+            )[: batch.batch_size]
+
+        from .xla_scorer import score_chunks
+
+        b = batch.batch_size
+        cb = choose_chunk(batch, self.chunk_budget)
+        bp = round_up(b, cb)
+        rows = np.zeros((bp, batch.l2p), dtype=np.int32)
+        rows[:b] = batch.seq2
+        lens = np.zeros(bp, dtype=np.int32)
+        lens[:b] = batch.len2
+        out = score_chunks(
+            jnp.asarray(batch.seq1ext),
+            jnp.int32(batch.len1),
+            jnp.asarray(rows.reshape(bp // cb, cb, batch.l2p)),
+            jnp.asarray(lens.reshape(bp // cb, cb)),
+            jnp.asarray(val_flat),
+        )
+        return np.asarray(out).reshape(bp, 3)[:b]
+
+    # -- text-level API ----------------------------------------------------
+    def score(self, seq1: str, seq2_list: list[str], weights) -> np.ndarray:
+        return self.score_codes(
+            encode_normalized(seq1),
+            [encode_normalized(s) for s in seq2_list],
+            weights,
+        )

@@ -1,0 +1,109 @@
+"""Pure-XLA alignment scorer (reference parity: C13 kernel + C14 launcher).
+
+The reference's CUDA kernel walks the (offset n, mutant k) candidate grid
+serially, re-scoring all L2 characters per candidate with shared-memory
+atomics (cudaFunctions.cu:116-168).  The TPU formulation (SURVEY §7.2)
+vectorises the whole grid with diagonal prefix sums:
+
+* ``v0[n, i]`` = signed value of pairing seq2[i] with seq1[n+i] (unshifted
+  diagonal); ``v1[n, i]`` pairs with seq1[n+i+1] (hyphen-shifted diagonal).
+* ``score(n, k) = prefix(v0[n])[k] + suffix(v1[n])[k]`` — one cumsum pass per
+  diagonal family, then a single argmax over the masked grid.
+
+This turns O((L1-L2)*L2^2) work into O(L1*L2) and replaces the serial
+candidate loop, the `__shared__` histogram and the `atomicAdd` reductions
+with lane-parallel cumulative sums — no atomics exist or are needed.
+
+Semantics parity (tested against the numpy oracles and the Appendix C
+goldens): offsets n in [0, len1-len2); k=0 encodes hyphen-after-end; ties
+resolve to the first candidate in offset-major, k-ascending-with-0-first
+order (jnp.argmax's first-hit rule over a grid laid out in exactly the
+reference's iteration order, cudaFunctions.cu:161); len2 == len1 scores
+positionally as (score, 0, 0); len2 > len1 (or len2 == 0) yields INT32_MIN.
+
+Shapes are static per (L1P, L2P, chunk) bucket — no data-dependent Python
+control flow; everything under jit is lax-traced once per bucket.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..utils.constants import ALPHABET_SIZE, INT32_MIN
+
+_NEG = jnp.int32(INT32_MIN)
+
+
+def _score_pair(seq1ext, len1, seq2row, len2, val_flat):
+    """Score one (seq1, seq2) pair over the full padded candidate grid.
+
+    seq1ext : [L1P + L2P + 1] int32 — seq1 codes padded with trailing zeros
+              so diagonal gathers never go out of bounds.
+    len1    : scalar int32 actual length of seq1.
+    seq2row : [L2P] int32 padded seq2 codes.
+    len2    : scalar int32 actual length.
+    val_flat: [27*27] int32 flattened signed pair-value table.
+
+    Returns (score, n, k) int32 scalars.
+    """
+    l2p = seq2row.shape[0]
+    noff = seq1ext.shape[0] - l2p - 1  # == L1P: covers all valid offsets
+
+    n = jnp.arange(noff, dtype=jnp.int32)[:, None]
+    i = jnp.arange(l2p, dtype=jnp.int32)[None, :]
+    idx0 = n + i
+
+    g0 = jnp.take(seq1ext, idx0)  # seq1 char on the unshifted diagonal
+    g1 = jnp.take(seq1ext, idx0 + 1)  # ... and after the hyphen shift
+    pair_base = seq2row[None, :].astype(jnp.int32) * ALPHABET_SIZE
+    charmask = i < len2  # zero out padded seq2 positions
+    v0 = jnp.where(charmask, jnp.take(val_flat, pair_base + g0), 0)
+    v1 = jnp.where(charmask, jnp.take(val_flat, pair_base + g1), 0)
+
+    c0 = jnp.cumsum(v0, axis=1)
+    c1 = jnp.cumsum(v1, axis=1)
+    t0 = c0[:, -1:]  # full unshifted sum per offset (k=0 candidate)
+    t1 = c1[:, -1:]
+
+    # Column j holds mutant k=j: k=0 -> t0; k>=1 -> prefix0(k) + shifted suffix1(k).
+    scores = jnp.concatenate([t0, c0[:, :-1] + (t1 - c1[:, :-1])], axis=1)
+
+    k = jnp.arange(l2p, dtype=jnp.int32)[None, :]
+    valid = (n < jnp.maximum(len1 - len2, 0)) & ((k == 0) | (k < len2))
+    flat = jnp.where(valid, scores, _NEG).reshape(-1)
+
+    # First max in n-major, k=0,1,... order == the reference's strict-> loop.
+    bi = jnp.argmax(flat).astype(jnp.int32)
+    best_score = flat[bi]
+    best_n = bi // l2p
+    best_k = bi % l2p
+
+    eq_score = c0[0, -1]  # positional score at n=0 (branch-A analogue)
+    searchable = (len2 < len1) & (len2 > 0)
+    score = jnp.where(
+        len2 == len1, eq_score, jnp.where(searchable, best_score, _NEG)
+    )
+    out_n = jnp.where(searchable, best_n, 0)
+    out_k = jnp.where(searchable, best_k, 0)
+    return jnp.stack([score, out_n, out_k])
+
+
+@jax.jit
+def score_chunks(seq1ext, len1, seq2_chunks, len2_chunks, val_flat):
+    """Score a [NC, CB, L2P] chunked batch; returns [NC, CB, 3] int32.
+
+    ``vmap`` handles intra-chunk batch parallelism (the per-sequence kernel
+    launches of cudaFunctions.cu:204-220, minus the host synchronisation);
+    ``lax.map`` walks chunks sequentially to bound live memory — the
+    device-memory-manager role of C14, without per-call mallocs.
+    """
+
+    def chunk_fn(args):
+        rows, lens = args
+        return jax.vmap(
+            lambda r, l: _score_pair(seq1ext, len1, r, l, val_flat)
+        )(rows, lens)
+
+    return lax.map(chunk_fn, (seq2_chunks, len2_chunks))

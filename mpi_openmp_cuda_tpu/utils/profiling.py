@@ -1,0 +1,57 @@
+"""Per-phase timing + profiler harness (SURVEY §5 tracing/profiling gap).
+
+The reference has no timers at all (the vendored StopWatch helpers are dead
+code).  This provides the phase wall-clock harness (parse / setup / score /
+print) and an optional ``jax.profiler`` trace context for TPU runs.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import sys
+import time
+from dataclasses import dataclass, field
+
+
+@dataclass
+class PhaseTimer:
+    """Accumulates named wall-clock phases; reports to stderr when enabled."""
+
+    enabled: bool = False
+    phases: list[tuple[str, float]] = field(default_factory=list)
+
+    @contextlib.contextmanager
+    def phase(self, name: str):
+        start = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.phases.append((name, time.perf_counter() - start))
+
+    def report(self, out=None) -> None:
+        if not self.enabled:
+            return
+        out = out or sys.stderr
+        total = sum(d for _, d in self.phases)
+        for name, dur in self.phases:
+            print(f"[profile] {name:>16}: {dur * 1e3:10.2f} ms", file=out)
+        print(f"[profile] {'total':>16}: {total * 1e3:10.2f} ms", file=out)
+
+
+@contextlib.contextmanager
+def device_trace(log_dir: str | None):
+    """jax.profiler trace context; no-op when log_dir is None."""
+    if log_dir is None:
+        yield
+        return
+    import jax
+
+    with jax.profiler.trace(log_dir):
+        yield
+
+
+def block_until_ready(tree):
+    """Barrier helper for wall-clock measurement of async dispatch."""
+    import jax
+
+    return jax.block_until_ready(tree)

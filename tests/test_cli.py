@@ -1,0 +1,97 @@
+"""End-to-end CLI integration tests: the six reference fixtures must produce
+byte-exact golden stdout (SURVEY §4 tier c; goldens = Appendix C)."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from conftest import REFERENCE_DIR, reference_fixture
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+GOLDEN = os.path.join(REPO, "tests", "golden")
+
+ENV = {
+    **os.environ,
+    "JAX_PLATFORMS": "cpu",
+    "PYTHONPATH": REPO,
+}
+
+
+def run_cli(*args, stdin_path=None, check=True):
+    cmd = [sys.executable, "-m", "mpi_openmp_cuda_tpu", *args]
+    with open(stdin_path) if stdin_path else open(os.devnull) as f:
+        proc = subprocess.run(
+            cmd, stdin=f, capture_output=True, text=True, env=ENV, cwd=REPO
+        )
+    if check and proc.returncode != 0:
+        raise AssertionError(f"CLI failed: {proc.returncode}\n{proc.stderr}")
+    return proc
+
+
+def golden(name):
+    with open(os.path.join(GOLDEN, name)) as f:
+        return f.read()
+
+
+@pytest.mark.parametrize("fixture", ["input1", "input2", "input5", "input6"])
+def test_fixture_stdout_exact(fixture):
+    path = reference_fixture(f"{fixture}.txt")
+    proc = run_cli(stdin_path=path)
+    assert proc.stdout == golden(f"{fixture}.out")
+
+
+@pytest.mark.parametrize("fixture", ["input3", "input4"])
+def test_heavy_fixture_stdout_exact(fixture):
+    # Stress fixtures (6.1e9 / 2.4e8 brute-force char ops) via the O(L1*L2)
+    # XLA path — still byte-exact against the goldens.
+    path = reference_fixture(f"{fixture}.txt")
+    proc = run_cli(stdin_path=path)
+    assert proc.stdout == golden(f"{fixture}.out")
+
+
+def test_input_flag_equivalent_to_stdin():
+    path = reference_fixture("input5.txt")
+    assert run_cli("--input", path).stdout == golden("input5.out")
+
+
+def test_oracle_backend_matches():
+    path = reference_fixture("input6.txt")
+    proc = run_cli("--backend", "oracle", stdin_path=path)
+    assert proc.stdout == golden("input6.out")
+
+
+def test_json_sidecar(tmp_path):
+    path = reference_fixture("input5.txt")
+    sidecar = str(tmp_path / "out.json")
+    proc = run_cli("--json", sidecar, stdin_path=path)
+    assert proc.stdout == golden("input5.out")
+    data = json.load(open(sidecar))
+    assert data["results"][0] == {"index": 0, "score": 27, "n": 0, "k": 5}
+    assert data["meta"]["backend"] == "xla"
+
+
+def test_profile_goes_to_stderr_not_stdout():
+    path = reference_fixture("input6.txt")
+    proc = run_cli("--profile", stdin_path=path)
+    assert proc.stdout == golden("input6.out")
+    assert "[profile]" in proc.stderr
+
+
+def test_malformed_input_fails_cleanly(tmp_path):
+    bad = tmp_path / "bad.txt"
+    bad.write_text("1 2 3\n")
+    proc = run_cli("--input", str(bad), check=False)
+    assert proc.returncode == 1
+    assert "error" in proc.stderr.lower()
+    assert proc.stdout == ""
+
+
+def test_invalid_character_fails_cleanly(tmp_path):
+    bad = tmp_path / "bad.txt"
+    bad.write_text("1 2 3 4\nAB9C\n1\nAB\n")
+    proc = run_cli("--input", str(bad), check=False)
+    assert proc.returncode == 1
+    assert "invalid sequence character" in proc.stderr

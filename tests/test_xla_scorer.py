@@ -1,0 +1,110 @@
+"""XLA scorer tests: property-equivalence vs the numpy oracle, edge cases,
+padding/chunk invariance, determinism (SURVEY §4 test pyramid, tiers b+e)."""
+
+import numpy as np
+import pytest
+
+from mpi_openmp_cuda_tpu.models.encoding import encode
+from mpi_openmp_cuda_tpu.ops.dispatch import (
+    AlignmentScorer,
+    choose_chunk,
+    pad_problem,
+    round_up,
+)
+from mpi_openmp_cuda_tpu.ops.oracle import prefix_best
+from mpi_openmp_cuda_tpu.utils.constants import INT32_MIN
+
+W = [10, 2, 3, 4]
+
+
+def _random_problem(seed, n_seqs, l1_range=(2, 120), l2_max=None):
+    rng = np.random.default_rng(seed)
+    l1 = int(rng.integers(*l1_range))
+    seq1 = rng.integers(1, 27, size=l1).astype(np.int8)
+    seqs = []
+    for _ in range(n_seqs):
+        hi = l2_max or l1 + 2  # occasionally len2 >= len1 to hit edge paths
+        l2 = int(rng.integers(1, max(hi, 2)))
+        seqs.append(rng.integers(1, 27, size=l2).astype(np.int8))
+    weights = [int(x) for x in rng.integers(0, 15, size=4)]
+    return seq1, seqs, weights
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_xla_matches_oracle_random_ragged(seed):
+    seq1, seqs, weights = _random_problem(seed, n_seqs=9)
+    got = AlignmentScorer("xla").score_codes(seq1, seqs, weights)
+    want = [prefix_best(seq1, s, weights) for s in seqs]
+    assert [tuple(int(x) for x in row) for row in got] == want
+
+
+def test_equal_length_and_longer_seq2():
+    seq1 = encode("APQRSBATAV")
+    seqs = [encode("APQRSBATAV"), encode("APQRSBATAVX"), encode("OWRL")]
+    got = AlignmentScorer("xla").score_codes(seq1, seqs, W)
+    assert tuple(got[0]) == (10 * W[0], 0, 0)  # branch-A positional score
+    assert tuple(got[1]) == (INT32_MIN, 0, 0)  # len2 > len1 sentinel
+    assert prefix_best(seq1, seqs[2], W) == tuple(int(x) for x in got[2])
+
+
+def test_determinism_duplicate_sequences():
+    # input6 pattern: identical sequences in one batch must produce identical
+    # rows (the reference's racy kernel could not guarantee this, SURVEY B11).
+    seq1, seqs, weights = _random_problem(42, n_seqs=1)
+    batch = [seqs[0]] * 6
+    got = AlignmentScorer("xla").score_codes(seq1, batch, weights)
+    assert (got == got[0]).all()
+
+
+def test_chunking_invariance():
+    # Same problem scored with different chunk budgets must agree exactly.
+    seq1, seqs, weights = _random_problem(7, n_seqs=13)
+    a = AlignmentScorer("xla", chunk_budget=1 << 12).score_codes(seq1, seqs, weights)
+    b = AlignmentScorer("xla", chunk_budget=1 << 24).score_codes(seq1, seqs, weights)
+    assert (a == b).all()
+
+
+def test_padding_does_not_contaminate_scores():
+    # A batch with wildly different lengths: each row must score as if alone.
+    seq1 = encode("HELLOWORLDHELLOWORLDABCDEFGHIJ")
+    seqs = [encode("OWRL"), encode("HELLOWORLDHELLOWORLDABCDEFGH"), encode("A")]
+    got = AlignmentScorer("xla").score_codes(seq1, seqs, W)
+    for row, s2 in zip(got, seqs):
+        assert tuple(int(x) for x in row) == prefix_best(seq1, s2, W)
+
+
+def test_tie_break_parity_low_entropy():
+    # 2-letter alphabet maximises score ties; argmax order must match oracle.
+    rng = np.random.default_rng(3)
+    seq1 = rng.integers(1, 3, size=60).astype(np.int8)
+    seqs = [rng.integers(1, 3, size=int(rng.integers(1, 12))) for _ in range(16)]
+    weights = [5, 1, 1, 1]
+    got = AlignmentScorer("xla").score_codes(seq1, seqs, weights)
+    want = [prefix_best(seq1, s, weights) for s in seqs]
+    assert [tuple(int(x) for x in row) for row in got] == want
+
+
+def test_empty_batch():
+    assert AlignmentScorer("xla").score_codes(encode("ABC"), [], W).shape == (0, 3)
+
+
+def test_buffer_caps_enforced():
+    with pytest.raises(ValueError, match="BUF_SIZE_SEQ1"):
+        pad_problem(np.ones(3001, dtype=np.int8), [encode("A")])
+    with pytest.raises(ValueError, match="BUF_SIZE_SEQ2"):
+        pad_problem(encode("ABC"), [np.ones(2001, dtype=np.int8)])
+
+
+def test_round_up_and_chunking():
+    assert round_up(1, 128) == 128
+    assert round_up(129, 128) == 256
+    batch = pad_problem(encode("ABCD"), [encode("AB")])
+    assert batch.l1p == 128 and batch.l2p == 128
+    assert choose_chunk(batch, 1 << 24) >= 1
+
+
+def test_oracle_backend_dispatch():
+    seq1, seqs, weights = _random_problem(11, n_seqs=4)
+    a = AlignmentScorer("oracle").score_codes(seq1, seqs, weights)
+    b = AlignmentScorer("xla").score_codes(seq1, seqs, weights)
+    assert (np.asarray(a) == np.asarray(b)).all()
