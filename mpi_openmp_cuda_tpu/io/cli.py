@@ -95,25 +95,48 @@ def _build_sharding(mesh_arg: str | None):
 
 
 def run(argv: list[str] | None = None) -> int:
+    from ..utils.platform import apply_platform_override
+
+    apply_platform_override()
     args = build_arg_parser().parse_args(argv)
     timer = PhaseTimer(enabled=args.profile)
     try:
+        coordinator = True
         if args.distributed:
             with timer.phase("distributed_init"):
 
                 def _imp():
-                    from ..parallel.distributed import initialize_distributed
+                    from ..parallel import distributed
 
-                    return initialize_distributed
+                    return distributed
 
-                _feature_import("--distributed multi-host init", _imp)()
+                dist = _feature_import("--distributed multi-host init", _imp)
+                dist.initialize_distributed()
+                coordinator = dist.is_coordinator()
         with timer.phase("parse"):
-            problem = load_problem(args.input)
+            # Only the coordinator touches stdin (reference ROOT semantics);
+            # workers receive the parsed problem via broadcast.
+            problem = None
+            if coordinator:
+                try:
+                    problem = load_problem(args.input)
+                except Exception:
+                    if args.distributed:
+                        # Tell workers to abort instead of hanging in the
+                        # broadcast collective (whole-job fail-stop).
+                        dist.broadcast_problem(None, failed=True)
+                    raise
+            if args.distributed:
+                problem = dist.broadcast_problem(problem)
         with timer.phase("setup"):
             scorer = AlignmentScorer(
                 backend=args.backend, sharding=_build_sharding(args.mesh)
             )
         journal = None
+        if args.journal and args.distributed:
+            # Resume would make the coordinator score a subset while workers
+            # score the full batch — mismatched collectives hang the job.
+            raise ValueError("--journal cannot be combined with --distributed")
         if args.journal:
 
             def _imp():
@@ -130,11 +153,12 @@ def run(argv: list[str] | None = None) -> int:
                     problem.seq1_codes, problem.seq2_codes, problem.weights
                 )
         with timer.phase("print"):
-            print_results(results)
-            if args.json:
-                write_json_sidecar(
-                    results, args.json, meta={"backend": args.backend}
-                )
+            if coordinator:  # workers print nothing (main.c:199-211 semantics)
+                print_results(results)
+                if args.json:
+                    write_json_sidecar(
+                        results, args.json, meta={"backend": args.backend}
+                    )
         timer.report()
         return 0
     except BrokenPipeError:

@@ -119,7 +119,12 @@ class AlignmentScorer:
         batch = pad_problem(seq1_codes, seq2_codes)
         val_flat = value_table(weights).astype(np.int32).reshape(-1)
         if self.sharding is not None:
-            return self.sharding.score(batch, val_flat, backend=self.backend)
+            return self.sharding.score(
+                batch,
+                val_flat,
+                backend=self.backend,
+                chunk_budget=self.chunk_budget,
+            )
         return self._score_local(batch, val_flat)
 
     def _score_local(self, batch: PaddedBatch, val_flat: np.ndarray) -> np.ndarray:

@@ -90,14 +90,16 @@ def _score_pair(seq1ext, len1, seq2row, len2, val_flat):
     return jnp.stack([score, out_n, out_k])
 
 
-@jax.jit
-def score_chunks(seq1ext, len1, seq2_chunks, len2_chunks, val_flat):
+def score_chunks_body(seq1ext, len1, seq2_chunks, len2_chunks, val_flat):
     """Score a [NC, CB, L2P] chunked batch; returns [NC, CB, 3] int32.
 
     ``vmap`` handles intra-chunk batch parallelism (the per-sequence kernel
     launches of cudaFunctions.cu:204-220, minus the host synchronisation);
     ``lax.map`` walks chunks sequentially to bound live memory — the
     device-memory-manager role of C14, without per-call mallocs.
+
+    Unjitted body so the distribution layer can reuse it inside shard_map;
+    single-device callers use the jitted ``score_chunks`` below.
     """
 
     def chunk_fn(args):
@@ -107,3 +109,6 @@ def score_chunks(seq1ext, len1, seq2_chunks, len2_chunks, val_flat):
         )(rows, lens)
 
     return lax.map(chunk_fn, (seq2_chunks, len2_chunks))
+
+
+score_chunks = jax.jit(score_chunks_body)

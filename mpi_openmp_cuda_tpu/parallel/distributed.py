@@ -1,0 +1,145 @@
+"""Multi-host runtime (reference parity: C7 process tier + makefile runOn2).
+
+The reference deploys across two machines with ``mpiexec -np 2 -machinefile
+mf --map-by node`` (makefile:15): same binary on every node, rank 0 does the
+I/O.  The TPU-native equivalent is single-controller-style multi-host JAX:
+every host runs this same program, ``jax.distributed.initialize`` joins the
+job (env-driven under SLURM/GKE/TPU-VM metadata, or explicit flags), the
+global mesh spans all hosts' devices, and only process 0 touches
+stdin/stdout — workers feed from a host-0 broadcast exactly like the
+reference's ``MPI_Bcast`` of seq1/weights/sizes (main.c:149-152).
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def initialize_distributed(
+    coordinator_address: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+) -> None:
+    """Join (or start) a multi-host JAX job.
+
+    With no arguments, defers to jax.distributed's environment
+    auto-detection (TPU pod metadata, SLURM, ...).  Explicit arguments —
+    or JAX_COORDINATOR_ADDRESS / JAX_NUM_PROCESSES / JAX_PROCESS_ID env
+    vars — cover bare two-machine deployments (the `runOn2` analogue,
+    machinefile `mf` replaced by one coordinator address).
+    """
+    import jax
+
+    coordinator_address = coordinator_address or os.environ.get(
+        "JAX_COORDINATOR_ADDRESS"
+    )
+    if num_processes is None and "JAX_NUM_PROCESSES" in os.environ:
+        num_processes = int(os.environ["JAX_NUM_PROCESSES"])
+    if process_id is None and "JAX_PROCESS_ID" in os.environ:
+        process_id = int(os.environ["JAX_PROCESS_ID"])
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+    except (ValueError, RuntimeError) as e:
+        raise RuntimeError(
+            "multi-host initialization failed: set JAX_COORDINATOR_ADDRESS, "
+            "JAX_NUM_PROCESSES and JAX_PROCESS_ID (or run under a cluster "
+            f"jax.distributed can auto-detect): {e}"
+        ) from e
+
+
+def is_coordinator() -> bool:
+    """True on the rank that owns stdin/stdout (reference ROOT, main.c:9)."""
+    import jax
+
+    return jax.process_index() == 0
+
+
+def process_count() -> int:
+    import jax
+
+    return jax.process_count()
+
+
+def broadcast_problem(problem, *, failed: bool = False):
+    """Broadcast a parsed Problem from process 0 to all processes.
+
+    Only the coordinator reads stdin (reference semantics, main.c:76-108);
+    worker processes pass ``problem=None`` and receive the coordinator's.
+    Two-phase: a fixed-shape header (sizes) first, then the padded payload —
+    the fixed-stride-record idiom of the reference's Scatter buffer
+    (main.c:110-121) reused as a broadcast wire format.
+
+    ``failed=True`` (coordinator only) broadcasts an abort header instead,
+    so workers raise rather than hang in the collective when the
+    coordinator's parse failed — whole-job fail-stop, the C11 stance.
+    """
+    import jax
+    import numpy as np
+
+    if jax.process_count() == 1:
+        return problem
+    from jax.experimental import multihost_utils
+
+    from ..io.parse import Problem
+    from ..models.encoding import decode
+
+    if failed:
+        header = np.array([0, 0, 0, 1], dtype=np.int32)
+    elif problem is not None:
+        lens2 = np.array([c.size for c in problem.seq2_codes], dtype=np.int32)
+        maxl2 = int(lens2.max()) if lens2.size else 0
+        header = np.array(
+            [problem.seq1_codes.size, len(problem.seq2_codes), maxl2, 0],
+            dtype=np.int32,
+        )
+    else:
+        header = np.zeros(4, dtype=np.int32)
+    header = np.asarray(multihost_utils.broadcast_one_to_all(header))
+    if int(header[3]):
+        raise RuntimeError(
+            "coordinator failed before broadcasting the problem; aborting"
+        )
+    l1, n, maxl2 = int(header[0]), int(header[1]), int(header[2])
+
+    if problem is not None:
+        weights = np.asarray(problem.weights, dtype=np.int32)
+        seq1 = np.asarray(problem.seq1_codes, dtype=np.int8)
+        rows = np.zeros((n, maxl2), dtype=np.int8)
+        for i, c in enumerate(problem.seq2_codes):
+            rows[i, : c.size] = c
+        lens = lens2
+    else:
+        weights = np.zeros(4, dtype=np.int32)
+        seq1 = np.zeros(l1, dtype=np.int8)
+        rows = np.zeros((n, maxl2), dtype=np.int8)
+        lens = np.zeros(n, dtype=np.int32)
+
+    weights, seq1, rows, lens = (
+        np.asarray(a)
+        for a in multihost_utils.broadcast_one_to_all((weights, seq1, rows, lens))
+    )
+    seq2_codes = [rows[i, : int(lens[i])] for i in range(n)]
+    return Problem(
+        weights=[int(x) for x in weights],
+        seq1=decode(seq1),
+        seq2=[decode(c) for c in seq2_codes],
+        seq1_codes=seq1,
+        seq2_codes=seq2_codes,
+    )
+
+
+def broadcast_from_coordinator(tree):
+    """Host-level broadcast of (numpy) data from process 0 to all processes —
+    the MPI_Bcast tier for multi-host runs where only host 0 parsed stdin.
+    No-op in single-process jobs."""
+    import jax
+
+    if jax.process_count() == 1:
+        return tree
+    from jax.experimental import multihost_utils
+
+    return multihost_utils.broadcast_one_to_all(tree)

@@ -1,0 +1,64 @@
+"""Device mesh construction (reference parity: C7 topology setup).
+
+The reference's process topology is `mpiexec -np N` + `MPI_COMM_WORLD`
+(makefile:11,15; main.c:62-64).  The TPU equivalent is a named
+`jax.sharding.Mesh`: a 1-D ``('batch',)`` axis for data parallelism over the
+Seq2 batch; the sequence-parallel ring (parallel/ring.py) adds a ``'seq'``
+axis for long-context sharding.  Collectives ride ICI within a slice and
+DCN across slices — chosen by XLA from the sharding layout, not hand-coded.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+BATCH_AXIS = "batch"
+SEQ_AXIS = "seq"
+
+
+def make_mesh(
+    n_devices: int | None = None,
+    *,
+    axis_name: str = BATCH_AXIS,
+    devices=None,
+) -> Mesh:
+    """1-D mesh over the first ``n_devices`` devices (default: all)."""
+    import jax
+
+    devs = list(devices if devices is not None else jax.devices())
+    if n_devices is not None:
+        if n_devices < 1:
+            raise ValueError(f"mesh needs at least 1 device, got {n_devices}")
+        if n_devices > len(devs):
+            raise ValueError(
+                f"requested {n_devices} devices but only {len(devs)} available"
+            )
+        devs = devs[:n_devices]
+    return Mesh(np.array(devs), (axis_name,))
+
+
+def make_2d_mesh(
+    batch: int, seq: int, *, devices=None
+) -> Mesh:
+    """[batch, seq] mesh for combined data + sequence parallelism."""
+    import jax
+
+    devs = list(devices if devices is not None else jax.devices())
+    if batch * seq > len(devs):
+        raise ValueError(
+            f"mesh {batch}x{seq} needs {batch * seq} devices, have {len(devs)}"
+        )
+    return Mesh(
+        np.array(devs[: batch * seq]).reshape(batch, seq), (BATCH_AXIS, SEQ_AXIS)
+    )
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    """Every-device copy — the MPI_Bcast / CUDA-constant-memory analogue."""
+    return NamedSharding(mesh, PartitionSpec())
+
+
+def batch_sharded(mesh: Mesh, axis_name: str = BATCH_AXIS) -> NamedSharding:
+    """Leading-axis shard over the batch — the MPI_Scatter analogue."""
+    return NamedSharding(mesh, PartitionSpec(axis_name))

@@ -1,0 +1,28 @@
+"""Platform selection helper.
+
+Some TPU environments install a sitecustomize hook that force-registers a
+PJRT plugin and rewrites ``jax.config.jax_platforms`` at interpreter start,
+which silently overrides a user's ``JAX_PLATFORMS=cpu``.  This helper
+re-asserts the user's explicit choice (needed by the CPU-mesh test harness
+and any non-TPU deployment) without touching the TPU default path.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def apply_platform_override() -> None:
+    """Re-apply ``JAX_PLATFORMS`` from the environment if a site hook
+    overrode it.  No-op for TPU-targeting values."""
+    envp = os.environ.get("JAX_PLATFORMS")
+    if not envp:
+        return
+    # Only force non-TPU targets: the TPU plugin default is what site hooks
+    # set up, and narrowing e.g. "axon,cpu" -> "axon" would drop a fallback.
+    if any(p in envp for p in ("axon", "tpu")):
+        return
+    import jax
+
+    if jax.config.jax_platforms != envp:
+        jax.config.update("jax_platforms", envp)

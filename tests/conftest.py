@@ -21,6 +21,13 @@ if "xla_force_host_platform_device_count" not in _flags:
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+# A TPU sitecustomize hook may have force-registered a PJRT plugin and
+# overridden JAX_PLATFORMS; re-assert the CPU choice before any backend
+# initialises (see utils/platform.py).
+from mpi_openmp_cuda_tpu.utils.platform import apply_platform_override  # noqa: E402
+
+apply_platform_override()
+
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
