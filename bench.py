@@ -1,0 +1,142 @@
+"""Benchmark harness: one JSON line on stdout for the driver.
+
+Metric: **equivalent brute-force character comparisons per second per chip**
+on the stress fixture (input3-class workload).  The workload size is the
+reference algorithm's cost model — sum over pairs of (L1-L2+... ) exhaustive
+grid comparisons (BASELINE.md: 6,145,449,142 for input3.txt) — independent
+of how this framework actually computes it (the prefix-sum path does
+O(L1*L2) real work; the headroom is the point).
+
+vs_baseline: the reference publishes no numbers (BASELINE.md), so the
+denominator is an analytic estimate of the intended 2-rank MPI+CUDA
+deployment: 2 GPUs x ~1e9 effective char-comparisons/s each given the
+kernel's serial candidate grid with per-candidate block barriers and
+global-memory atomics = 2.0e9 elem/s.  vs_baseline > 1 means faster than
+the estimated reference; the north star is >= 10.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import numpy as np
+
+REF_BASELINE_ELEMS_PER_SEC = 2.0e9  # analytic 2-rank MPI+CUDA estimate
+
+
+def brute_force_elements(len1: int, lens2: list[int]) -> int:
+    """Reference cost model: per pair, (L1-L2) offsets x L2 mutants x L2
+    chars (equal-length pairs: L2 comparisons, one candidate)."""
+    total = 0
+    for l2 in lens2:
+        if l2 > len1:
+            continue
+        if l2 == len1:
+            total += l2
+        else:
+            total += (len1 - l2) * l2 * l2
+    return total
+
+
+def load_workload():
+    """input3.txt if the reference tree is mounted, else an equivalent
+    synthetic workload (same sizes, random uppercase sequences)."""
+    from mpi_openmp_cuda_tpu.io.parse import load_problem
+
+    path = os.environ.get("BENCH_INPUT", "/root/reference/input3.txt")
+    if os.path.exists(path):
+        return load_problem(path), os.path.basename(path)
+    rng = np.random.default_rng(3)
+    from mpi_openmp_cuda_tpu.io.parse import Problem
+    from mpi_openmp_cuda_tpu.models.encoding import decode, encode_normalized
+
+    seq1 = decode(rng.integers(1, 27, size=1489))
+    lens2 = [int(x) for x in rng.integers(56, 1153, size=32)]
+    seqs = [decode(rng.integers(1, 27, size=l)) for l in lens2]
+    problem = Problem(
+        weights=[2, 2, 1, 10],
+        seq1=seq1,
+        seq2=seqs,
+        seq1_codes=encode_normalized(seq1),
+        seq2_codes=[encode_normalized(s) for s in seqs],
+    )
+    return problem, "synthetic-input3-class"
+
+
+def pick_backend() -> str:
+    forced = os.environ.get("BENCH_BACKEND")
+    if forced:
+        return forced
+    try:
+        import jax
+
+        on_tpu = jax.devices()[0].platform == "tpu"
+    except Exception:
+        on_tpu = False
+    if on_tpu:
+        try:
+            import mpi_openmp_cuda_tpu.ops.pallas_scorer  # noqa: F401
+
+            return "pallas"
+        except Exception:
+            pass
+    return "xla"
+
+
+def main() -> None:
+    import jax
+
+    from mpi_openmp_cuda_tpu.ops.dispatch import AlignmentScorer
+
+    problem, workload = load_workload()
+    backend = pick_backend()
+    n_chips = 1  # bench contract: single-chip throughput
+    scorer = AlignmentScorer(backend=backend)
+
+    def run():
+        return scorer.score_codes(
+            problem.seq1_codes, problem.seq2_codes, problem.weights
+        )
+
+    t0 = time.perf_counter()
+    first = run()  # includes compile
+    compile_and_run = time.perf_counter() - t0
+
+    times = []
+    for _ in range(int(os.environ.get("BENCH_REPS", "3"))):
+        t0 = time.perf_counter()
+        out = run()
+        times.append(time.perf_counter() - t0)
+    wall = float(np.median(times))
+
+    assert (np.asarray(out) == np.asarray(first)).all(), "nondeterministic bench run"
+
+    elements = brute_force_elements(
+        problem.seq1_codes.size, [c.size for c in problem.seq2_codes]
+    )
+    value = elements / wall / n_chips
+    print(
+        json.dumps(
+            {
+                "metric": f"equivalent brute-force char comparisons/s/chip, {workload}",
+                "value": round(value, 1),
+                "unit": "elements/s/chip",
+                "vs_baseline": round(value / REF_BASELINE_ELEMS_PER_SEC, 2),
+            }
+        )
+    )
+    print(
+        f"[bench] backend={backend} device={jax.devices()[0].device_kind} "
+        f"workload={workload} elements={elements} wall={wall:.4f}s "
+        f"(compile+first run {compile_and_run:.1f}s, reps={times})",
+        file=sys.stderr,
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -129,9 +129,20 @@ def run(argv: list[str] | None = None) -> int:
             if args.distributed:
                 problem = dist.broadcast_problem(problem)
         with timer.phase("setup"):
-            scorer = AlignmentScorer(
-                backend=args.backend, sharding=_build_sharding(args.mesh)
-            )
+            sharding = _build_sharding(args.mesh)
+            if sharding is None and args.distributed:
+                # Distributed without an explicit mesh would make every host
+                # redo the full batch; default to the global mesh so the
+                # work actually splits (the MPI_Scatter semantics).
+                def _imp_default():
+                    from ..parallel.sharding import BatchSharding
+
+                    return BatchSharding
+
+                sharding = _feature_import(
+                    "--distributed batch sharding", _imp_default
+                ).over_devices(None)
+            scorer = AlignmentScorer(backend=args.backend, sharding=sharding)
         journal = None
         if args.journal and args.distributed:
             # Resume would make the coordinator score a subset while workers

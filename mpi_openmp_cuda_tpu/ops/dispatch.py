@@ -85,6 +85,19 @@ def choose_chunk(batch: PaddedBatch, budget: int) -> int:
     return min(cb, max(1, 1 << (batch.batch_size - 1).bit_length()))
 
 
+def pad_batch_rows(batch: PaddedBatch, bp: int) -> tuple[np.ndarray, np.ndarray]:
+    """Zero-pad the batch rows/lengths to ``bp`` total rows.
+
+    Shared by the single-device and sharded paths so padding semantics
+    (zero rows == len-0 sentinels, dropped on output) cannot diverge.
+    """
+    rows = np.zeros((bp, batch.l2p), dtype=np.int32)
+    rows[: batch.batch_size] = batch.seq2
+    lens = np.zeros(bp, dtype=np.int32)
+    lens[: batch.batch_size] = batch.len2
+    return rows, lens
+
+
 class AlignmentScorer:
     """Front door to the accelerated scoring paths (the C2 offload ABI's
     Python-side equivalent).
@@ -147,10 +160,7 @@ class AlignmentScorer:
         b = batch.batch_size
         cb = choose_chunk(batch, self.chunk_budget)
         bp = round_up(b, cb)
-        rows = np.zeros((bp, batch.l2p), dtype=np.int32)
-        rows[:b] = batch.seq2
-        lens = np.zeros(bp, dtype=np.int32)
-        lens[:b] = batch.len2
+        rows, lens = pad_batch_rows(batch, bp)
         out = score_chunks(
             jnp.asarray(batch.seq1ext),
             jnp.int32(batch.len1),

@@ -100,6 +100,10 @@ def broadcast_problem(problem, *, failed: bool = False):
         header = np.zeros(4, dtype=np.int32)
     header = np.asarray(multihost_utils.broadcast_one_to_all(header))
     if int(header[3]):
+        if jax.process_index() == 0:
+            # The coordinator already has the real parse exception in
+            # flight; let it propagate instead of masking it here.
+            return None
         raise RuntimeError(
             "coordinator failed before broadcasting the problem; aborting"
         )

@@ -34,6 +34,14 @@ def make_mesh(
             raise ValueError(
                 f"requested {n_devices} devices but only {len(devs)} available"
             )
+        if jax.process_count() > 1 and n_devices != len(devs):
+            # Slicing the global device list would exclude some hosts'
+            # devices; their processes would then address nothing in the
+            # mesh and hang/fail in the collectives.
+            raise ValueError(
+                f"multi-host jobs must mesh all {len(devs)} global devices, "
+                f"got --mesh {n_devices}"
+            )
         devs = devs[:n_devices]
     return Mesh(np.array(devs), (axis_name,))
 

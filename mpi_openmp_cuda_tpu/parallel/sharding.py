@@ -24,7 +24,12 @@ from dataclasses import dataclass
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
-from ..ops.dispatch import DEFAULT_CHUNK_BUDGET, PaddedBatch, choose_chunk
+from ..ops.dispatch import (
+    DEFAULT_CHUNK_BUDGET,
+    PaddedBatch,
+    choose_chunk,
+    pad_batch_rows,
+)
 from .mesh import BATCH_AXIS, batch_sharded, make_mesh, replicated
 
 
@@ -75,15 +80,14 @@ class BatchSharding:
         import jax.numpy as jnp
 
         if backend == "pallas":
+            # Import check up front for a friendly error; the cached
+            # shard_map factory re-imports by shape key (stable identity).
             try:
-                from ..ops.pallas_scorer import pallas_pair_scorer
+                from ..ops import pallas_scorer  # noqa: F401
             except ModuleNotFoundError as e:
                 raise RuntimeError(
                     "backend 'pallas' is not available in this build"
                 ) from e
-            pair_like = pallas_pair_scorer(batch.l1p, batch.l2p)
-        else:
-            pair_like = None
 
         d = self.n_devices
         b = batch.batch_size
@@ -93,10 +97,7 @@ class BatchSharding:
         bl = cb * (-(-b // (d * cb)))  # per-device rows, multiple of cb
         bp = bl * d
 
-        rows = np.zeros((bp, batch.l2p), dtype=np.int32)
-        rows[:b] = batch.seq2
-        lens = np.zeros(bp, dtype=np.int32)
-        lens[:b] = batch.len2
+        rows, lens = pad_batch_rows(batch, bp)
 
         rows_d = _put_global(rows, batch_sharded(self.mesh))
         lens_d = _put_global(lens, batch_sharded(self.mesh))
@@ -108,19 +109,28 @@ class BatchSharding:
         )
         len1_d = jnp.int32(batch.len1)
 
-        out = _sharded_score(
-            self.mesh, cb, seq1_d, len1_d, rows_d, lens_d, val_d, pair_like
-        )
+        out = _sharded_fn(
+            self.mesh, cb, (batch.l1p, batch.l2p) if backend == "pallas" else None
+        )(seq1_d, len1_d, rows_d, lens_d, val_d)
         return _fetch_global(out)[:b]
 
 
 @functools.lru_cache(maxsize=64)
-def _sharded_fn(mesh, cb, pair_like):
+def _sharded_fn(mesh, cb, pallas_shapes: tuple[int, int] | None):
     """Build (and cache) the jitted shard_map scorer for one mesh/chunk
-    config; jit itself then caches per input-shape bucket."""
+    config; jit itself then caches per input-shape bucket.  Keyed on the
+    (l1p, l2p) shape bucket for the pallas path — not a closure object —
+    so repeated calls hit the cache instead of re-tracing."""
     import jax
 
     from ..ops.xla_scorer import score_chunks_body
+
+    if pallas_shapes is not None:
+        from ..ops.pallas_scorer import pallas_pair_scorer
+
+        pair_like = pallas_pair_scorer(*pallas_shapes)
+    else:
+        pair_like = None
 
     def local_fn(seq1ext, len1, rows, lens, val_flat):
         bl, l2p = rows.shape
@@ -142,10 +152,4 @@ def _sharded_fn(mesh, cb, pair_like):
             in_specs=(P(), P(), P(BATCH_AXIS), P(BATCH_AXIS), P()),
             out_specs=P(BATCH_AXIS),
         )
-    )
-
-
-def _sharded_score(mesh, cb, seq1ext, len1, rows, lens, val_flat, pair_like):
-    return _sharded_fn(mesh, cb, pair_like)(
-        seq1ext, len1, rows, lens, val_flat
     )

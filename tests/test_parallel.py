@@ -81,16 +81,35 @@ def test_sharded_matches_oracle():
 
 
 def test_sharded_output_is_batch_sharded():
-    # The compute must actually distribute: check the device-local shards.
+    # The compute must actually distribute: inspect the pre-fetch jax Array's
+    # sharding and per-device shards, not just the gathered host result.
+    from mpi_openmp_cuda_tpu.parallel.sharding import (
+        _put_global,
+        _sharded_fn,
+    )
+    import jax.numpy as jnp
+
     mesh = make_mesh(8)
-    sharding = BatchSharding(mesh)
     rng = np.random.default_rng(1)
     seq1 = rng.integers(1, 27, size=40).astype(np.int8)
     seqs = [rng.integers(1, 27, size=10).astype(np.int8) for _ in range(16)]
     batch = pad_problem(seq1, seqs)
     val = value_table(W).astype(np.int32).reshape(-1)
-    out = sharding.score(batch, val)
-    assert out.shape == (16, 3)
+
+    rows, lens = np.zeros((16, batch.l2p), np.int32), np.zeros(16, np.int32)
+    rows[:16] = batch.seq2
+    lens[:16] = batch.len2
+    out = _sharded_fn(mesh, 2, None)(
+        _put_global(np.asarray(batch.seq1ext, np.int32), replicated(mesh)),
+        jnp.int32(batch.len1),
+        _put_global(rows, batch_sharded(mesh)),
+        _put_global(lens, batch_sharded(mesh)),
+        _put_global(np.asarray(val, np.int32), replicated(mesh)),
+    )
+    assert out.sharding.spec == ("batch",)
+    shards = out.addressable_shards
+    assert len(shards) == 8
+    assert all(s.data.shape == (2, 3) for s in shards)
 
 
 def test_mixed_edge_rows_sharded():
