@@ -31,9 +31,10 @@ def build_arg_parser() -> argparse.ArgumentParser:
     )
     p.add_argument(
         "--backend",
-        choices=("xla", "pallas", "oracle"),
+        choices=("xla", "xla-gather", "pallas", "oracle"),
         default="xla",
-        help="compute path: pure-XLA (default), Pallas TPU kernel, or host numpy oracle",
+        help="compute path: pure-XLA MXU formulation (default), gather "
+        "formulation, Pallas TPU kernel, or host numpy oracle",
     )
     p.add_argument(
         "--mesh",

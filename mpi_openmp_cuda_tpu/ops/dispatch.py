@@ -85,6 +85,33 @@ def choose_chunk(batch: PaddedBatch, budget: int) -> int:
     return min(cb, max(1, 1 << (batch.batch_size - 1).bit_length()))
 
 
+def mm_formulation_exact(val_flat: np.ndarray) -> bool:
+    """True when every partial sum stays an exact float32 integer on the
+    matmul path (|score| <= BUF_SIZE_SEQ2 * max|value| < 2^24)."""
+    from .matmul_scorer import MAX_EXACT_WEIGHT
+
+    return int(np.abs(np.asarray(val_flat)).max()) <= MAX_EXACT_WEIGHT
+
+
+def xla_formulation_mode(backend: str, val_flat: np.ndarray) -> str:
+    """'mm' or 'gather' for an 'xla*' backend string — the single source of
+    truth for the formulation choice, shared by the local and sharded paths."""
+    if backend == "xla" and mm_formulation_exact(val_flat):
+        return "mm"
+    return "gather"
+
+
+def resolve_xla_formulation(backend: str, val_flat: np.ndarray):
+    """Pick the jitted chunked scorer for an 'xla*' backend string."""
+    if xla_formulation_mode(backend, val_flat) == "mm":
+        from .matmul_scorer import score_chunks_mm
+
+        return score_chunks_mm
+    from .xla_scorer import score_chunks
+
+    return score_chunks
+
+
 def pad_batch_rows(batch: PaddedBatch, bp: int) -> tuple[np.ndarray, np.ndarray]:
     """Zero-pad the batch rows/lengths to ``bp`` total rows.
 
@@ -102,8 +129,11 @@ class AlignmentScorer:
     """Front door to the accelerated scoring paths (the C2 offload ABI's
     Python-side equivalent).
 
-    backend: 'xla' (default, works everywhere), 'pallas' (TPU kernel),
-    or 'oracle' (host numpy — the always-correct reference path).
+    backend: 'xla' (default: the gather-free MXU matmul formulation, with
+    an automatic fall-back to the gather formulation when weight magnitudes
+    could exceed float32 integer exactness), 'xla-gather' (force the
+    int32 gather formulation), 'pallas' (TPU kernel), or 'oracle' (host
+    numpy — the always-correct reference path).
     """
 
     def __init__(
@@ -112,7 +142,7 @@ class AlignmentScorer:
         chunk_budget: int = DEFAULT_CHUNK_BUDGET,
         sharding=None,
     ):
-        if backend not in ("xla", "pallas", "oracle"):
+        if backend not in ("xla", "xla-gather", "pallas", "oracle"):
             raise ValueError(f"unknown backend {backend!r}")
         self.backend = backend
         self.chunk_budget = chunk_budget
@@ -155,13 +185,13 @@ class AlignmentScorer:
                 score_batch_pallas(batch, jnp.asarray(val_flat))
             )[: batch.batch_size]
 
-        from .xla_scorer import score_chunks
+        fn = resolve_xla_formulation(self.backend, val_flat)
 
         b = batch.batch_size
         cb = choose_chunk(batch, self.chunk_budget)
         bp = round_up(b, cb)
         rows, lens = pad_batch_rows(batch, bp)
-        out = score_chunks(
+        out = fn(
             jnp.asarray(batch.seq1ext),
             jnp.int32(batch.len1),
             jnp.asarray(rows.reshape(bp // cb, cb, batch.l2p)),

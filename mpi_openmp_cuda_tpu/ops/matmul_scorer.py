@@ -1,0 +1,140 @@
+"""MXU-formulated XLA scorer: gather-free diagonal prefix sums.
+
+The first XLA formulation (xla_scorer.py) indexes the 27x27 value table and
+seq1 with large gathers, which TPUs execute poorly (the bench showed the
+host numpy oracle outrunning it).  This formulation maps the same math onto
+the hardware's strengths:
+
+* **Value matrix via one-hot matmul (MXU).**  ``V[i, j] = val[seq2[i],
+  seq1[j]]`` becomes ``onehot(seq2) @ (val @ onehot(seq1).T)`` — the
+  ``[27, W]`` right factor is shared by the whole batch, so each pair costs
+  one ``[L2P, 27] x [27, W]`` matmul.  Integer values < 2^24 are exact in
+  float32 (the dispatch layer falls back to the gather path for weights
+  that could overflow this).
+* **Diagonal shear via pad+reshape (zero data movement).**  Appending one
+  zero column's worth of padding to ``V``'s flat buffer and re-viewing it
+  with row stride W+1 shifts row i left by i: ``D[i, n] = V[i, i+n]`` —
+  the diagonal family — with NO gather (wrap garbage lands only in cells
+  the (n, k) validity mask kills anyway).
+* **Prefix sums on the VPU; argmax as reductions.**  ``score(n, k) =
+  prefix0[k] + total1 - prefix1[k]``; the best candidate is found with a
+  per-offset max over k, an argmax over offsets (first-hit = smallest n),
+  then a first-equal scan over k — reproducing the reference's
+  offset-major, k-ascending-with-0-first tie-break exactly
+  (cudaFunctions.cu:161) without materialising a transposed grid.
+
+Semantics are identical to xla_scorer/the oracles; property tests pin all
+three to each other.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..utils.constants import ALPHABET_SIZE, INT32_MIN
+
+_NEG = jnp.float32(-(2.0**40))
+
+# Weight magnitudes up to this keep every partial sum an exact float32
+# integer (|score| <= BUF_SIZE_SEQ2 * max_w < 2^24).
+MAX_EXACT_WEIGHT = 4095
+
+
+def _onehot(codes, width: int) -> jax.Array:
+    return (
+        codes[:, None] == jnp.arange(width, dtype=codes.dtype)[None, :]
+    ).astype(jnp.float32)
+
+
+def _shear(v: jax.Array) -> jax.Array:
+    """[M, W] -> [M, W+1] with row i shifted left by i: out[i, n] = v[i, i+n].
+
+    Pure pad+reshape on the flat buffer (row stride W -> W+1); cells with
+    i+n >= W hold wrap garbage that only the validity mask ever sees.
+    """
+    m, w = v.shape
+    flat = jnp.concatenate([v.reshape(-1), jnp.zeros(m, v.dtype)])
+    return flat.reshape(m, w + 1)
+
+
+def _score_pair_mm(a_right, len1, seq2row, len2, noff):
+    """Score one pair against the shared right factor ``a_right`` =
+    val @ onehot(seq1).T, shape [27, W].  Returns (score, n, k) int32."""
+    l2p = seq2row.shape[0]
+    i = jnp.arange(l2p, dtype=jnp.int32)
+
+    oh2 = _onehot(seq2row.astype(jnp.int32), ALPHABET_SIZE)
+    oh2 = jnp.where((i < len2)[:, None], oh2, 0.0)  # pad rows contribute 0
+    v = jax.lax.dot_general(
+        oh2,
+        a_right,
+        (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )  # [L2P, W]
+
+    d = _shear(v)  # [L2P, W+1]
+    d0 = d[:, :noff]  # D0[i, n] = V[i, i+n]
+    d1 = d[:, 1 : noff + 1]  # D1[i, n] = V[i, i+n+1]
+    c0 = jnp.cumsum(d0, axis=0)
+    c1 = jnp.cumsum(d1, axis=0)
+    t0 = c0[-1, :]  # full unshifted sum per offset (k=0 candidate)
+    t1 = c1[-1, :]
+
+    # Row k holds mutant k: k=0 -> t0; k>=1 -> prefix0(k) + shifted suffix1(k).
+    s = jnp.concatenate(
+        [t0[None, :], c0[:-1, :] + (t1[None, :] - c1[:-1, :])], axis=0
+    )  # [L2P, NOFF]
+
+    k = jnp.arange(l2p, dtype=jnp.int32)[:, None]
+    n = jnp.arange(noff, dtype=jnp.int32)[None, :]
+    valid = (n < jnp.maximum(len1 - len2, 0)) & ((k == 0) | (k < len2))
+    s = jnp.where(valid, s, _NEG)
+
+    per_n_max = jnp.max(s, axis=0)  # [NOFF]
+    best_n = jnp.argmax(per_n_max).astype(jnp.int32)  # first max -> smallest n
+    best = per_n_max[best_n]
+    col = s[:, best_n]
+    best_k = jnp.argmax(col == best).astype(jnp.int32)  # first k achieving it
+
+    eq_score = c0[-1, 0]  # positional score at n=0 (branch-A analogue)
+    searchable = (len2 < len1) & (len2 > 0)
+    score_f = jnp.where(len2 == len1, eq_score, best)
+    score = jnp.where(
+        searchable | (len2 == len1),
+        score_f.astype(jnp.int32),
+        jnp.int32(INT32_MIN),
+    )
+    out_n = jnp.where(searchable, best_n, 0)
+    out_k = jnp.where(searchable, best_k, 0)
+    return jnp.stack([score, out_n, out_k])
+
+
+def score_chunks_mm_body(seq1ext, len1, seq2_chunks, len2_chunks, val_flat):
+    """MXU-path analogue of xla_scorer.score_chunks_body: [NC, CB, L2P]
+    chunked batch -> [NC, CB, 3] int32."""
+    nc, cb, l2p = seq2_chunks.shape
+    noff = seq1ext.shape[0] - l2p - 1  # == L1P, same convention as gather path
+    w = noff
+
+    # Shared right factor: [27, W], one small matmul per problem.
+    val27 = val_flat.reshape(ALPHABET_SIZE, ALPHABET_SIZE).astype(jnp.float32)
+    oh1 = _onehot(seq1ext[:w].astype(jnp.int32), ALPHABET_SIZE)  # [W, 27]
+    a_right = jax.lax.dot_general(
+        val27,
+        oh1,
+        (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )  # [27, W]
+
+    def chunk_fn(args):
+        rows, lens = args
+        return jax.vmap(
+            lambda r, l: _score_pair_mm(a_right, len1, r, l, noff)
+        )(rows, lens)
+
+    return lax.map(chunk_fn, (seq2_chunks, len2_chunks))
+
+
+score_chunks_mm = jax.jit(score_chunks_mm_body)

@@ -88,6 +88,11 @@ class BatchSharding:
                 raise RuntimeError(
                     "backend 'pallas' is not available in this build"
                 ) from e
+            mode = ("pallas", batch.l1p, batch.l2p)
+        else:
+            from ..ops.dispatch import xla_formulation_mode
+
+            mode = (xla_formulation_mode(backend, val_flat),)
 
         d = self.n_devices
         b = batch.batch_size
@@ -109,34 +114,39 @@ class BatchSharding:
         )
         len1_d = jnp.int32(batch.len1)
 
-        out = _sharded_fn(
-            self.mesh, cb, (batch.l1p, batch.l2p) if backend == "pallas" else None
-        )(seq1_d, len1_d, rows_d, lens_d, val_d)
+        out = _sharded_fn(self.mesh, cb, mode)(
+            seq1_d, len1_d, rows_d, lens_d, val_d
+        )
         return _fetch_global(out)[:b]
 
 
 @functools.lru_cache(maxsize=64)
-def _sharded_fn(mesh, cb, pallas_shapes: tuple[int, int] | None):
+def _sharded_fn(mesh, cb, mode: tuple):
     """Build (and cache) the jitted shard_map scorer for one mesh/chunk
-    config; jit itself then caches per input-shape bucket.  Keyed on the
-    (l1p, l2p) shape bucket for the pallas path — not a closure object —
-    so repeated calls hit the cache instead of re-tracing."""
+    config; jit itself then caches per input-shape bucket.  ``mode`` is a
+    hashable formulation key — ('mm',), ('gather',) or ('pallas', l1p, l2p)
+    — never a closure object, so repeated calls hit the cache."""
     import jax
 
-    from ..ops.xla_scorer import score_chunks_body
-
-    if pallas_shapes is not None:
+    if mode[0] == "pallas":
         from ..ops.pallas_scorer import pallas_pair_scorer
 
-        pair_like = pallas_pair_scorer(*pallas_shapes)
+        pair_like = pallas_pair_scorer(mode[1], mode[2])
+        chunks_body = None
+    elif mode[0] == "mm":
+        from ..ops.matmul_scorer import score_chunks_mm_body as chunks_body
+
+        pair_like = None
     else:
+        from ..ops.xla_scorer import score_chunks_body as chunks_body
+
         pair_like = None
 
     def local_fn(seq1ext, len1, rows, lens, val_flat):
         bl, l2p = rows.shape
         if pair_like is not None:
             return pair_like(seq1ext, len1, rows, lens, val_flat)
-        out = score_chunks_body(
+        out = chunks_body(
             seq1ext,
             len1,
             rows.reshape(bl // cb, cb, l2p),

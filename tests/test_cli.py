@@ -13,10 +13,14 @@ from conftest import REFERENCE_DIR, reference_fixture
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 GOLDEN = os.path.join(REPO, "tests", "golden")
 
+_pp = os.environ.get("PYTHONPATH")
 ENV = {
     **os.environ,
     "JAX_PLATFORMS": "cpu",
-    "PYTHONPATH": REPO,
+    # Prepend, never replace: site hooks (e.g. the TPU plugin loader) may
+    # already live on PYTHONPATH.  No trailing separator: an empty entry
+    # would put the subprocess cwd on sys.path.
+    "PYTHONPATH": REPO + (os.pathsep + _pp if _pp else ""),
 }
 
 

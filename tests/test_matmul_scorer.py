@@ -1,0 +1,101 @@
+"""MXU-formulation scorer tests: equivalence with the gather formulation and
+the numpy oracle, float32-exactness fallback, tie-break parity."""
+
+import numpy as np
+import pytest
+
+from mpi_openmp_cuda_tpu.models.encoding import encode
+from mpi_openmp_cuda_tpu.ops.dispatch import (
+    AlignmentScorer,
+    mm_formulation_exact,
+    resolve_xla_formulation,
+)
+from mpi_openmp_cuda_tpu.ops.matmul_scorer import MAX_EXACT_WEIGHT
+from mpi_openmp_cuda_tpu.ops.oracle import prefix_best
+from mpi_openmp_cuda_tpu.ops.values import value_table
+from mpi_openmp_cuda_tpu.utils.constants import INT32_MIN
+
+W = [10, 2, 3, 4]
+
+
+def _random_problem(seed, n_seqs, l1_max=150):
+    rng = np.random.default_rng(seed)
+    l1 = int(rng.integers(2, l1_max))
+    seq1 = rng.integers(1, 27, size=l1).astype(np.int8)
+    seqs = [
+        rng.integers(1, 27, size=int(rng.integers(1, l1 + 2))).astype(np.int8)
+        for _ in range(n_seqs)
+    ]
+    weights = [int(x) for x in rng.integers(0, 15, size=4)]
+    return seq1, seqs, weights
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_mm_matches_oracle_random_ragged(seed):
+    seq1, seqs, weights = _random_problem(seed, n_seqs=9)
+    got = AlignmentScorer("xla").score_codes(seq1, seqs, weights)
+    want = [prefix_best(seq1, s, weights) for s in seqs]
+    assert [tuple(int(x) for x in row) for row in got] == want
+
+
+@pytest.mark.parametrize("seed", range(3))
+def test_mm_matches_gather_formulation(seed):
+    seq1, seqs, weights = _random_problem(seed + 100, n_seqs=7)
+    mm = AlignmentScorer("xla").score_codes(seq1, seqs, weights)
+    gather = AlignmentScorer("xla-gather").score_codes(seq1, seqs, weights)
+    assert (mm == gather).all()
+
+
+def test_mm_tie_break_low_entropy():
+    rng = np.random.default_rng(5)
+    seq1 = rng.integers(1, 3, size=80).astype(np.int8)
+    seqs = [rng.integers(1, 3, size=int(rng.integers(1, 15))) for _ in range(12)]
+    weights = [5, 1, 1, 1]
+    got = AlignmentScorer("xla").score_codes(seq1, seqs, weights)
+    want = [prefix_best(seq1, s, weights) for s in seqs]
+    assert [tuple(int(x) for x in row) for row in got] == want
+
+
+def test_mm_edge_cases():
+    seq1 = encode("HELLOWORLD")
+    seqs = [encode("HELLOWORLD"), encode("HELLOWORLDX"), encode("OWRL")]
+    got = AlignmentScorer("xla").score_codes(seq1, seqs, W)
+    assert tuple(got[0]) == (10 * W[0], 0, 0)
+    assert tuple(got[1]) == (INT32_MIN, 0, 0)
+    assert tuple(got[2]) == prefix_best(seq1, seqs[2], W)
+
+
+def test_exactness_guard_falls_back_to_gather():
+    small = value_table([10, 2, 3, 4]).reshape(-1)
+    huge = value_table([MAX_EXACT_WEIGHT + 1, 2, 3, 4]).reshape(-1)
+    assert mm_formulation_exact(small)
+    assert not mm_formulation_exact(huge)
+    from mpi_openmp_cuda_tpu.ops.matmul_scorer import score_chunks_mm
+    from mpi_openmp_cuda_tpu.ops.xla_scorer import score_chunks
+
+    assert resolve_xla_formulation("xla", small) is score_chunks_mm
+    assert resolve_xla_formulation("xla", huge) is score_chunks
+    assert resolve_xla_formulation("xla-gather", small) is score_chunks
+
+
+def test_huge_weights_still_correct_end_to_end():
+    # Weights beyond float32 exactness: dispatch must auto-route to the
+    # int32 gather path and still match the (int64) oracle.
+    rng = np.random.default_rng(8)
+    seq1 = rng.integers(1, 27, size=60).astype(np.int8)
+    seqs = [rng.integers(1, 27, size=20).astype(np.int8) for _ in range(4)]
+    weights = [100000, 50000, 3, 4]
+    got = AlignmentScorer("xla").score_codes(seq1, seqs, weights)
+    want = [prefix_best(seq1, s, weights) for s in seqs]
+    assert [tuple(int(x) for x in row) for row in got] == want
+
+
+def test_mm_sharded_matches_local():
+    from mpi_openmp_cuda_tpu.parallel.sharding import BatchSharding
+
+    seq1, seqs, weights = _random_problem(77, n_seqs=13)
+    local = AlignmentScorer("xla").score_codes(seq1, seqs, weights)
+    shard = AlignmentScorer(
+        "xla", sharding=BatchSharding.over_devices(8)
+    ).score_codes(seq1, seqs, weights)
+    assert (local == shard).all()

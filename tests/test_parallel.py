@@ -99,7 +99,7 @@ def test_sharded_output_is_batch_sharded():
     rows, lens = np.zeros((16, batch.l2p), np.int32), np.zeros(16, np.int32)
     rows[:16] = batch.seq2
     lens[:16] = batch.len2
-    out = _sharded_fn(mesh, 2, None)(
+    out = _sharded_fn(mesh, 2, ("mm",))(
         _put_global(np.asarray(batch.seq1ext, np.int32), replicated(mesh)),
         jnp.int32(batch.len1),
         _put_global(rows, batch_sharded(mesh)),
@@ -128,7 +128,9 @@ def test_mixed_edge_rows_sharded():
 
 def test_cli_mesh_flag_byte_exact():
     path = reference_fixture("input1.txt")
-    env = {**os.environ, "JAX_PLATFORMS": "cpu", "PYTHONPATH": REPO,
+    pp = os.environ.get("PYTHONPATH")
+    env = {**os.environ, "JAX_PLATFORMS": "cpu",
+           "PYTHONPATH": REPO + (os.pathsep + pp if pp else ""),
            "XLA_FLAGS": "--xla_force_host_platform_device_count=8"}
     with open(path) as f:
         proc = subprocess.run(
