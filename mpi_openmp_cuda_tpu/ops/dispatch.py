@@ -112,6 +112,26 @@ def resolve_xla_formulation(backend: str, val_flat: np.ndarray):
     return score_chunks
 
 
+def resolve_chunks_body(backend: str, val_flat: np.ndarray):
+    """Unjitted chunked-scorer body for a backend string (bench/shard_map
+    composition), including the float32-exactness fallback: a 'pallas'
+    request with overflow-risk weights gets the exact int32 gather body —
+    the same routing the production score paths apply."""
+    if backend == "pallas" and mm_formulation_exact(val_flat):
+        from .pallas_scorer import score_chunks_pallas_body
+
+        return score_chunks_pallas_body
+    if backend == "pallas":
+        backend = "xla-gather"
+    if xla_formulation_mode(backend, val_flat) == "mm":
+        from .matmul_scorer import score_chunks_mm_body
+
+        return score_chunks_mm_body
+    from .xla_scorer import score_chunks_body
+
+    return score_chunks_body
+
+
 def pad_batch_rows(batch: PaddedBatch, bp: int) -> tuple[np.ndarray, np.ndarray]:
     """Zero-pad the batch rows/lengths to ``bp`` total rows.
 

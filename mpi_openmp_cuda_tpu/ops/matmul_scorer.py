@@ -59,9 +59,49 @@ def _shear(v: jax.Array) -> jax.Array:
     return flat.reshape(m, w + 1)
 
 
+_SCAN_BLOCK = 128  # MXU-native tile edge
+
+
+def _block_prefix(d: jax.Array) -> jax.Array:
+    """Inclusive prefix sum over axis 0 via a two-level block-scan.
+
+    ``jnp.cumsum`` over a 1280-long axis and a full [M, M] triangular
+    matmul both measured ~6-8 ms/rep on the stress workload; splitting M
+    into 128-row blocks does the heavy lifting with [128, 128] triangular
+    matmuls on the MXU (10x fewer FLOPs than the full triangle) plus a
+    tiny carry-in cumsum over the block totals.  Exact in float32: every
+    partial sum is an integer below 2^24 regardless of summation order.
+    """
+    m, w = d.shape
+    if m % _SCAN_BLOCK != 0:  # bucketing guarantees this; stay safe anyway
+        return jnp.cumsum(d, axis=0)
+    nb = m // _SCAN_BLOCK
+    ii = jnp.arange(_SCAN_BLOCK)
+    ltri = (ii[:, None] >= ii[None, :]).astype(d.dtype)
+    blocks = d.reshape(nb, _SCAN_BLOCK, w)
+    within = jnp.einsum(
+        "kb,nbw->nkw", ltri, blocks, preferred_element_type=d.dtype
+    )
+    carry = jnp.cumsum(within[:, -1, :], axis=0) - within[:, -1, :]
+    return (within + carry[:, None, :]).reshape(m, w)
+
+
 def _score_pair_mm(a_right, len1, seq2row, len2, noff):
     """Score one pair against the shared right factor ``a_right`` =
-    val @ onehot(seq1).T, shape [27, W].  Returns (score, n, k) int32."""
+    val @ onehot(seq1).T, shape [27, W].  Returns (score, n, k) int32.
+
+    Delta formulation.  With d0/d1 the unshifted/shifted diagonal values and
+    dD = d0 - d1, every candidate collapses to
+
+        score(n, k) = t1(n) + G[kappa(k), n],   G = prefix_i(dD)
+
+    where kappa(k) = k for k in 1..len2-1 and kappa(0) = len2 (hyphen after
+    end == take the full unshifted prefix; dD rows past len2 are zero, so
+    G[len2] = t0 - t1 exactly).  The per-offset suffix term t1(n) is common
+    to all k, so the inner argmax over k needs only G — one [L2P, NOFF]
+    max/argmax instead of materialising the full score matrix, and the
+    valid kappa range is simply rows 1..len2.
+    """
     l2p = seq2row.shape[0]
     i = jnp.arange(l2p, dtype=jnp.int32)
 
@@ -75,30 +115,28 @@ def _score_pair_mm(a_right, len1, seq2row, len2, noff):
     )  # [L2P, W]
 
     d = _shear(v)  # [L2P, W+1]
-    d0 = d[:, :noff]  # D0[i, n] = V[i, i+n]
-    d1 = d[:, 1 : noff + 1]  # D1[i, n] = V[i, i+n+1]
-    c0 = jnp.cumsum(d0, axis=0)
-    c1 = jnp.cumsum(d1, axis=0)
-    t0 = c0[-1, :]  # full unshifted sum per offset (k=0 candidate)
-    t1 = c1[-1, :]
+    d0 = d[:, :noff]
+    d1 = d[:, 1 : noff + 1]
+    t1 = jnp.sum(d1, axis=0)  # [NOFF] shifted totals
+    g = _block_prefix(d0 - d1)  # [L2P, NOFF]; row r = kappa (r+1)
 
-    # Row k holds mutant k: k=0 -> t0; k>=1 -> prefix0(k) + shifted suffix1(k).
-    s = jnp.concatenate(
-        [t0[None, :], c0[:-1, :] + (t1[None, :] - c1[:-1, :])], axis=0
-    )  # [L2P, NOFF]
+    # Valid kappa = 1..len2  <=>  rows 0..len2-1.
+    gm = jnp.where((i < len2)[:, None], g, _NEG)
+    run_max = jnp.max(gm, axis=0)  # [NOFF]
+    run_row = jnp.argmax(gm, axis=0).astype(jnp.int32)  # first row hitting max
+    end_g = g[jnp.maximum(len2 - 1, 0), :]  # G at kappa = len2 (k=0's cell)
 
-    k = jnp.arange(l2p, dtype=jnp.int32)[:, None]
-    n = jnp.arange(noff, dtype=jnp.int32)[None, :]
-    valid = (n < jnp.maximum(len1 - len2, 0)) & ((k == 0) | (k < len2))
-    s = jnp.where(valid, s, _NEG)
+    # k=0 outranks equal-scoring k>=1 in the reference's candidate order.
+    best_k_per_n = jnp.where(end_g == run_max, 0, run_row + 1)
+    score_per_n = t1 + run_max
 
-    per_n_max = jnp.max(s, axis=0)  # [NOFF]
-    best_n = jnp.argmax(per_n_max).astype(jnp.int32)  # first max -> smallest n
-    best = per_n_max[best_n]
-    col = s[:, best_n]
-    best_k = jnp.argmax(col == best).astype(jnp.int32)  # first k achieving it
+    n = jnp.arange(noff, dtype=jnp.int32)
+    score_per_n = jnp.where(n < jnp.maximum(len1 - len2, 0), score_per_n, _NEG)
+    best_n = jnp.argmax(score_per_n).astype(jnp.int32)  # first max: smallest n
+    best = score_per_n[best_n]
+    best_k = best_k_per_n[best_n]
 
-    eq_score = c0[-1, 0]  # positional score at n=0 (branch-A analogue)
+    eq_score = t1[0] + end_g[0]  # == t0[0]: positional score at n=0
     searchable = (len2 < len1) & (len2 > 0)
     score_f = jnp.where(len2 == len1, eq_score, best)
     score = jnp.where(

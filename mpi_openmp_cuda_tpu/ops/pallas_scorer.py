@@ -1,0 +1,277 @@
+"""Fused Pallas TPU kernel for the alignment search (reference C13+C14,
+re-designed TPU-first).
+
+The XLA matmul path materialises the pair-value matrix V, its sheared
+diagonals and their prefix sums in HBM (~4 full [L2P, W] arrays per pair);
+profiling shows those HBM round-trips dominate.  This kernel fuses the whole
+delta-formulation pipeline so V never leaves VMEM:
+
+  per pair (grid cell), per (offset-block nb, char-block ib) 128x128 tile:
+    onehot(seq2 block)            [128, 128]   broadcast compare, VPU
+    V tile = onehot @ A band      [128, 256]   MXU (A = val @ onehot(seq1).T,
+                                               rows padded 27 -> 128)
+    shear row r left by r         7x (roll + select), VPU  (the pad/reshape
+                                               trick is not lowerable in
+                                               Mosaic; log2(128) uniform
+                                               rolls implement the per-row
+                                               shift instead)
+    dD = d0 - d1; block prefix    ltri128 @ dD on the MXU
+    streaming carries             prefix carry, running (max, first-kappa),
+                                  G[len2] capture, t1 totals — all lane
+                                  vectors in registers
+
+  outputs per pair: per-offset best score, best k, and the k=0 score
+  (t1 + G[len2]); the tiny [B, NOFF] argmax/masking epilogue runs in XLA.
+
+Tie-break parity with the reference's offset-major, k-ascending-with-0-first
+order (cudaFunctions.cu:161) is preserved: strictly-greater running updates
+keep the smallest kappa, first-hit row selection uses a min-index reduction,
+and k=0 (kappa = len2) outranks equal-scoring k >= 1 via the G[len2]
+capture.  Float32 math is exact for |weight| <= 4095 (same bound as the
+matmul path); the module transparently falls back to the XLA bodies for
+larger weights or for shape buckets that are not 128-aligned (e.g. the
+tiny-shape multi-chip dryrun).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ..utils.constants import ALPHABET_SIZE, INT32_MIN
+
+_BLK = 128
+# Plain Python scalars: jnp scalars would be captured as pallas kernel
+# constants, which pallas_call rejects.
+_NEG = -(2.0**40)
+_BIGROW = 1 << 30
+
+
+def _kernel(len2_ref, codes_ref, a_ref, score_ref, k_ref, k0_ref, *, nbn, nbi):
+    """One grid cell scores one pair across all offset blocks."""
+    l2 = len2_ref[pl.program_id(0)]  # scalar-prefetch SMEM array, whole
+    a = a_ref[:]  # [128, Wneed] f32, rows >= 27 are zero
+
+    ri = lax.broadcasted_iota(jnp.int32, (_BLK, 2 * _BLK), 0)
+    ri1 = lax.broadcasted_iota(jnp.int32, (_BLK, _BLK), 0)
+    ci1 = lax.broadcasted_iota(jnp.int32, (_BLK, _BLK), 1)
+    ltri = (ri1 >= ci1).astype(jnp.float32)
+
+    for nb in range(nbn):
+        n0 = nb * _BLK
+
+        def ibody(ib, car):
+            # Char-blocks wholly past len2 contribute nothing (masked rows,
+            # zero deltas, no captures): skip their compute entirely.
+            return lax.cond(ib * _BLK < l2, _ibody, lambda _, c: c, ib, car)
+
+        def _ibody(ib, car):
+            carry, runmax, runkap, endg, t1 = car
+            i0 = ib * _BLK
+            codes = codes_ref[0, ib, :, :]  # [128, 1] int32, sublane-oriented
+            oh = (codes == ci1).astype(jnp.float32)  # [128, 128]
+            aband = a_ref[:, pl.ds(n0 + i0, 2 * _BLK)]
+            vp = jnp.dot(oh, aband, preferred_element_type=jnp.float32)
+            vp = jnp.where(ri < l2 - i0, vp, 0.0)  # mask chars past len2
+            # Shear: roll row r left by r, one bit at a time.
+            for b in range(7):
+                amt = 1 << b
+                rolled = pltpu.roll(vp, shift=2 * _BLK - amt, axis=1)
+                vp = jnp.where((ri & amt) != 0, rolled, vp)
+            d0 = vp[:, :_BLK]
+            d1 = vp[:, 1 : _BLK + 1]
+            dd = d0 - d1
+            lp = jnp.dot(ltri, dd, preferred_element_type=jnp.float32)
+            g = lp + carry[None, :]
+            valid_row = ri1 < l2 - i0  # kappa = i0+r+1 in 1..len2
+            gm = jnp.where(valid_row, g, _NEG)
+            bmax = jnp.max(gm, axis=0)  # [128]
+            brow = jnp.min(
+                jnp.where(gm == bmax[None, :], ri1, _BIGROW), axis=0
+            )
+            upd = bmax > runmax
+            runmax = jnp.where(upd, bmax, runmax)
+            runkap = jnp.where(upd, i0 + brow + 1, runkap)
+            endg = endg + jnp.sum(
+                jnp.where(ri1 == l2 - 1 - i0, g, 0.0), axis=0
+            )
+            t1 = t1 + jnp.sum(d1, axis=0)
+            carry = carry + lp[_BLK - 1, :]
+            return carry, runmax, runkap, endg, t1
+
+        zeros = jnp.zeros((_BLK,), jnp.float32)
+        init = (
+            zeros,
+            jnp.full((_BLK,), _NEG),
+            jnp.zeros((_BLK,), jnp.int32),
+            zeros,
+            zeros,
+        )
+        carry, runmax, runkap, endg, t1 = lax.fori_loop(0, nbi, ibody, init)
+
+        sl = (0, 0, pl.ds(n0, _BLK))
+        score_ref[sl] = t1 + runmax
+        k_ref[sl] = jnp.where(endg == runmax, 0, runkap)  # k=0 wins ties
+        k0_ref[sl] = t1 + endg
+
+
+@functools.lru_cache(maxsize=32)
+def _pallas_call(nbn: int, nbi: int, wneed: int, b: int, interpret: bool):
+    kernel = functools.partial(_kernel, nbn=nbn, nbi=nbi)
+    w = nbn * _BLK
+    return pl.pallas_call(
+        kernel,
+        interpret=interpret,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,  # lens [B] int32, whole array in SMEM
+            grid=(b,),
+            in_specs=[
+                pl.BlockSpec((1, nbi, _BLK, 1), lambda p, lens: (p, 0, 0, 0)),
+                pl.BlockSpec((_BLK, wneed), lambda p, lens: (0, 0)),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, 1, w), lambda p, lens: (p, 0, 0)),
+                pl.BlockSpec((1, 1, w), lambda p, lens: (p, 0, 0)),
+                pl.BlockSpec((1, 1, w), lambda p, lens: (p, 0, 0)),
+            ],
+        ),
+        out_shape=[
+            jax.ShapeDtypeStruct((b, 1, w), jnp.float32),
+            jax.ShapeDtypeStruct((b, 1, w), jnp.int32),
+            jax.ShapeDtypeStruct((b, 1, w), jnp.float32),
+        ],
+    )
+
+
+def _pallas_rows(seq1ext, len1, rows, lens, val_flat):
+    """Score a [B, L2P] padded batch with the fused kernel; returns [B, 3]."""
+    b, l2p = rows.shape
+    w = seq1ext.shape[0] - l2p - 1  # == L1P (offset-axis extent)
+    nbn, nbi = w // _BLK, l2p // _BLK
+    wneed = w + l2p  # A columns reachable by n0 + i0 + 255
+
+    val27 = val_flat.reshape(ALPHABET_SIZE, ALPHABET_SIZE).astype(jnp.float32)
+    oh1 = (
+        seq1ext[:wneed, None].astype(jnp.int32)
+        == jnp.arange(ALPHABET_SIZE, dtype=jnp.int32)[None, :]
+    ).astype(jnp.float32)
+    a_small = lax.dot_general(
+        val27, oh1, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    )  # [27, Wneed]
+    a_ext = jnp.zeros((_BLK, wneed), jnp.float32).at[:ALPHABET_SIZE].set(a_small)
+
+    codes = rows.astype(jnp.int32).reshape(b, nbi, _BLK, 1)
+
+    # Off-TPU (the 8-virtual-device CPU test mesh) the Mosaic kernel cannot
+    # lower; interpret mode runs the same kernel semantics for parity tests.
+    interpret = jax.default_backend() != "tpu"
+    score_n, k_n, k0_n = _pallas_call(nbn, nbi, wneed, b, interpret)(
+        lens.astype(jnp.int32), codes, a_ext
+    )
+    score_n, k_n, k0_n = score_n[:, 0, :], k_n[:, 0, :], k0_n[:, 0, :]
+
+    # Tiny [B, NOFF] epilogue in XLA: offset validity, first-max argmax,
+    # equal-length / unsearchable selection.
+    n = jnp.arange(w, dtype=jnp.int32)[None, :]
+    score_n = jnp.where(n < jnp.maximum(len1 - lens, 0)[:, None], score_n, _NEG)
+    bn = jnp.argmax(score_n, axis=1).astype(jnp.int32)
+    best = jnp.take_along_axis(score_n, bn[:, None], axis=1)[:, 0]
+    bk = jnp.take_along_axis(k_n, bn[:, None], axis=1)[:, 0]
+    eq = k0_n[:, 0]  # t1 + G[len2] at n=0 == positional score
+
+    searchable = (lens < len1) & (lens > 0)
+    score_f = jnp.where(lens == len1, eq, best)
+    score = jnp.where(
+        searchable | (lens == len1),
+        score_f.astype(jnp.int32),
+        jnp.int32(INT32_MIN),
+    )
+    out_n = jnp.where(searchable, bn, 0)
+    out_k = jnp.where(searchable, bk, 0)
+    return jnp.stack([score, out_n, out_k], axis=1)
+
+
+def _shapes_supported(l1p: int, l2p: int) -> bool:
+    return l1p % _BLK == 0 and l2p % _BLK == 0
+
+
+def score_chunks_pallas_body(seq1ext, len1, seq2_chunks, len2_chunks, val_flat):
+    """Chunked-batch entry, same contract as the XLA bodies:
+    [NC, CB, L2P] -> [NC, CB, 3].  Falls back to the XLA matmul body for
+    non-128-aligned shape buckets (tiny problems)."""
+    nc, cb, l2p = seq2_chunks.shape
+    l1p = seq1ext.shape[0] - l2p - 1
+    if not _shapes_supported(l1p, l2p):
+        from .matmul_scorer import score_chunks_mm_body
+
+        return score_chunks_mm_body(
+            seq1ext, len1, seq2_chunks, len2_chunks, val_flat
+        )
+    out = _pallas_rows(
+        seq1ext,
+        len1,
+        seq2_chunks.reshape(nc * cb, l2p),
+        len2_chunks.reshape(nc * cb),
+        val_flat,
+    )
+    return out.reshape(nc, cb, 3)
+
+
+score_chunks_pallas = jax.jit(score_chunks_pallas_body)
+
+
+@functools.lru_cache(maxsize=32)
+def pallas_pair_scorer(l1p: int, l2p: int):
+    """Per-shard callable for the shard_map path: (seq1ext, len1,
+    rows [BL, L2P], lens [BL], val_flat) -> [BL, 3].  Cached by shape
+    bucket so the shard_map jit cache stays hot."""
+
+    def fn(seq1ext, len1, rows, lens, val_flat):
+        if not _shapes_supported(l1p, l2p):
+            from .matmul_scorer import score_chunks_mm_body
+
+            bl = rows.shape[0]
+            return score_chunks_mm_body(
+                seq1ext,
+                len1,
+                rows.reshape(bl, 1, l2p).transpose(1, 0, 2),
+                lens.reshape(1, bl),
+                val_flat,
+            ).reshape(bl, 3)
+        return _pallas_rows(seq1ext, len1, rows, lens, val_flat)
+
+    return fn
+
+
+def score_batch_pallas(batch, val_flat):
+    """PaddedBatch entry used by ops.dispatch; returns [B, 3] (device)."""
+    from .dispatch import mm_formulation_exact
+
+    if not mm_formulation_exact(val_flat):
+        # Same float32 bound as the matmul path; route to exact int32 XLA.
+        from .dispatch import pad_batch_rows
+        from .xla_scorer import score_chunks
+
+        rows, lens = pad_batch_rows(batch, batch.batch_size)
+        return score_chunks(
+            jnp.asarray(batch.seq1ext),
+            jnp.int32(batch.len1),
+            jnp.asarray(rows.reshape(1, batch.batch_size, batch.l2p)),
+            jnp.asarray(lens.reshape(1, batch.batch_size)),
+            jnp.asarray(val_flat),
+        ).reshape(batch.batch_size, 3)
+    from .dispatch import pad_batch_rows
+
+    rows, lens = pad_batch_rows(batch, batch.batch_size)
+    return score_chunks_pallas(
+        jnp.asarray(batch.seq1ext),
+        jnp.int32(batch.len1),
+        jnp.asarray(rows.reshape(1, batch.batch_size, batch.l2p)),
+        jnp.asarray(lens.reshape(1, batch.batch_size)),
+        jnp.asarray(val_flat),
+    ).reshape(batch.batch_size, 3)

@@ -79,6 +79,8 @@ class BatchSharding:
         """Returns [B, 3] int32 host array, input order."""
         import jax.numpy as jnp
 
+        from ..ops.dispatch import mm_formulation_exact, xla_formulation_mode
+
         if backend == "pallas":
             # Import check up front for a friendly error; the cached
             # shard_map factory re-imports by shape key (stable identity).
@@ -88,10 +90,12 @@ class BatchSharding:
                 raise RuntimeError(
                     "backend 'pallas' is not available in this build"
                 ) from e
-            mode = ("pallas", batch.l1p, batch.l2p)
+            if mm_formulation_exact(val_flat):
+                mode = ("pallas", batch.l1p, batch.l2p)
+            else:
+                # Same float32 bound as the matmul path: route to int32.
+                mode = ("gather",)
         else:
-            from ..ops.dispatch import xla_formulation_mode
-
             mode = (xla_formulation_mode(backend, val_flat),)
 
         d = self.n_devices
@@ -161,5 +165,9 @@ def _sharded_fn(mesh, cb, mode: tuple):
             mesh=mesh,
             in_specs=(P(), P(), P(BATCH_AXIS), P(BATCH_AXIS), P()),
             out_specs=P(BATCH_AXIS),
+            # pallas_call out_shapes carry no varying-mesh-axes metadata, so
+            # the vma check must be off for the pallas mode only — the XLA
+            # modes keep the trace-time sharding safety net.
+            check_vma=(mode[0] != "pallas"),
         )
     )

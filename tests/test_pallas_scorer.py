@@ -1,0 +1,111 @@
+"""Pallas kernel tests (interpret mode on the CPU harness; the same kernel
+lowers to Mosaic on real TPUs).  Parity against the numpy oracle and the XLA
+paths, including the tie-break and fallback behaviours."""
+
+import numpy as np
+import pytest
+
+from mpi_openmp_cuda_tpu.models.encoding import encode
+from mpi_openmp_cuda_tpu.ops.dispatch import AlignmentScorer
+from mpi_openmp_cuda_tpu.ops.oracle import prefix_best
+from mpi_openmp_cuda_tpu.utils.constants import INT32_MIN
+
+W = [10, 2, 3, 4]
+
+
+def _score(seq1, seqs, weights):
+    return AlignmentScorer("pallas").score_codes(seq1, seqs, weights)
+
+
+@pytest.mark.parametrize("seed", range(3))
+def test_pallas_matches_oracle_random(seed):
+    rng = np.random.default_rng(seed)
+    l1 = int(rng.integers(100, 250))
+    seq1 = rng.integers(1, 27, size=l1).astype(np.int8)
+    seqs = [
+        rng.integers(1, 27, size=int(rng.integers(1, l1 + 2))).astype(np.int8)
+        for _ in range(5)
+    ]
+    got = _score(seq1, seqs, W)
+    want = [prefix_best(seq1, s, W) for s in seqs]
+    assert [tuple(int(x) for x in row) for row in got] == want
+
+
+def test_pallas_tie_break_low_entropy():
+    rng = np.random.default_rng(5)
+    seq1 = rng.integers(1, 3, size=140).astype(np.int8)
+    seqs = [rng.integers(1, 3, size=int(rng.integers(1, 120))) for _ in range(6)]
+    weights = [5, 1, 1, 1]
+    got = _score(seq1, seqs, weights)
+    want = [prefix_best(seq1, s, weights) for s in seqs]
+    assert [tuple(int(x) for x in row) for row in got] == want
+
+
+def test_pallas_k0_and_edge_rows():
+    seq1 = encode("ABCD" * 40)  # 160 chars
+    seqs = [
+        encode("ABCD" * 40),  # equal length
+        encode("ABCD" * 40 + "X"),  # longer -> sentinel
+        encode("ABC"),  # k=0 optimum (exact prefix match)
+        encode("A"),
+    ]
+    got = _score(seq1, seqs, W)
+    assert tuple(got[0]) == (160 * W[0], 0, 0)
+    assert tuple(got[1]) == (INT32_MIN, 0, 0)
+    for row, s in zip(got[2:], seqs[2:]):
+        assert tuple(int(x) for x in row) == prefix_best(seq1, s, W)
+
+
+def test_pallas_matches_xla_backends():
+    rng = np.random.default_rng(11)
+    seq1 = rng.integers(1, 27, size=300).astype(np.int8)
+    seqs = [
+        rng.integers(1, 27, size=int(rng.integers(1, 290))).astype(np.int8)
+        for _ in range(7)
+    ]
+    pall = _score(seq1, seqs, W)
+    mm = AlignmentScorer("xla").score_codes(seq1, seqs, W)
+    gather = AlignmentScorer("xla-gather").score_codes(seq1, seqs, W)
+    assert (pall == mm).all() and (pall == gather).all()
+
+
+def test_pallas_huge_weights_fall_back_exact():
+    rng = np.random.default_rng(2)
+    seq1 = rng.integers(1, 27, size=150).astype(np.int8)
+    seqs = [rng.integers(1, 27, size=40).astype(np.int8) for _ in range(3)]
+    weights = [100000, 50000, 3, 4]  # beyond float32 exactness
+    got = _score(seq1, seqs, weights)
+    want = [prefix_best(seq1, s, weights) for s in seqs]
+    assert [tuple(int(x) for x in row) for row in got] == want
+
+
+def test_pallas_sharded_huge_weights_exact():
+    # The sharded pallas route must apply the same float32-exactness
+    # fallback as the local path (regression: it silently skipped it).
+    from mpi_openmp_cuda_tpu.parallel.sharding import BatchSharding
+
+    rng = np.random.default_rng(31)
+    seq1 = rng.integers(1, 27, size=150).astype(np.int8)
+    seqs = [rng.integers(1, 27, size=40).astype(np.int8) for _ in range(5)]
+    weights = [100000, 50000, 3, 4]
+    got = AlignmentScorer(
+        "pallas", sharding=BatchSharding.over_devices(8)
+    ).score_codes(seq1, seqs, weights)
+    want = [prefix_best(seq1, s, weights) for s in seqs]
+    assert [tuple(int(x) for x in row) for row in got] == want
+
+
+def test_pallas_sharded_matches_local():
+    from mpi_openmp_cuda_tpu.parallel.sharding import BatchSharding
+
+    rng = np.random.default_rng(21)
+    seq1 = rng.integers(1, 27, size=200).astype(np.int8)
+    seqs = [
+        rng.integers(1, 27, size=int(rng.integers(1, 190))).astype(np.int8)
+        for _ in range(9)
+    ]
+    local = _score(seq1, seqs, W)
+    shard = AlignmentScorer(
+        "pallas", sharding=BatchSharding.over_devices(8)
+    ).score_codes(seq1, seqs, W)
+    assert (local == shard).all()
