@@ -1,0 +1,160 @@
+"""Per-sequence result journal: checkpoint / resume (SURVEY §5).
+
+The reference has no checkpointing — it is a stateless single-shot batch run
+(stdin → stdout) whose failure model is fail-stop (`cudaFunctions.cu:15-33`).
+SURVEY §5 names the upgrade worth building: a per-sequence result journal so
+a preempted batch resumes at the first unscored sequence instead of
+recomputing everything.
+
+Format: JSON-lines.  Line 1 is a header carrying a fingerprint of the
+problem (weights + Seq1 + the Seq2 batch); every later line is one scored
+result ``{"index": i, "score": S, "n": N, "k": K}``.  A journal whose
+fingerprint does not match the current problem is rejected (fail-stop, not
+silent corruption).  Appends are flushed + fsync'd per chunk so a kill at
+any point loses at most the in-flight chunk.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+
+import numpy as np
+
+_FORMAT = "mpi_openmp_cuda_tpu.journal.v1"
+
+# Sequences scored per journal append.  Small enough that a preemption
+# loses little work; large enough to amortise dispatch overhead.
+DEFAULT_CHUNK = 64
+
+
+class JournalMismatchError(RuntimeError):
+    """Journal on disk belongs to a different problem (or is corrupt)."""
+
+
+def problem_fingerprint(problem) -> str:
+    """Stable content hash of (weights, seq1, seq2 batch)."""
+    h = hashlib.sha256()
+    h.update(json.dumps([int(w) for w in problem.weights]).encode())
+    h.update(problem.seq1_codes.tobytes())
+    h.update(np.int64(len(problem.seq2_codes)).tobytes())
+    for codes in problem.seq2_codes:
+        h.update(np.int64(codes.size).tobytes())
+        h.update(codes.tobytes())
+    return h.hexdigest()
+
+
+class ResultJournal:
+    """Journalled scoring: skip already-scored sequences on restart."""
+
+    def __init__(self, path: str, chunk: int = DEFAULT_CHUNK):
+        self.path = path
+        self.chunk = max(1, int(chunk))
+
+    # -- on-disk state -----------------------------------------------------
+    def _read(self, fingerprint: str) -> dict[int, tuple[int, int, int]]:
+        """Load completed entries; reject foreign or malformed journals."""
+        if not os.path.exists(self.path):
+            return {}
+        done: dict[int, tuple[int, int, int]] = {}
+        with open(self.path, "r", encoding="utf-8") as f:
+            header_line = f.readline()
+            if not header_line.strip():
+                return {}
+            try:
+                header = json.loads(header_line)
+            except json.JSONDecodeError as e:
+                raise JournalMismatchError(
+                    f"journal {self.path!r}: unreadable header: {e}"
+                ) from e
+            if header.get("format") != _FORMAT:
+                raise JournalMismatchError(
+                    f"journal {self.path!r}: not a {_FORMAT} file"
+                )
+            if header.get("fingerprint") != fingerprint:
+                raise JournalMismatchError(
+                    f"journal {self.path!r} was written for a different problem; "
+                    "delete it (or pass a fresh --journal path) to rescore"
+                )
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                    done[int(rec["index"])] = (
+                        int(rec["score"]),
+                        int(rec["n"]),
+                        int(rec["k"]),
+                    )
+                except (json.JSONDecodeError, KeyError, ValueError):
+                    # A torn final line from a mid-write kill is expected;
+                    # that sequence simply gets rescored.
+                    continue
+        return done
+
+    def _append(self, f, indices, rows) -> None:
+        for i, (score, n, k) in zip(indices, rows):
+            f.write(
+                json.dumps(
+                    {"index": int(i), "score": int(score), "n": int(n), "k": int(k)}
+                )
+                + "\n"
+            )
+        f.flush()
+        os.fsync(f.fileno())
+
+    # -- the resumable scoring loop ---------------------------------------
+    def score_with_resume(self, scorer, problem) -> np.ndarray:
+        """Score ``problem``, journalling per chunk; returns [B, 3] int32."""
+        fingerprint = problem_fingerprint(problem)
+        done = self._read(fingerprint)
+        total = len(problem.seq2_codes)
+        pending = [i for i in range(total) if i not in done]
+
+        results = np.zeros((total, 3), dtype=np.int32)
+        for i, row in done.items():
+            if i < total:
+                results[i] = row
+
+        fresh = not os.path.exists(self.path) or not done
+        mode = "w" if fresh else "a"
+        if not fresh:
+            # A kill mid-write can leave a torn final line with no trailing
+            # newline; appending straight onto it would glue the next record
+            # to the fragment and lose it on the following resume.
+            with open(self.path, "rb") as rf:
+                rf.seek(0, os.SEEK_END)
+                if rf.tell() > 0:
+                    rf.seek(-1, os.SEEK_END)
+                    needs_newline = rf.read(1) != b"\n"
+                else:
+                    needs_newline = False
+        with open(self.path, mode, encoding="utf-8") as f:
+            if not fresh and needs_newline:
+                f.write("\n")
+            if fresh:
+                f.write(
+                    json.dumps(
+                        {
+                            "format": _FORMAT,
+                            "fingerprint": fingerprint,
+                            "num_seq2": total,
+                        }
+                    )
+                    + "\n"
+                )
+                f.flush()
+                os.fsync(f.fileno())
+            for start in range(0, len(pending), self.chunk):
+                idx = pending[start : start + self.chunk]
+                rows = scorer.score_codes(
+                    problem.seq1_codes,
+                    [problem.seq2_codes[i] for i in idx],
+                    problem.weights,
+                )
+                for i, row in zip(idx, rows):
+                    results[i] = row
+                self._append(f, idx, rows)
+        return results

@@ -1,0 +1,118 @@
+"""Checkpoint/resume journal tests (SURVEY §5: per-sequence result journal)."""
+
+import json
+
+import numpy as np
+import pytest
+
+from mpi_openmp_cuda_tpu.io.parse import parse_problem
+from mpi_openmp_cuda_tpu.ops.dispatch import AlignmentScorer
+from mpi_openmp_cuda_tpu.utils.journal import (
+    JournalMismatchError,
+    ResultJournal,
+    problem_fingerprint,
+)
+
+import io
+
+
+def _problem(n=7, seed=0):
+    rng = np.random.default_rng(seed)
+    seq1 = "".join(chr(ord("A") + int(c)) for c in rng.integers(0, 26, size=40))
+    seqs = [
+        "".join(chr(ord("A") + int(c)) for c in rng.integers(0, 26, size=int(l)))
+        for l in rng.integers(3, 20, size=n)
+    ]
+    text = f"10 2 3 4\n{seq1}\n{n}\n" + "\n".join(seqs) + "\n"
+    return parse_problem(io.StringIO(text))
+
+
+class CountingScorer(AlignmentScorer):
+    def __init__(self, **kw):
+        super().__init__(backend="oracle", **kw)
+        self.calls = []
+
+    def score_codes(self, seq1_codes, seq2_codes, weights):
+        self.calls.append(len(seq2_codes))
+        return super().score_codes(seq1_codes, seq2_codes, weights)
+
+
+def test_journal_roundtrip_and_skip(tmp_path):
+    problem = _problem()
+    path = str(tmp_path / "j.jsonl")
+    scorer = CountingScorer()
+    journal = ResultJournal(path, chunk=3)
+    first = journal.score_with_resume(scorer, problem)
+    want = AlignmentScorer(backend="oracle").score_codes(
+        problem.seq1_codes, problem.seq2_codes, problem.weights
+    )
+    np.testing.assert_array_equal(first, want)
+    assert sum(scorer.calls) == problem.num_seq2
+
+    # Second run: everything journalled, scorer must not be called at all.
+    scorer2 = CountingScorer()
+    second = ResultJournal(path, chunk=3).score_with_resume(scorer2, problem)
+    np.testing.assert_array_equal(second, want)
+    assert scorer2.calls == []
+
+
+def test_journal_resumes_partial(tmp_path):
+    problem = _problem()
+    path = str(tmp_path / "j.jsonl")
+    want = AlignmentScorer(backend="oracle").score_codes(
+        problem.seq1_codes, problem.seq2_codes, problem.weights
+    )
+    # Hand-write a partial journal: header + first two results + a torn line
+    # (the shape a preemption mid-append leaves behind).
+    with open(path, "w") as f:
+        f.write(
+            json.dumps(
+                {
+                    "format": "mpi_openmp_cuda_tpu.journal.v1",
+                    "fingerprint": problem_fingerprint(problem),
+                    "num_seq2": problem.num_seq2,
+                }
+            )
+            + "\n"
+        )
+        for i in range(2):
+            s, n, k = (int(x) for x in want[i])
+            f.write(json.dumps({"index": i, "score": s, "n": n, "k": k}) + "\n")
+        f.write('{"index": 2, "scor')  # torn write
+
+    scorer = CountingScorer()
+    out = ResultJournal(path, chunk=100).score_with_resume(scorer, problem)
+    np.testing.assert_array_equal(out, want)
+    # Only the unjournalled tail (indices 2..) was rescored.
+    assert sum(scorer.calls) == problem.num_seq2 - 2
+
+    # The resume must not have glued its first record onto the torn line:
+    # a third run sees a fully intact journal and rescores nothing.
+    scorer3 = CountingScorer()
+    out3 = ResultJournal(path, chunk=100).score_with_resume(scorer3, problem)
+    np.testing.assert_array_equal(out3, want)
+    assert scorer3.calls == []
+
+
+def test_journal_rejects_foreign_problem(tmp_path):
+    path = str(tmp_path / "j.jsonl")
+    ResultJournal(path).score_with_resume(CountingScorer(), _problem(seed=0))
+    with pytest.raises(JournalMismatchError):
+        ResultJournal(path).score_with_resume(CountingScorer(), _problem(seed=1))
+
+
+def test_cli_journal_flag(tmp_path, capsys):
+    """--journal end-to-end through the CLI, including a resume run."""
+    from mpi_openmp_cuda_tpu.io.cli import run
+
+    problem_text = "10 2 3 4\nAPQRSBATAV\n1\nASQREAVSL\n"
+    inp = tmp_path / "in.txt"
+    inp.write_text(problem_text)
+    jpath = str(tmp_path / "journal.jsonl")
+    for _ in range(2):  # second run resumes from the complete journal
+        rc = run(
+            ["--input", str(inp), "--backend", "oracle", "--journal", jpath]
+        )
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert out == "#0: score: 27, n: 0, k: 5\n"
