@@ -1,0 +1,52 @@
+# TPU-native analogue of the reference build/deploy makefile (makefile:1-15).
+#
+# Reference targets -> TPU equivalents:
+#   build   mpicxx+nvcc link          ->  g++ driver + embedded-CPython backend
+#   run     mpiexec -np 2 ./final     ->  ./final (backend shards via
+#                                         TPU_SEQALIGN_MESH instead of ranks)
+#   runOn2  mpiexec 2 machines        ->  multi-host JAX (python -m ... --distributed)
+#   clean                             ->  clean
+#
+# The Python package itself needs no build step; `final` is the native
+# host-driver path (SURVEY §7.3 step 6).
+
+PYTHON     ?= python3
+PYCONFIG   ?= $(PYTHON)-config
+CXX        ?= g++
+CXXFLAGS   ?= -O2 -std=c++17 -Wall -Wextra
+PY_CFLAGS  := $(shell $(PYCONFIG) --includes)
+PY_LDFLAGS := $(shell $(PYCONFIG) --ldflags --embed)
+INPUT      ?= /root/reference/input5.txt
+
+.PHONY: build run run2 runOn2 test bench clean
+
+build: final
+
+final: native/main.cpp native/tpu_backend.cpp native/tpu_proto.h
+	$(CXX) $(CXXFLAGS) -DTPU_SEQALIGN_REPO_ROOT='"$(CURDIR)"' \
+	    native/main.cpp native/tpu_backend.cpp -o $@ \
+	    $(PY_CFLAGS) $(PY_LDFLAGS) -lpthread
+
+# Single host; all local devices. The reference's `run` is 2 ranks on one
+# node (makefile:11) — the mesh analogue is run2.
+run: final
+	./final < $(INPUT)
+
+run2: final
+	TPU_SEQALIGN_MESH=2 ./final < $(INPUT)
+
+# Two-machine deployment (reference runOn2, makefile:15): every host runs
+# the same command; host 0 reads stdin.  Requires JAX_COORDINATOR_ADDRESS,
+# JAX_NUM_PROCESSES, JAX_PROCESS_ID in the environment (the machinefile's
+# replacement; parallel/distributed.py).
+runOn2:
+	$(PYTHON) -m mpi_openmp_cuda_tpu --distributed < $(INPUT)
+
+test:
+	$(PYTHON) -m pytest tests/ -q
+
+bench:
+	$(PYTHON) bench.py
+
+clean:
+	rm -f final

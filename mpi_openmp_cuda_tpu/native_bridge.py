@@ -1,0 +1,100 @@
+"""Python side of the native host-ABI shim (SURVEY §7.3 step 6, component C2).
+
+The reference's only host↔device interface is the 4-function C ABI in
+`myProto.h:7-10`; its CUDA side stages read-only state in `__constant__`
+memory (`cudaFunctions.cu:35-61`) and scores a fixed-stride batch of
+NUL-terminated records (`cudaFunctions.cu:178-242`).  The TPU build keeps
+that ABI as the stable native surface: `native/tpu_backend.cpp` embeds
+CPython and forwards one call per staged batch to :func:`score_strided`
+below, which decodes the wire format and dispatches to the JAX scorer.
+
+Wire format (chosen for a zero-dependency C side — plain bytes, no numpy
+C API, no pybind11):
+
+* sequences arrive as ASCII bytes (already uppercased by the C++ driver);
+* the batch is one ``rows × stride`` byte buffer, each record a
+  NUL-terminated C string (the reference's Scatter buffer layout,
+  main.c:110-121);
+* the two 27×27 0/1 membership matrices arrive as 729-byte blobs exactly
+  as the host built them (C4's `build_mat` output shape);
+* results return as ``rows × 3`` little-endian int32 ``(score, n, k)``
+  triples packed into one bytes object.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .models.encoding import encode
+from .ops.dispatch import AlignmentScorer
+from .ops.values import signed_weights
+from .utils.constants import ALPHABET_SIZE
+from .utils.platform import apply_platform_override
+
+
+def value_table_from_levels(mat1: np.ndarray, mat2: np.ndarray, weights) -> np.ndarray:
+    """[27, 27] signed pair-value table from host-built membership matrices.
+
+    Applies the kernel's precedence chain ($ > % > # > space,
+    cudaFunctions.cu:88-95): identity beats conservative beats
+    semi-conservative beats mismatch — regardless of what the matrices say
+    about the diagonal.
+    """
+    mat1 = np.asarray(mat1).reshape(ALPHABET_SIZE, ALPHABET_SIZE)
+    mat2 = np.asarray(mat2).reshape(ALPHABET_SIZE, ALPHABET_SIZE)
+    sw = signed_weights(weights)
+    val = np.full((ALPHABET_SIZE, ALPHABET_SIZE), sw[3], dtype=np.int32)
+    val[mat2 == 1] = sw[2]
+    val[mat1 == 1] = sw[1]
+    idx = np.arange(1, ALPHABET_SIZE)
+    val[idx, idx] = sw[0]
+    return val
+
+
+def _decode_record(record: bytes) -> np.ndarray:
+    """One fixed-stride record -> codes; C-string semantics (stop at NUL)."""
+    nul = record.find(b"\0")
+    if nul >= 0:
+        record = record[:nul]
+    return encode(record.decode("ascii"))
+
+
+def score_strided(
+    seq1: bytes,
+    seq2_all: bytes,
+    stride: int,
+    rows: int,
+    mat1: bytes,
+    mat2: bytes,
+    weights: tuple,
+    backend: str,
+    mesh: int,
+) -> bytes:
+    """Score a staged fixed-stride batch; returns rows*3 int32 as bytes.
+
+    ``mesh > 0`` shards the batch over that many devices (the MPI_Scatter
+    tier, dissolved into jax.sharding); ``mesh == 0`` runs single-device.
+    """
+    apply_platform_override()
+    if rows <= 0:
+        return b""
+    if stride <= 0 or len(seq2_all) < rows * stride:
+        raise ValueError(
+            f"batch buffer too small: {len(seq2_all)} bytes for "
+            f"{rows} rows x {stride} stride"
+        )
+    seq1_codes = encode(seq1.decode("ascii"))
+    seq2_codes = [
+        _decode_record(seq2_all[r * stride : (r + 1) * stride]) for r in range(rows)
+    ]
+    val = value_table_from_levels(
+        np.frombuffer(mat1, dtype=np.int8), np.frombuffer(mat2, dtype=np.int8), weights
+    )
+    sharding = None
+    if mesh > 0:
+        from .parallel.sharding import BatchSharding
+
+        sharding = BatchSharding.over_devices(mesh)
+    scorer = AlignmentScorer(backend=backend, sharding=sharding)
+    out = scorer.score_codes(seq1_codes, seq2_codes, list(weights), val_table=val)
+    return np.ascontiguousarray(out, dtype="<i4").tobytes()

@@ -17,7 +17,7 @@ from dataclasses import dataclass
 import numpy as np
 
 from ..models.encoding import encode_normalized, pad_to
-from ..utils.constants import BUF_SIZE_SEQ1, BUF_SIZE_SEQ2
+from ..utils.constants import ALPHABET_SIZE, BUF_SIZE_SEQ1, BUF_SIZE_SEQ2
 from .oracle import score_batch_oracle
 from .values import value_table
 
@@ -170,17 +170,42 @@ class AlignmentScorer:
 
     # -- code-level API ----------------------------------------------------
     def score_codes(
-        self, seq1_codes: np.ndarray, seq2_codes: list[np.ndarray], weights
+        self,
+        seq1_codes: np.ndarray,
+        seq2_codes: list[np.ndarray],
+        weights,
+        *,
+        val_table: np.ndarray | None = None,
     ) -> np.ndarray:
-        """Returns [B, 3] int32 array of (score, n, k) rows, input order."""
+        """Returns [B, 3] int32 array of (score, n, k) rows, input order.
+
+        ``val_table`` optionally overrides the spec-derived [27, 27] signed
+        pair-value table — the native host ABI stages its own matrices
+        (reference C2/C12 semantics: the host builds and uploads the lookup
+        state, the device scores with whatever it was given).
+        """
         if not seq2_codes:
             return np.zeros((0, 3), dtype=np.int32)
         if self.backend == "oracle":
+            if val_table is not None and not np.array_equal(
+                np.asarray(val_table, dtype=np.int32), value_table(weights)
+            ):
+                raise ValueError(
+                    "backend 'oracle' scores from the spec group tables; "
+                    "a custom val_table needs an accelerated backend"
+                )
             return np.array(
                 score_batch_oracle(seq1_codes, seq2_codes, weights), dtype=np.int32
             )
         batch = pad_problem(seq1_codes, seq2_codes)
-        val_flat = value_table(weights).astype(np.int32).reshape(-1)
+        if val_table is None:
+            val_flat = value_table(weights).astype(np.int32).reshape(-1)
+        else:
+            val_flat = np.asarray(val_table, dtype=np.int32).reshape(-1)
+            if val_flat.size != ALPHABET_SIZE * ALPHABET_SIZE:
+                raise ValueError(
+                    f"val_table must be [27, 27]; got {val_flat.size} elements"
+                )
         if self.sharding is not None:
             return self.sharding.score(
                 batch,

@@ -1,0 +1,145 @@
+/* TPU backend for the native host ABI (reference parity: C10-C14,
+ * cudaFunctions.cu:9-242 — redesigned, not translated).
+ *
+ * Where the reference stages state in CUDA `__constant__` memory and runs a
+ * serial per-sequence kernel-launch loop, this backend stages state in host
+ * memory and forwards the WHOLE batch in one call to the JAX/XLA/Pallas
+ * scorer through an embedded CPython interpreter
+ * (mpi_openmp_cuda_tpu.native_bridge.score_strided).  Marshalling is plain
+ * bytes both ways — no numpy C API, no pybind11 (not in this image).
+ *
+ * Fail-stop error handling mirrors checkStatus (cudaFunctions.cu:15-33):
+ * print a diagnostic, exit(1).  Python exceptions are printed with their
+ * traceback before exiting.
+ */
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <vector>
+
+#include "tpu_proto.h"
+
+#ifndef TPU_SEQALIGN_REPO_ROOT
+#define TPU_SEQALIGN_REPO_ROOT ""
+#endif
+
+namespace {
+
+constexpr int kMatCells = 27 * 27;
+
+/* Staged read-only state — the `__constant__`-memory analogue. */
+char g_mat1[kMatCells];
+char g_mat2[kMatCells];
+std::vector<char> g_seq1;
+int g_weights[4];
+bool g_have_mats = false, g_have_seq1 = false, g_have_weights = false;
+
+[[noreturn]] void die(const char *msg) {
+  /* Diagnostics to stderr (unlike the reference's stdout typo'd messages,
+   * SURVEY §5 observability): results stream stays clean. */
+  std::fprintf(stderr, "tpu_backend: error: %s\n", msg);
+  std::exit(1);
+}
+
+[[noreturn]] void die_py(const char *what) {
+  std::fprintf(stderr, "tpu_backend: error: %s\n", what);
+  if (PyErr_Occurred()) PyErr_Print();
+  std::exit(1);
+}
+
+void ensure_python() {
+  if (Py_IsInitialized()) return;
+  Py_Initialize();
+  std::atexit(tpu_backend_shutdown);
+  /* Make the package importable: explicit env override, then the
+   * compiled-in repo root, then the working directory. */
+  std::string code =
+      "import sys, os\n"
+      "for _p in (os.environ.get('TPU_SEQALIGN_PYROOT'), "
+      "r'" TPU_SEQALIGN_REPO_ROOT "' or None, os.getcwd()):\n"
+      "    if _p and _p not in sys.path:\n"
+      "        sys.path.insert(0, _p)\n";
+  if (PyRun_SimpleString(code.c_str()) != 0)
+    die_py("failed to set up sys.path for the bridge module");
+}
+
+int env_int(const char *name, int dflt) {
+  const char *v = std::getenv(name);
+  if (!v || !*v) return dflt;
+  return std::atoi(v);
+}
+
+}  // namespace
+
+extern "C" void send_mat_levels_cuda(char mat_level1[kMatCells],
+                                     char mat_level2[kMatCells], int size) {
+  if (size != kMatCells) die("send_mat_levels_cuda: size must be 27*27");
+  std::memcpy(g_mat1, mat_level1, kMatCells);
+  std::memcpy(g_mat2, mat_level2, kMatCells);
+  g_have_mats = true;
+}
+
+extern "C" void send_Seq1_To_Cuda(char *seq1, int seq1_size) {
+  if (seq1_size < 0 || seq1_size > BUF_SIZE_SEQ1)
+    die("send_Seq1_To_Cuda: seq1_size out of range");
+  g_seq1.assign(seq1, seq1 + seq1_size);
+  g_have_seq1 = true;
+}
+
+extern "C" void send_weights_cuda(int weights[4]) {
+  std::memcpy(g_weights, weights, sizeof(g_weights));
+  g_have_weights = true;
+}
+
+extern "C" void send_divided_Seq2_To_Cuda(char *seq2_divided, int seq2_size,
+                                          int num_rows_each_proc,
+                                          int *local_score, int *local_offset,
+                                          int *local_k) {
+  if (num_rows_each_proc <= 0) return;
+  if (!g_have_mats || !g_have_seq1 || !g_have_weights)
+    die(
+        "send_divided_Seq2_To_Cuda: stage matrices, seq1 and weights first "
+        "(ABI contract, myProto.h order)");
+  if (seq2_size <= 0 || seq2_size % num_rows_each_proc != 0)
+    die("send_divided_Seq2_To_Cuda: seq2_size must be rows * stride");
+  const int stride = seq2_size / num_rows_each_proc;
+
+  ensure_python();
+  const char *backend = std::getenv("TPU_SEQALIGN_BACKEND");
+  if (!backend || !*backend) backend = "xla";
+  const int mesh = env_int("TPU_SEQALIGN_MESH", 0);
+
+  PyObject *mod = PyImport_ImportModule("mpi_openmp_cuda_tpu.native_bridge");
+  if (!mod) die_py("cannot import mpi_openmp_cuda_tpu.native_bridge");
+  PyObject *res = PyObject_CallMethod(
+      mod, "score_strided", "(y#y#iiy#y#(iiii)si)", g_seq1.data(),
+      (Py_ssize_t)g_seq1.size(), seq2_divided, (Py_ssize_t)seq2_size, stride,
+      num_rows_each_proc, g_mat1, (Py_ssize_t)kMatCells, g_mat2,
+      (Py_ssize_t)kMatCells, g_weights[0], g_weights[1], g_weights[2],
+      g_weights[3], backend, mesh);
+  Py_DECREF(mod);
+  if (!res) die_py("score_strided raised");
+
+  char *buf = nullptr;
+  Py_ssize_t nbytes = 0;
+  if (PyBytes_AsStringAndSize(res, &buf, &nbytes) != 0)
+    die_py("score_strided returned a non-bytes result");
+  const Py_ssize_t want =
+      (Py_ssize_t)num_rows_each_proc * 3 * (Py_ssize_t)sizeof(int32_t);
+  if (nbytes != want) die("score_strided result has the wrong size");
+  const int32_t *vals = reinterpret_cast<const int32_t *>(buf);
+  for (int r = 0; r < num_rows_each_proc; ++r) {
+    local_score[r] = vals[3 * r + 0];
+    local_offset[r] = vals[3 * r + 1];
+    local_k[r] = vals[3 * r + 2];
+  }
+  Py_DECREF(res);
+}
+
+extern "C" void tpu_backend_shutdown(void) {
+  if (Py_IsInitialized()) Py_FinalizeEx();
+}

@@ -1,0 +1,62 @@
+/* TPU-native host offload ABI (reference parity: component C2, myProto.h:3-10).
+ *
+ * The reference program's ONLY interface between host orchestration and
+ * device compute is a 4-function C ABI (myProto.h:7-10); SURVEY §2.3 keeps
+ * it verbatim as the stable native surface so a driver structured like the
+ * reference's main.c runs unchanged on top of the TPU backend.
+ *
+ * Semantics (mirroring the CUDA side, cudaFunctions.cu:35-61,178-242):
+ *   - send_mat_levels_cuda / send_weights_cuda / send_Seq1_To_Cuda STAGE
+ *     read-only state — the `__constant__`-memory tier, realised here as
+ *     host-side staging that becomes a replicated device array;
+ *   - send_divided_Seq2_To_Cuda EXECUTES: scores a fixed-stride batch of
+ *     NUL-terminated records (the MPI_Scatter buffer layout, main.c:110-121)
+ *     and fills the three parallel int result arrays (score, offset n,
+ *     mutant k) in record order.
+ *
+ * Backend selection (env):
+ *   TPU_SEQALIGN_BACKEND  xla | xla-gather | pallas | oracle   (default xla)
+ *   TPU_SEQALIGN_MESH     N > 0 shards the batch over N devices (default 0)
+ *   TPU_SEQALIGN_PYROOT   package root override (default: compiled-in path)
+ *
+ * Failure model: fail-stop, like the reference's checkStatus
+ * (cudaFunctions.cu:15-33) — any backend error prints a diagnostic and
+ * exits nonzero.
+ */
+#pragma once
+
+#define BUF_SIZE_SEQ1 3000 /* myProto.h:3 */
+#define BUF_SIZE_SEQ2 2000 /* myProto.h:4 */
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+/* Stage the two 27x27 0/1 group-membership matrices (conservative,
+ * semi-conservative); `size` must be 27*27. */
+void send_mat_levels_cuda(char mat_level1[27 * 27], char mat_level2[27 * 27],
+                          int size);
+
+/* Stage Seq1 (uppercase ASCII, not necessarily NUL-terminated at
+ * seq1_size). */
+void send_Seq1_To_Cuda(char *seq1, int seq1_size);
+
+/* Stage the 4 scoring weights (w1 identity, w2 conservative,
+ * w3 semi-conservative, w4 mismatch). */
+void send_weights_cuda(int weights[4]);
+
+/* Score a batch: `seq2_divided` is `num_rows_each_proc` records of stride
+ * `seq2_size / num_rows_each_proc` bytes, each a NUL-terminated uppercase
+ * C string.  Results land in the three caller-owned arrays, one entry per
+ * record.  Requires all three staging calls to have happened first. */
+void send_divided_Seq2_To_Cuda(char *seq2_divided, int seq2_size,
+                               int num_rows_each_proc, int *local_score,
+                               int *local_offset, int *local_k);
+
+/* TPU-build extension: tear down the embedded interpreter (optional; the
+ * backend also registers it with atexit). */
+void tpu_backend_shutdown(void);
+
+#ifdef __cplusplus
+}
+#endif
