@@ -39,7 +39,9 @@ def build_arg_parser() -> argparse.ArgumentParser:
     p.add_argument(
         "--mesh",
         default=None,
-        help="shard the batch over N devices, e.g. '--mesh 8' or '--mesh batch:8' "
+        help="device mesh: 'N' or 'batch:N' shards the Seq2 batch over N "
+        "devices (data parallel); 'seq:N' ring-shards Seq1 over N devices "
+        "(sequence/context parallel); 'DxS' composes both on a 2-D mesh "
         "(default: no sharding, single device)",
     )
     p.add_argument(
@@ -85,14 +87,29 @@ def _build_sharding(mesh_arg: str | None):
     if mesh_arg is None:
         return None
 
-    def _imp():
+    def _imp_batch():
         from ..parallel.sharding import BatchSharding
 
         return BatchSharding
 
+    def _imp_ring():
+        from ..parallel.ring import RingSharding
+
+        return RingSharding
+
     spec = mesh_arg.split(":")
-    n = int(spec[-1])
-    return _feature_import("--mesh batch sharding", _imp).over_devices(n)
+    if spec[0] == "seq":
+        return _feature_import("--mesh sequence sharding", _imp_ring).over_devices(
+            seq=int(spec[-1])
+        )
+    if "x" in spec[-1]:
+        dp, sp = (int(t) for t in spec[-1].split("x"))
+        return _feature_import("--mesh 2-D sharding", _imp_ring).over_devices(
+            seq=sp, batch=dp
+        )
+    return _feature_import("--mesh batch sharding", _imp_batch).over_devices(
+        int(spec[-1])
+    )
 
 
 def run(argv: list[str] | None = None) -> int:

@@ -55,13 +55,19 @@ def pad_problem(
     seq2_codes: list[np.ndarray],
     *,
     lane: int = _LANE,
+    enforce_caps: bool = True,
 ) -> PaddedBatch:
-    """Encode a ragged problem into bucket-padded rectangular arrays."""
+    """Encode a ragged problem into bucket-padded rectangular arrays.
+
+    ``enforce_caps=False`` lifts the reference's fixed buffer limits
+    (myProto.h:3-4) for the long-context sequence-parallel path, which
+    shards Seq1 across devices and has no single-buffer ceiling.
+    """
     len1 = int(seq1_codes.size)
-    if len1 > BUF_SIZE_SEQ1:
+    if enforce_caps and len1 > BUF_SIZE_SEQ1:
         raise ValueError(f"Seq1 length {len1} exceeds BUF_SIZE_SEQ1={BUF_SIZE_SEQ1}")
     for idx, codes in enumerate(seq2_codes):
-        if codes.size > BUF_SIZE_SEQ2:
+        if enforce_caps and codes.size > BUF_SIZE_SEQ2:
             raise ValueError(
                 f"Seq2[{idx}] length {codes.size} exceeds BUF_SIZE_SEQ2={BUF_SIZE_SEQ2}"
             )
@@ -83,6 +89,17 @@ def choose_chunk(batch: PaddedBatch, budget: int) -> int:
     cb = max(1, budget // max(per_pair, 1))
     cb = 1 << (cb.bit_length() - 1)  # floor to power of two
     return min(cb, max(1, 1 << (batch.batch_size - 1).bit_length()))
+
+
+def choose_chunk_rows(per_pair: int, budget: int, per_dev_rows: int) -> int:
+    """Per-device chunk size: the single chunk policy shared by the sharded
+    paths (batch and ring).  Power-of-two rows whose [rows, per_pair] grid
+    fits the budget, never exceeding the per-device row count."""
+    cb = max(1, budget // max(per_pair, 1))
+    cb = 1 << (cb.bit_length() - 1)  # floor to power of two
+    while cb > max(1, per_dev_rows):
+        cb >>= 1
+    return cb
 
 
 def mm_formulation_exact(val_flat: np.ndarray) -> bool:
@@ -197,7 +214,13 @@ class AlignmentScorer:
             return np.array(
                 score_batch_oracle(seq1_codes, seq2_codes, weights), dtype=np.int32
             )
-        batch = pad_problem(seq1_codes, seq2_codes)
+        # Sequence-parallel shardings advertise `unbounded`: Seq1 is split
+        # across devices, so the reference's fixed buffer caps don't apply.
+        batch = pad_problem(
+            seq1_codes,
+            seq2_codes,
+            enforce_caps=not getattr(self.sharding, "unbounded", False),
+        )
         if val_table is None:
             val_flat = value_table(weights).astype(np.int32).reshape(-1)
         else:

@@ -57,6 +57,14 @@ def make_2d_mesh(
         raise ValueError(
             f"mesh {batch}x{seq} needs {batch * seq} devices, have {len(devs)}"
         )
+    if jax.process_count() > 1 and batch * seq != len(devs):
+        # Same hazard as make_mesh: a partial global mesh leaves some
+        # hosts' devices unaddressed and their processes hang in the
+        # collectives instead of erroring.
+        raise ValueError(
+            f"multi-host jobs must mesh all {len(devs)} global devices, "
+            f"got {batch}x{seq}"
+        )
     return Mesh(
         np.array(devs[: batch * seq]).reshape(batch, seq), (BATCH_AXIS, SEQ_AXIS)
     )

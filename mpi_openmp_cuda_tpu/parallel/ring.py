@@ -1,0 +1,218 @@
+"""Sequence/context parallelism: ring-sharded Seq1 (SURVEY §2.4 SP/CP row).
+
+The reference parallelises *within* a sequence only inside one GPU (one CUDA
+thread per Seq2 character, cudaFunctions.cu:66-99); Seq1 itself is bounded
+by a single device's buffer (myProto.h:3).  This module removes that ceiling
+the way ring attention does for KV blocks:
+
+* Seq1 is split into ``sp`` contiguous blocks, one per device along a
+  ``'seq'`` mesh axis; each device *owns the candidate offsets* that start
+  inside its block (the "query block" analogue).
+* Scoring offset ``n`` needs the Seq1 window ``[n, n + L2 + 1]``, which
+  spills into neighbouring blocks.  Each device assembles its window from
+  ``R = ceil((L2P+1)/Bs)`` ring steps of ``lax.ppermute`` — neighbour
+  exchange over ICI, never an all-gather of the full sequence.  Per-device
+  memory is O(Bs + L2) for the window, O(Bs * L2) for its score grid —
+  both independent of the global Seq1 length.
+* Each device reduces its grid to one best candidate (first-hit argmax =
+  the reference's offset-major tie-break within the block, SURVEY A.3),
+  then one tiny ``all_gather`` of per-device (score, n, k, eq) candidates
+  picks the global winner — lowest device index on ties, which is exactly
+  offset-major order globally.
+* Wrapped ring blocks (past the end of Seq1) only ever feed grid cells
+  that the validity masks already exclude: valid reads stop at global
+  index ``len1 - 1 < sp * Bs``.
+
+Composes with data parallelism on a 2-D ``('batch', 'seq')`` mesh: the
+batch axis shards Seq2 rows (the MPI_Scatter tier), the seq axis shards
+Seq1 — dp x sp.  Yields the same (score, n, k) triples, bit-exact, as the
+single-device paths; property-tested against the host oracle.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from dataclasses import dataclass
+
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..ops.dispatch import (
+    DEFAULT_CHUNK_BUDGET,
+    PaddedBatch,
+    choose_chunk_rows,
+    pad_batch_rows,
+    round_up,
+)
+from ..utils.constants import ALPHABET_SIZE, INT32_MIN
+from .mesh import BATCH_AXIS, SEQ_AXIS, make_2d_mesh
+
+
+@dataclass
+class RingSharding:
+    """Scores a PaddedBatch with Seq1 ring-sharded over the 'seq' axis."""
+
+    mesh: Mesh  # axes (BATCH_AXIS, SEQ_AXIS)
+
+    # Sharded Seq1 has no single-buffer ceiling: AlignmentScorer lifts the
+    # reference's BUF_SIZE caps (myProto.h:3-4) when scoring through this.
+    unbounded = True
+
+    @classmethod
+    def over_devices(cls, seq: int, batch: int = 1) -> "RingSharding":
+        return cls(mesh=make_2d_mesh(batch, seq))
+
+    @property
+    def sp(self) -> int:
+        return self.mesh.shape[SEQ_AXIS]
+
+    @property
+    def dp(self) -> int:
+        return self.mesh.shape[BATCH_AXIS]
+
+    def score(
+        self,
+        batch: PaddedBatch,
+        val_flat: np.ndarray,
+        backend: str = "xla",
+        chunk_budget: int = DEFAULT_CHUNK_BUDGET,
+    ) -> np.ndarray:
+        """Returns [B, 3] int32 host array, input order.
+
+        The ring path has a single (gather) formulation — the window
+        assembly, not the per-cell lookup, is what it exists for — so only
+        the default 'xla' family is accepted; asking for 'pallas'/'oracle'
+        here fails fast rather than silently running something else.
+        """
+        if backend not in ("xla", "xla-gather"):
+            raise ValueError(
+                f"backend {backend!r} is not available on the sequence-parallel "
+                "ring path (it has a single XLA formulation); drop --backend "
+                "or use a batch-only mesh"
+            )
+        import jax.numpy as jnp
+
+        sp, dp = self.sp, self.dp
+        # Per-device offset-block size: sublane-aligned so the grid tiles.
+        bs = round_up(math.ceil(batch.l1p / sp), 8)
+
+        seq1pad = np.zeros(sp * bs, dtype=np.int32)
+        take = min(seq1pad.size, batch.seq1ext.size)
+        seq1pad[:take] = batch.seq1ext[:take]
+
+        b = batch.batch_size
+        # Chunk the per-device batch rows so the [cb, Bs, L2P] grid stays
+        # inside the budget (the C14 memory-manager role).
+        cb = choose_chunk_rows(bs * batch.l2p, chunk_budget, -(-b // dp))
+        bl = cb * (-(-b // (dp * cb)))
+        bp = bl * dp
+        rows, lens = pad_batch_rows(batch, bp)
+
+        from .sharding import _fetch_global, _put_global
+
+        rows_d = _put_global(rows, NamedSharding(self.mesh, P(BATCH_AXIS)))
+        lens_d = _put_global(lens, NamedSharding(self.mesh, P(BATCH_AXIS)))
+        seq1_d = _put_global(seq1pad, NamedSharding(self.mesh, P(SEQ_AXIS)))
+        val_d = _put_global(
+            np.asarray(val_flat, dtype=np.int32), NamedSharding(self.mesh, P())
+        )
+        out = _ring_fn(self.mesh, bs, batch.l2p, cb)(
+            seq1_d, jnp.int32(batch.len1), rows_d, lens_d, val_d
+        )
+        return _fetch_global(out)[:b]
+
+
+@functools.lru_cache(maxsize=32)
+def _ring_fn(mesh, bs, l2p, cb):
+    """Jitted shard_map ring scorer for one (mesh, Bs, L2P, chunk) config."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    sp = mesh.shape[SEQ_AXIS]
+    # Ring steps so the window [0, Bs + L2P + 1) is fully materialised.
+    r_steps = math.ceil((l2p + 1) / bs)
+    win_len = (r_steps + 1) * bs
+    neg = jnp.int32(INT32_MIN)
+
+    def local_fn(seq1_blk, len1, rows, lens, val_flat):
+        d = lax.axis_index(SEQ_AXIS).astype(jnp.int32)
+
+        # -- assemble the window: R neighbour exchanges over the ring ----
+        win = jnp.zeros(win_len, dtype=jnp.int32)
+        blk = seq1_blk
+        win = lax.dynamic_update_slice(win, blk, (0,))
+        perm = [(j, (j - 1) % sp) for j in range(sp)]
+        for r in range(1, r_steps + 1):
+            blk = lax.ppermute(blk, SEQ_AXIS, perm)
+            win = lax.dynamic_update_slice(win, blk, (r * bs,))
+
+        n_local = jnp.arange(bs, dtype=jnp.int32)[:, None]
+        i = jnp.arange(l2p, dtype=jnp.int32)[None, :]
+        idx0 = n_local + i
+        g0 = jnp.take(win, idx0)
+        g1 = jnp.take(win, idx0 + 1)
+        kk = jnp.arange(l2p, dtype=jnp.int32)[None, :]
+        gn = d * bs + n_local
+
+        def pair_candidate(row, len2):
+            pair_base = row[None, :].astype(jnp.int32) * ALPHABET_SIZE
+            charmask = i < len2
+            v0 = jnp.where(charmask, jnp.take(val_flat, pair_base + g0), 0)
+            v1 = jnp.where(charmask, jnp.take(val_flat, pair_base + g1), 0)
+            c0 = jnp.cumsum(v0, axis=1)
+            c1 = jnp.cumsum(v1, axis=1)
+            t0 = c0[:, -1:]
+            t1 = c1[:, -1:]
+            scores = jnp.concatenate(
+                [t0, c0[:, :-1] + (t1 - c1[:, :-1])], axis=1
+            )
+            valid = (gn < jnp.maximum(len1 - len2, 0)) & (
+                (kk == 0) | (kk < len2)
+            )
+            flat = jnp.where(valid, scores, neg).reshape(-1)
+            bi = jnp.argmax(flat).astype(jnp.int32)
+            # eq: positional score at global n=0 — real only on device 0.
+            return jnp.stack(
+                [flat[bi], d * bs + bi // l2p, bi % l2p, c0[0, -1]]
+            )
+
+        def chunk_fn(args):
+            rows_c, lens_c = args
+            return jax.vmap(pair_candidate)(rows_c, lens_c)
+
+        bl = rows.shape[0]
+        cand = lax.map(
+            chunk_fn, (rows.reshape(bl // cb, cb, l2p), lens.reshape(bl // cb, cb))
+        ).reshape(bl, 4)
+
+        # -- global combine: tiny all_gather of one candidate per device --
+        gathered = lax.all_gather(cand, SEQ_AXIS)  # [sp, bl, 4]
+        scores = gathered[:, :, 0]
+        gi = jnp.argmax(scores, axis=0)  # first-hit: lowest block wins ties
+        best = jnp.take_along_axis(
+            gathered, gi[None, :, None], axis=0
+        )[0]  # [bl, 4]
+        eq = gathered[0, :, 3]
+
+        searchable = (lens < len1) & (lens > 0)
+        score = jnp.where(
+            lens == len1, eq, jnp.where(searchable, best[:, 0], neg)
+        )
+        out_n = jnp.where(searchable, best[:, 1], 0)
+        out_k = jnp.where(searchable, best[:, 2], 0)
+        return jnp.stack([score, out_n, out_k], axis=1).astype(jnp.int32)
+
+    return jax.jit(
+        jax.shard_map(
+            local_fn,
+            mesh=mesh,
+            in_specs=(P(SEQ_AXIS), P(), P(BATCH_AXIS), P(BATCH_AXIS), P()),
+            out_specs=P(BATCH_AXIS),
+            # The output is replicated over 'seq' by construction (every
+            # device runs the identical combine on the all_gather'd
+            # candidates), which the static vma inference cannot see.
+            check_vma=False,
+        )
+    )

@@ -27,7 +27,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 from ..ops.dispatch import (
     DEFAULT_CHUNK_BUDGET,
     PaddedBatch,
-    choose_chunk,
+    choose_chunk_rows,
     pad_batch_rows,
 )
 from .mesh import BATCH_AXIS, batch_sharded, make_mesh, replicated
@@ -100,9 +100,7 @@ class BatchSharding:
 
         d = self.n_devices
         b = batch.batch_size
-        cb = choose_chunk(batch, chunk_budget)
-        while cb > max(1, -(-b // d)):  # no point chunking past per-device rows
-            cb >>= 1
+        cb = choose_chunk_rows(batch.l1p * batch.l2p, chunk_budget, -(-b // d))
         bl = cb * (-(-b // (d * cb)))  # per-device rows, multiple of cb
         bp = bl * d
 

@@ -116,6 +116,16 @@ def test_malformed_input_fail_stop(final_bin):
     assert "error" in proc.stderr
 
 
+def _membership(groups) -> np.ndarray:
+    """Host-side build of a 27x27 0/1 group matrix (what main.cpp does)."""
+    mat = np.zeros((27, 27), dtype=np.int8)
+    for g in groups:
+        for a in g:
+            for b in g:
+                mat[ord(a) - ord("A") + 1, ord(b) - ord("A") + 1] = 1
+    return mat
+
+
 def test_bridge_value_table_matches_spec():
     """Host-built membership matrices -> the spec-derived value table."""
     from mpi_openmp_cuda_tpu.models.groups import (
@@ -125,17 +135,9 @@ def test_bridge_value_table_matches_spec():
     from mpi_openmp_cuda_tpu.native_bridge import value_table_from_levels
     from mpi_openmp_cuda_tpu.ops.values import value_table
 
-    def membership(groups):
-        mat = np.zeros((27, 27), dtype=np.int8)
-        for g in groups:
-            for a in g:
-                for b in g:
-                    mat[ord(a) - ord("A") + 1, ord(b) - ord("A") + 1] = 1
-        return mat
-
     weights = [7, 3, 2, 11]
     got = value_table_from_levels(
-        membership(CONSERVATIVE_GROUPS), membership(SEMI_CONSERVATIVE_GROUPS), weights
+        _membership(CONSERVATIVE_GROUPS), _membership(SEMI_CONSERVATIVE_GROUPS), weights
     )
     want = value_table(weights)
     # Index 0 (pad/hyphen) is masked before any reduction; compare the used part.
@@ -150,14 +152,6 @@ def test_score_strided_wire_format():
     )
     from mpi_openmp_cuda_tpu.native_bridge import score_strided
 
-    def membership(groups):
-        mat = np.zeros((27, 27), dtype=np.int8)
-        for g in groups:
-            for a in g:
-                for b in g:
-                    mat[ord(a) - ord("A") + 1, ord(b) - ord("A") + 1] = 1
-        return mat.tobytes()
-
     stride = 12
     records = [b"ASQREAVSL", b"OWRL"]
     batch = b"".join(r + b"\0" * (stride - len(r)) for r in records)
@@ -166,8 +160,8 @@ def test_score_strided_wire_format():
         batch,
         stride,
         2,
-        membership(CONSERVATIVE_GROUPS),
-        membership(SEMI_CONSERVATIVE_GROUPS),
+        _membership(CONSERVATIVE_GROUPS).tobytes(),
+        _membership(SEMI_CONSERVATIVE_GROUPS).tobytes(),
         (10, 2, 3, 4),
         "xla",
         0,
