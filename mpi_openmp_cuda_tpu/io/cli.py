@@ -13,9 +13,16 @@ import argparse
 import sys
 
 from ..ops.dispatch import AlignmentScorer
-from ..utils.profiling import PhaseTimer
+from ..utils.profiling import PhaseTimer, device_trace
 from .parse import load_problem
 from .printer import print_results, write_json_sidecar
+
+
+def _nonnegative_int(text: str) -> int:
+    value = int(text)
+    if value < 0:
+        raise argparse.ArgumentTypeError(f"must be >= 0, got {value}")
+    return value
 
 
 def build_arg_parser() -> argparse.ArgumentParser:
@@ -66,6 +73,27 @@ def build_arg_parser() -> argparse.ArgumentParser:
         action="store_true",
         help="print per-phase wall-clock timings to stderr",
     )
+    p.add_argument(
+        "--trace",
+        default=None,
+        metavar="DIR",
+        help="capture a jax.profiler device trace of the scoring phase "
+        "into DIR (view with TensorBoard / xprof)",
+    )
+    p.add_argument(
+        "--selfcheck",
+        action="store_true",
+        help="after scoring, rescore a deterministic sample on the host "
+        "oracle and fail on any mismatch (sanitizer analogue)",
+    )
+    p.add_argument(
+        "--retries",
+        type=_nonnegative_int,
+        default=0,
+        metavar="N",
+        help="retry the scoring phase up to N times on transient device "
+        "failure (combine with --journal to resume mid-batch)",
+    )
     return p
 
 
@@ -102,13 +130,19 @@ def _build_sharding(mesh_arg: str | None):
         return _feature_import("--mesh sequence sharding", _imp_ring).over_devices(
             seq=int(spec[-1])
         )
-    if "x" in spec[-1]:
-        dp, sp = (int(t) for t in spec[-1].split("x"))
+    if spec[0] == "batch" or len(spec) > 1:
+        # An explicit 'batch:' prefix always means 1-D batch sharding —
+        # 'batch:2x4' is a spec error, not a silent 2-D ring mesh.
+        return _feature_import("--mesh batch sharding", _imp_batch).over_devices(
+            int(spec[-1])
+        )
+    if "x" in spec[0]:
+        dp, sp = (int(t) for t in spec[0].split("x"))
         return _feature_import("--mesh 2-D sharding", _imp_ring).over_devices(
             seq=sp, batch=dp
         )
     return _feature_import("--mesh batch sharding", _imp_batch).over_devices(
-        int(spec[-1])
+        int(spec[0])
     )
 
 
@@ -174,12 +208,48 @@ def run(argv: list[str] | None = None) -> int:
                 return ResultJournal
 
             journal = _feature_import("--journal resume", _imp)(args.journal)
-        with timer.phase("score"):
+        if args.retries and args.distributed:
+            # A retry loop on one host would rerun collectives the other
+            # hosts never re-enter; restart the whole job instead.
+            raise ValueError("--retries cannot be combined with --distributed")
+
+        def _score_once():
             if journal is not None:
-                results = journal.score_with_resume(scorer, problem)
-            else:
-                results = scorer.score_codes(
-                    problem.seq1_codes, problem.seq2_codes, problem.weights
+                return journal.score_with_resume(scorer, problem)
+            return scorer.score_codes(
+                problem.seq1_codes, problem.seq2_codes, problem.weights
+            )
+
+        with timer.phase("score"), device_trace(args.trace):
+            for attempt in range(args.retries + 1):
+                try:
+                    results = _score_once()
+                    break
+                except (ValueError, TypeError):
+                    raise  # programming/shape errors are not transient
+                except Exception as e:
+                    if attempt >= args.retries:
+                        raise
+                    print(
+                        f"mpi_openmp_cuda_tpu: scoring attempt "
+                        f"{attempt + 1} failed ({e}); retrying",
+                        file=sys.stderr,
+                    )
+        if args.selfcheck:
+            with timer.phase("selfcheck"):
+
+                def _imp_check():
+                    from ..utils.selfcheck import verify_results
+
+                    return verify_results
+
+                checked = _feature_import("--selfcheck validation", _imp_check)(
+                    problem, results
+                )
+                print(
+                    f"mpi_openmp_cuda_tpu: selfcheck OK "
+                    f"({checked} sequences re-verified on the host oracle)",
+                    file=sys.stderr,
                 )
         with timer.phase("print"):
             if coordinator:  # workers print nothing (main.c:199-211 semantics)

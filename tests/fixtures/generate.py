@@ -1,0 +1,125 @@
+"""Self-contained fixture generator (SURVEY §4 tier c, reference C16).
+
+The reference validates only against six stdin files that live outside this
+repo (and its fixtures never exercise the equal-length branch, the
+over-long-Seq2 case, or an empty batch — SURVEY §4).  This generator
+produces an ORIGINAL fixture suite — seeded, deterministic, no reference
+content — covering every regime plus the gaps, with golden outputs computed
+by the host prefix-sum oracle (ops/oracle.py), which is itself
+property-tested against the brute-force spec transcription.
+
+Run ``python tests/fixtures/generate.py`` from the repo root to regenerate;
+the committed .txt/.out files must match (test_fixtures.py asserts this).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, REPO)
+
+from mpi_openmp_cuda_tpu.io.printer import format_result  # noqa: E402
+from mpi_openmp_cuda_tpu.models.encoding import encode_normalized  # noqa: E402
+from mpi_openmp_cuda_tpu.ops.oracle import score_batch_oracle  # noqa: E402
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+LETTERS = np.frombuffer(b"ABCDEFGHIJKLMNOPQRSTUVWXYZ", dtype=np.uint8)
+
+
+def rand_seq(rng: np.random.Generator, length: int) -> str:
+    return bytes(rng.choice(LETTERS, size=length)).decode("ascii")
+
+
+def mixcase(rng: np.random.Generator, seq: str) -> str:
+    """Lowercase a deterministic subset of characters (normalization regime)."""
+    flags = rng.random(len(seq)) < 0.4
+    return "".join(c.lower() if f else c for c, f in zip(seq, flags))
+
+
+def fixtures() -> dict[str, tuple[list[int], str, list[str]]]:
+    """name -> (weights, seq1_raw, seq2_raw_list); raw = as written to .txt."""
+    out: dict[str, tuple[list[int], str, list[str]]] = {}
+
+    # 1. Mixed-case normalization, small batch (input1 regime).
+    rng = np.random.default_rng(11)
+    seq1 = rand_seq(rng, 64)
+    seqs = [rand_seq(rng, int(n)) for n in rng.integers(10, 31, size=8)]
+    out["mixedcase"] = ([20, 3, 2, 4], mixcase(rng, seq1), [mixcase(rng, s) for s in seqs])
+
+    # 2. Equal-length (branch A — no reference fixture covers it) plus
+    #    near-equal (offset grid of size 1) and a shorter control.
+    rng = np.random.default_rng(22)
+    seq1 = rand_seq(rng, 96)
+    equal = rand_seq(rng, 96)
+    near = rand_seq(rng, 95)
+    out["equal_len"] = ([10, 2, 3, 4], seq1, [equal, seq1, near, rand_seq(rng, 40)])
+
+    # 3. Over-long Seq2 (B12 semantics: INT32_MIN, 0, 0) + a valid row to
+    #    prove the batch keeps scoring around the sentinel, + a 1-char row.
+    rng = np.random.default_rng(33)
+    seq1 = rand_seq(rng, 48)
+    out["overlong"] = ([5, 1, 2, 3], seq1, [rand_seq(rng, 60), rand_seq(rng, 20), "Q"])
+
+    # 4. Duplicates (determinism, input6 regime) + an exact-substring plant:
+    #    seq2 embedded verbatim in seq1 makes k=0 (hyphen after end) optimal
+    #    at a known offset with full identity score (plant chosen so the
+    #    flanking chars differ — no earlier shifted tie can reach it).
+    rng = np.random.default_rng(44)
+    seq1 = rand_seq(rng, 80)
+    planted = seq1[1:21]
+    dup = rand_seq(rng, 15)
+    out["dup_and_k0"] = ([9, 2, 3, 10], seq1, [dup, planted, dup, planted, dup])
+
+    # 5. Seeded stress batch (input3 regime scaled for CI): heavy mismatch
+    #    weight drives negative scores; uneven lengths stress padding.
+    rng = np.random.default_rng(55)
+    seq1 = rand_seq(rng, 1024)
+    lens = [64, 100, 128, 200, 256, 300, 384, 448, 512, 700, 851, 1000]
+    out["stress_small"] = ([2, 2, 1, 10], seq1, [rand_seq(rng, n) for n in lens])
+
+    # 6. Tiny extremes: 1-char Seq1-adjacent cases and an empty batch file
+    #    is separate (N=0 below); here the smallest searchable problems.
+    rng = np.random.default_rng(66)
+    out["tiny"] = ([4, 3, 2, 1], rand_seq(rng, 3), ["A", "GG", rand_seq(rng, 2)])
+
+    # 7. Empty batch: N=0 — parse succeeds, zero output lines.
+    rng = np.random.default_rng(77)
+    out["empty_batch"] = ([1, 1, 1, 1], rand_seq(rng, 10), [])
+
+    return out
+
+
+def fixture_text(weights: list[int], seq1: str, seqs: list[str]) -> str:
+    lines = [" ".join(str(w) for w in weights), seq1, str(len(seqs)), *seqs]
+    return "\n".join(lines) + "\n"
+
+
+def golden_text(weights: list[int], seq1: str, seqs: list[str]) -> str:
+    results = score_batch_oracle(
+        encode_normalized(seq1), [encode_normalized(s) for s in seqs], weights
+    )
+    return "".join(
+        format_result(i, score, n, k) + "\n"
+        for i, (score, n, k) in enumerate(results)
+    )
+
+
+def write_fixture(name: str, weights: list[int], seq1: str, seqs: list[str]) -> None:
+    with open(os.path.join(HERE, f"{name}.txt"), "w", encoding="ascii") as f:
+        f.write(fixture_text(weights, seq1, seqs))
+    with open(os.path.join(HERE, f"{name}.out"), "w", encoding="ascii") as f:
+        f.write(golden_text(weights, seq1, seqs))
+
+
+def main() -> None:
+    for name, (weights, seq1, seqs) in fixtures().items():
+        write_fixture(name, weights, seq1, seqs)
+        print(f"wrote {name}.txt / {name}.out ({len(seqs)} sequences)")
+
+
+if __name__ == "__main__":
+    main()

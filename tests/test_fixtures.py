@@ -1,0 +1,179 @@
+"""Self-contained fixture suite: integration tests that need no reference
+mount (SURVEY §4 tier c via original, oracle-golden fixtures), plus the
+aux-subsystem CLI flags (--selfcheck, --retries, --trace; SURVEY §5)."""
+
+import importlib.util
+import os
+import sys
+
+import numpy as np
+import pytest
+
+from test_cli import run_cli
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXDIR = os.path.join(REPO, "tests", "fixtures")
+
+_spec = importlib.util.spec_from_file_location(
+    "fixture_generate", os.path.join(FIXDIR, "generate.py")
+)
+generate = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(generate)
+
+ALL_FIXTURES = sorted(generate.fixtures())
+
+
+def fixture_path(name: str) -> str:
+    return os.path.join(FIXDIR, f"{name}.txt")
+
+
+def golden(name: str) -> str:
+    with open(os.path.join(FIXDIR, f"{name}.out")) as f:
+        return f.read()
+
+
+@pytest.mark.parametrize("name", ALL_FIXTURES)
+def test_fixture_stdout_exact(name):
+    proc = run_cli(stdin_path=fixture_path(name))
+    assert proc.stdout == golden(name)
+
+
+@pytest.mark.parametrize("name", ["equal_len", "overlong", "tiny"])
+def test_fixture_gather_backend(name):
+    proc = run_cli("--backend", "xla-gather", stdin_path=fixture_path(name))
+    assert proc.stdout == golden(name)
+
+
+def test_fixture_oracle_backend():
+    proc = run_cli("--backend", "oracle", stdin_path=fixture_path("dup_and_k0"))
+    assert proc.stdout == golden("dup_and_k0")
+
+
+def test_fixture_batch_mesh():
+    # 8 virtual CPU devices (conftest): dp sharding over an uneven batch.
+    proc = run_cli("--mesh", "4", stdin_path=fixture_path("mixedcase"))
+    assert proc.stdout == golden("mixedcase")
+
+
+def test_fixture_ring_mesh():
+    proc = run_cli("--mesh", "seq:4", stdin_path=fixture_path("equal_len"))
+    assert proc.stdout == golden("equal_len")
+
+
+def test_committed_fixtures_match_generator():
+    """The committed .txt/.out files are exactly what generate.py produces —
+    guards against silent drift between suite and generator."""
+    for name, (weights, seq1, seqs) in generate.fixtures().items():
+        with open(fixture_path(name)) as f:
+            assert f.read() == generate.fixture_text(weights, seq1, seqs), name
+        assert golden(name) == generate.golden_text(weights, seq1, seqs), name
+
+
+def test_empty_batch_prints_nothing():
+    proc = run_cli(stdin_path=fixture_path("empty_batch"))
+    assert proc.stdout == ""
+
+
+def test_overlong_sentinel_matches_reference_b12():
+    # L2 > L1 drops through with the reference's (INT_MIN, 0, 0) triple.
+    assert golden("overlong").splitlines()[0] == "#0: score: -2147483648, n: 0, k: 0"
+
+
+# -- aux-subsystem flags (SURVEY §5) --------------------------------------
+
+
+def test_selfcheck_passes_and_reports():
+    proc = run_cli("--selfcheck", stdin_path=fixture_path("mixedcase"))
+    assert proc.stdout == golden("mixedcase")
+    assert "selfcheck OK" in proc.stderr
+
+
+def test_selfcheck_catches_corruption():
+    from mpi_openmp_cuda_tpu.io.parse import load_problem
+    from mpi_openmp_cuda_tpu.ops.dispatch import AlignmentScorer
+    from mpi_openmp_cuda_tpu.utils.selfcheck import SelfCheckError, verify_results
+
+    problem = load_problem(fixture_path("tiny"))
+    scorer = AlignmentScorer(backend="xla")
+    results = scorer.score_codes(
+        problem.seq1_codes, problem.seq2_codes, problem.weights
+    )
+    assert verify_results(problem, results) == len(problem.seq2_codes)
+    corrupted = np.array(results, copy=True)
+    corrupted[1, 0] += 1
+    with pytest.raises(SelfCheckError, match="#1"):
+        verify_results(problem, corrupted)
+
+
+def test_selfcheck_sample_indices_deterministic_and_bounded():
+    from mpi_openmp_cuda_tpu.utils.selfcheck import sample_indices
+
+    assert sample_indices(0) == []
+    assert sample_indices(1) == [0]
+    idx = sample_indices(1000)
+    assert idx == sample_indices(1000)  # deterministic
+    assert idx[0] == 0 and idx[-1] == 999 and len(idx) == 8
+
+
+def test_retries_recovers_from_transient_failure(monkeypatch, capsys):
+    from mpi_openmp_cuda_tpu.io import cli
+    from mpi_openmp_cuda_tpu.ops.dispatch import AlignmentScorer
+
+    calls = {"n": 0}
+    real = AlignmentScorer.score_codes
+
+    def flaky(self, *a, **kw):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise RuntimeError("synthetic transient device loss")
+        return real(self, *a, **kw)
+
+    monkeypatch.setattr(AlignmentScorer, "score_codes", flaky)
+    rc = cli.run(["--retries", "2", "--input", fixture_path("tiny")])
+    captured = capsys.readouterr()
+    assert rc == 0
+    assert calls["n"] == 2
+    assert "retrying" in captured.err
+    assert captured.out == golden("tiny")
+
+
+def test_retries_exhausted_fails(monkeypatch, capsys):
+    from mpi_openmp_cuda_tpu.io import cli
+    from mpi_openmp_cuda_tpu.ops.dispatch import AlignmentScorer
+
+    def always_down(self, *a, **kw):
+        raise RuntimeError("synthetic persistent device loss")
+
+    monkeypatch.setattr(AlignmentScorer, "score_codes", always_down)
+    rc = cli.run(["--retries", "1", "--input", fixture_path("tiny")])
+    captured = capsys.readouterr()
+    assert rc == 1
+    assert captured.out == ""
+    assert "persistent device loss" in captured.err
+
+
+def test_retries_does_not_mask_value_errors(monkeypatch, capsys):
+    from mpi_openmp_cuda_tpu.io import cli
+    from mpi_openmp_cuda_tpu.ops.dispatch import AlignmentScorer
+
+    calls = {"n": 0}
+
+    def bad_shape(self, *a, **kw):
+        calls["n"] += 1
+        raise ValueError("synthetic shape error")
+
+    monkeypatch.setattr(AlignmentScorer, "score_codes", bad_shape)
+    rc = cli.run(["--retries", "5", "--input", fixture_path("tiny")])
+    capsys.readouterr()
+    assert rc == 1
+    assert calls["n"] == 1  # not retried
+
+
+def test_trace_writes_profile_data(tmp_path):
+    tracedir = str(tmp_path / "trace")
+    proc = run_cli("--trace", tracedir, stdin_path=fixture_path("tiny"))
+    assert proc.stdout == golden("tiny")
+    found = [
+        os.path.join(r, f) for r, _, fs in os.walk(tracedir) for f in fs
+    ]
+    assert found, "jax.profiler trace produced no files"
