@@ -15,7 +15,7 @@ import sys
 from ..ops.dispatch import AlignmentScorer
 from ..utils.profiling import PhaseTimer, device_trace
 from .parse import load_problem
-from .printer import print_results, write_json_sidecar
+from .printer import guarded_stdout, print_results, write_json_sidecar
 
 
 def _nonnegative_int(text: str) -> int:
@@ -125,24 +125,46 @@ def _build_sharding(mesh_arg: str | None):
 
         return RingSharding
 
+    def _bad(detail: str = "") -> ValueError:
+        return ValueError(
+            f"bad --mesh spec {mesh_arg!r}: expected 'N', 'batch:N', "
+            f"'seq:N', or 'DxS'{detail}"
+        )
+
+    def _count(token: str) -> int:
+        try:
+            value = int(token)
+        except ValueError:
+            raise _bad() from None
+        if value < 1:
+            raise _bad(f" (device count must be >= 1, got {value})")
+        return value
+
     spec = mesh_arg.split(":")
-    if spec[0] == "seq":
-        return _feature_import("--mesh sequence sharding", _imp_ring).over_devices(
-            seq=int(spec[-1])
-        )
-    if spec[0] == "batch" or len(spec) > 1:
-        # An explicit 'batch:' prefix always means 1-D batch sharding —
-        # 'batch:2x4' is a spec error, not a silent 2-D ring mesh.
-        return _feature_import("--mesh batch sharding", _imp_batch).over_devices(
-            int(spec[-1])
-        )
+    if len(spec) == 2:
+        # Explicit axis prefix: anything but 'seq'/'batch' is a spec error,
+        # never a silent fallback to some other parallelism strategy.
+        if spec[0] == "seq":
+            return _feature_import(
+                "--mesh sequence sharding", _imp_ring
+            ).over_devices(seq=_count(spec[1]))
+        if spec[0] == "batch":
+            return _feature_import(
+                "--mesh batch sharding", _imp_batch
+            ).over_devices(_count(spec[1]))
+        raise _bad(f" (unknown axis {spec[0]!r})")
+    if len(spec) != 1:
+        raise _bad()
     if "x" in spec[0]:
-        dp, sp = (int(t) for t in spec[0].split("x"))
+        tokens = spec[0].split("x")
+        if len(tokens) != 2:
+            raise _bad()
+        dp, sp = (_count(t) for t in tokens)
         return _feature_import("--mesh 2-D sharding", _imp_ring).over_devices(
             seq=sp, batch=dp
         )
     return _feature_import("--mesh batch sharding", _imp_batch).over_devices(
-        int(spec[0])
+        _count(spec[0])
     )
 
 
@@ -152,7 +174,45 @@ def run(argv: list[str] | None = None) -> int:
     apply_platform_override()
     args = build_arg_parser().parse_args(argv)
     timer = PhaseTimer(enabled=args.profile)
+    # Static argument-compatibility checks: fail before any expensive phase
+    # (a multi-host job should not complete init + broadcast just to learn
+    # its flags conflict).
+    if args.distributed:
+        for flag, bad, why in (
+            ("--journal", args.journal, "resume would desynchronise the "
+             "hosts' collective schedules"),
+            ("--retries", args.retries, "a retry loop on one host would "
+             "rerun collectives the other hosts never re-enter"),
+        ):
+            if bad:
+                print(
+                    f"mpi_openmp_cuda_tpu: error: {flag} cannot be combined "
+                    f"with --distributed ({why})",
+                    file=sys.stderr,
+                )
+                return 1
+
+    guard = None
+    out_stream = None  # None -> sys.stdout
+
+    def _close_guard(suppress: bool) -> None:
+        nonlocal guard
+        if guard is None:
+            return
+        closing, guard = guard, None
+        try:
+            closing.__exit__(None, None, None)
+        except OSError:
+            if not suppress:
+                raise
+
     try:
+        if args.distributed:
+            # Collective backends may write banners straight to fd 1 from
+            # C++ (Gloo does on CPU); guard the byte-exact result stream
+            # for the whole run and print results to the true stdout only.
+            guard = guarded_stdout()
+            out_stream = guard.__enter__()
         coordinator = True
         if args.distributed:
             with timer.phase("distributed_init"):
@@ -196,10 +256,6 @@ def run(argv: list[str] | None = None) -> int:
                 ).over_devices(None)
             scorer = AlignmentScorer(backend=args.backend, sharding=sharding)
         journal = None
-        if args.journal and args.distributed:
-            # Resume would make the coordinator score a subset while workers
-            # score the full batch — mismatched collectives hang the job.
-            raise ValueError("--journal cannot be combined with --distributed")
         if args.journal:
 
             def _imp():
@@ -208,10 +264,6 @@ def run(argv: list[str] | None = None) -> int:
                 return ResultJournal
 
             journal = _feature_import("--journal resume", _imp)(args.journal)
-        if args.retries and args.distributed:
-            # A retry loop on one host would rerun collectives the other
-            # hosts never re-enter; restart the whole job instead.
-            raise ValueError("--retries cannot be combined with --distributed")
 
         def _score_once():
             if journal is not None:
@@ -253,18 +305,26 @@ def run(argv: list[str] | None = None) -> int:
                 )
         with timer.phase("print"):
             if coordinator:  # workers print nothing (main.c:199-211 semantics)
-                print_results(results)
+                print_results(results, out=out_stream)
                 if args.json:
                     write_json_sidecar(
                         results, args.json, meta={"backend": args.backend}
                     )
         timer.report()
+        # Close the guard while still inside the try: the final flush of
+        # buffered results can itself raise (e.g. BrokenPipeError under
+        # `... | head`), and must hit the handlers below.
+        _close_guard(suppress=False)
         return 0
     except BrokenPipeError:
         return 1
     except Exception as e:  # fail-stop: diagnose on stderr, nonzero exit (C11)
         print(f"mpi_openmp_cuda_tpu: error: {e}", file=sys.stderr)
         return 1
+    finally:
+        # Error paths: restore fd 1 without letting a secondary flush
+        # failure mask the original exception.
+        _close_guard(suppress=True)
 
 
 def main() -> None:

@@ -6,7 +6,9 @@ Byte-identical output contract: one line per Seq2, in input order:
 
 from __future__ import annotations
 
+import contextlib
 import json
+import os
 import sys
 from typing import Iterable, Sequence, TextIO
 
@@ -21,6 +23,34 @@ def print_results(
     out = out or sys.stdout
     for i, (score, n, k) in enumerate(results):
         print(format_result(i, int(score), int(n), int(k)), file=out)
+
+
+@contextlib.contextmanager
+def guarded_stdout():
+    """Protect the result stream from native-library chatter.
+
+    Multi-process collective backends can write status lines directly to
+    file descriptor 1 from C++ (e.g. Gloo's peer-connection banner on the
+    CPU backend), interleaving with — and corrupting — the byte-exact
+    result contract.  This redirects fd 1 to stderr for the duration and
+    yields a stream on a private duplicate of the real stdout, so only
+    deliberate result printing reaches it.
+    """
+    sys.stdout.flush()
+    saved = os.dup(1)
+    try:
+        real_stdout = os.fdopen(saved, "w")
+    except OSError:
+        os.close(saved)
+        raise
+    try:
+        os.dup2(2, 1)
+        yield real_stdout
+    finally:
+        real_stdout.flush()
+        sys.stdout.flush()
+        os.dup2(saved, 1)
+        real_stdout.close()  # closes the dup; fd 1 is restored above
 
 
 def write_json_sidecar(

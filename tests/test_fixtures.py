@@ -177,3 +177,26 @@ def test_trace_writes_profile_data(tmp_path):
         os.path.join(r, f) for r, _, fs in os.walk(tracedir) for f in fs
     ]
     assert found, "jax.profiler trace produced no files"
+
+
+@pytest.mark.parametrize(
+    "spec", ["batch:2x4", "sq:4", "ring:4", "2x3x4", "a:b:c", "0", "seq:-1", ""]
+)
+def test_bad_mesh_specs_fail_clearly(spec, capsys):
+    from mpi_openmp_cuda_tpu.io import cli
+
+    rc = cli.run(["--mesh", spec, "--input", fixture_path("tiny")])
+    captured = capsys.readouterr()
+    assert rc == 1
+    assert captured.out == ""
+    assert "bad --mesh spec" in captured.err
+
+
+@pytest.mark.parametrize("flag", [["--journal", "/tmp/x.jsonl"], ["--retries", "2"]])
+def test_distributed_flag_conflicts_fail_before_init(flag, capsys):
+    from mpi_openmp_cuda_tpu.io import cli
+
+    rc = cli.run([*flag, "--distributed", "--input", fixture_path("tiny")])
+    captured = capsys.readouterr()
+    assert rc == 1
+    assert "cannot be combined with --distributed" in captured.err
