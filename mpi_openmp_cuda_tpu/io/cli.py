@@ -207,14 +207,15 @@ def run(argv: list[str] | None = None) -> int:
                 raise
 
     try:
+        coordinator = True
         if args.distributed:
             # Collective backends may write banners straight to fd 1 from
             # C++ (Gloo does on CPU); guard the byte-exact result stream
             # for the whole run and print results to the true stdout only.
+            # The guard must be in place before distributed init starts
+            # emitting that chatter.
             guard = guarded_stdout()
             out_stream = guard.__enter__()
-        coordinator = True
-        if args.distributed:
             with timer.phase("distributed_init"):
 
                 def _imp():

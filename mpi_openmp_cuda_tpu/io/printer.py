@@ -47,10 +47,14 @@ def guarded_stdout():
         os.dup2(2, 1)
         yield real_stdout
     finally:
-        real_stdout.flush()
-        sys.stdout.flush()
-        os.dup2(saved, 1)
-        real_stdout.close()  # closes the dup; fd 1 is restored above
+        # fd 1 must be restored even if flushing raises (e.g. BrokenPipeError
+        # when the consumer of the real stdout has gone away).
+        try:
+            real_stdout.flush()
+            sys.stdout.flush()
+        finally:
+            os.dup2(saved, 1)
+            real_stdout.close()  # closes the dup; fd 1 is restored above
 
 
 def write_json_sidecar(

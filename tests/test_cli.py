@@ -99,3 +99,34 @@ def test_invalid_character_fails_cleanly(tmp_path):
     proc = run_cli("--input", str(bad), check=False)
     assert proc.returncode == 1
     assert "invalid sequence character" in proc.stderr
+
+
+def test_guarded_stdout_restores_fd1_on_broken_pipe():
+    """A BrokenPipeError while flushing the guarded stream must still
+    restore fd 1 (printer.py cleanup ordering): afterwards fd 1 points back
+    at the original (broken) pipe, not at stderr."""
+    code = (
+        "import os, sys\n"
+        "from mpi_openmp_cuda_tpu.io.printer import guarded_stdout\n"
+        "r, w = os.pipe()\n"
+        "os.dup2(w, 1)\n"
+        "os.close(w)\n"
+        "os.close(r)  # no reader: writes to fd 1 now raise EPIPE\n"
+        "try:\n"
+        "    with guarded_stdout() as out:\n"
+        "        out.write('x' * 70000)  # exceeds the io buffer -> EPIPE\n"
+        "except BrokenPipeError:\n"
+        "    pass\n"
+        "try:\n"
+        "    os.write(1, b'y')\n"
+        "    sys.stderr.write('FD1_NOT_RESTORED')\n"
+        "except OSError:\n"
+        "    sys.stderr.write('FD1_RESTORED')\n"
+        "sys.stderr.flush()\n"
+        "os._exit(0)\n"
+    )
+    proc = subprocess.run(
+        [sys.executable, "-c", code], capture_output=True, text=True, env=ENV
+    )
+    assert proc.returncode == 0
+    assert "FD1_RESTORED" in proc.stderr

@@ -29,7 +29,26 @@ def _free_port() -> int:
 
 
 def _launch_pair(*cli_args, stdin_path=None, coordinator_stdin=None):
-    """Run coordinator+worker; returns (proc0, proc1) CompletedProcess-like."""
+    """Run coordinator+worker; returns (proc0, proc1) CompletedProcess-like.
+
+    _free_port() is inherently TOCTOU-racy (the port is released before the
+    coordinator, seconds later, binds it); on a bind collision the pair is
+    relaunched on a fresh port.
+    """
+    last = None
+    for _ in range(3):
+        outs = _launch_pair_once(
+            *cli_args, stdin_path=stdin_path, coordinator_stdin=coordinator_stdin
+        )
+        (rc0, _, err0) = outs[0]
+        if rc0 != 0 and "address already in use" in err0.lower():
+            last = outs
+            continue
+        return outs
+    return last
+
+
+def _launch_pair_once(*cli_args, stdin_path=None, coordinator_stdin=None):
     port = _free_port()
     procs = []
     for pid in (0, 1):
