@@ -88,6 +88,11 @@ def pick_backend() -> str:
     return "xla"
 
 
+# Floor for a non-positive measured slope (sub-timer-resolution workloads);
+# consumers (scripts/bench_table.py) detect the clamp through this constant.
+STEADY_CLAMP_FLOOR = 1e-9
+
+
 def steady_state_wall(problem, backend: str, reps: int) -> float:
     """Per-run device wall-clock with host round-trip latency amortised.
 
@@ -151,7 +156,7 @@ def steady_state_wall(problem, backend: str, reps: int) -> float:
             int(f(*args))
             times.append(time.perf_counter() - t0)
         walls[k] = float(np.median(times))
-    return max(walls[1 + reps] - walls[1], 1e-9) / reps
+    return max(walls[1 + reps] - walls[1], STEADY_CLAMP_FLOOR) / reps
 
 
 def main() -> None:

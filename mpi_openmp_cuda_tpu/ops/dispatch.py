@@ -135,9 +135,13 @@ def resolve_chunks_body(backend: str, val_flat: np.ndarray):
     request with overflow-risk weights gets the exact int32 gather body —
     the same routing the production score paths apply."""
     if backend == "pallas" and mm_formulation_exact(val_flat):
-        from .pallas_scorer import score_chunks_pallas_body
+        import functools
 
-        return score_chunks_pallas_body
+        from .pallas_scorer import bf16_exact, score_chunks_pallas_body
+
+        return functools.partial(
+            score_chunks_pallas_body, bf16=bf16_exact(val_flat)
+        )
     if backend == "pallas":
         backend = "xla-gather"
     if xla_formulation_mode(backend, val_flat) == "mm":

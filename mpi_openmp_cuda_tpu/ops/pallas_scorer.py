@@ -31,6 +31,21 @@ capture.  Float32 math is exact for |weight| <= 4095 (same bound as the
 matmul path); the module transparently falls back to the XLA bodies for
 larger weights or for shape buckets that are not 128-aligned (e.g. the
 tiny-shape multi-chip dryrun).
+
+Two workload-adaptive fast paths on top of the baseline kernel:
+
+* **offset-block skip** — a pair only has valid offsets n < len1 - len2,
+  so offset blocks wholly past that bound are skipped per pair (the
+  epilogue masks their lanes anyway).  For near-Seq1-length sequences this
+  removes most of the grid; block nb=0 always runs because it carries the
+  equal-length k=0 capture.
+* **bf16 MXU feed** — when every pair value satisfies |v| <= 128, the two
+  matmul operands are fed to the MXU as bfloat16 with float32 accumulation.
+  This is *exact*: the one-hot factors are 0/1, V entries are integers
+  |v| <= 128, the delta d0-d1 is an integer of magnitude <= 256 (every
+  integer up to 2^8 is exactly representable in bf16's 8 mantissa bits),
+  and all accumulation happens in float32 (preferred_element_type), where
+  partial sums stay below 2^24.  Weights above 128 keep the f32 kernel.
 """
 
 from __future__ import annotations
@@ -51,16 +66,28 @@ _BLK = 128
 _NEG = -(2.0**40)
 _BIGROW = 1 << 30
 
+# |pair value| bound below which feeding the MXU in bfloat16 stays exact
+# (see module docstring); checked on concrete weights at dispatch time.
+MAX_BF16_EXACT_WEIGHT = 128
 
-def _kernel(len2_ref, codes_ref, a_ref, score_ref, k_ref, k0_ref, *, nbn, nbi):
+
+def bf16_exact(val_flat) -> bool:
+    """True when the bf16 MXU feed is bit-exact for this value table."""
+    import numpy as np
+
+    return int(np.abs(np.asarray(val_flat)).max()) <= MAX_BF16_EXACT_WEIGHT
+
+
+def _kernel(meta_ref, codes_ref, a_ref, score_ref, k_ref, k0_ref, *, nbn, nbi, bf16):
     """One grid cell scores one pair across all offset blocks."""
-    l2 = len2_ref[pl.program_id(0)]  # scalar-prefetch SMEM array, whole
-    a = a_ref[:]  # [128, Wneed] f32, rows >= 27 are zero
+    len1 = meta_ref[0]  # scalar-prefetch SMEM array: [len1, lens...]
+    l2 = meta_ref[1 + pl.program_id(0)]
+    mxu_t = jnp.bfloat16 if bf16 else jnp.float32
 
     ri = lax.broadcasted_iota(jnp.int32, (_BLK, 2 * _BLK), 0)
     ri1 = lax.broadcasted_iota(jnp.int32, (_BLK, _BLK), 0)
     ci1 = lax.broadcasted_iota(jnp.int32, (_BLK, _BLK), 1)
-    ltri = (ri1 >= ci1).astype(jnp.float32)
+    ltri = (ri1 >= ci1).astype(mxu_t)
 
     for nb in range(nbn):
         n0 = nb * _BLK
@@ -74,7 +101,7 @@ def _kernel(len2_ref, codes_ref, a_ref, score_ref, k_ref, k0_ref, *, nbn, nbi):
             carry, runmax, runkap, endg, t1 = car
             i0 = ib * _BLK
             codes = codes_ref[0, ib, :, :]  # [128, 1] int32, sublane-oriented
-            oh = (codes == ci1).astype(jnp.float32)  # [128, 128]
+            oh = (codes == ci1).astype(mxu_t)  # [128, 128]
             aband = a_ref[:, pl.ds(n0 + i0, 2 * _BLK)]
             vp = jnp.dot(oh, aband, preferred_element_type=jnp.float32)
             vp = jnp.where(ri < l2 - i0, vp, 0.0)  # mask chars past len2
@@ -85,7 +112,7 @@ def _kernel(len2_ref, codes_ref, a_ref, score_ref, k_ref, k0_ref, *, nbn, nbi):
                 vp = jnp.where((ri & amt) != 0, rolled, vp)
             d0 = vp[:, :_BLK]
             d1 = vp[:, 1 : _BLK + 1]
-            dd = d0 - d1
+            dd = (d0 - d1).astype(mxu_t)  # integer, |dd| <= 256: bf16-exact
             lp = jnp.dot(ltri, dd, preferred_element_type=jnp.float32)
             g = lp + carry[None, :]
             valid_row = ri1 < l2 - i0  # kappa = i0+r+1 in 1..len2
@@ -112,7 +139,19 @@ def _kernel(len2_ref, codes_ref, a_ref, score_ref, k_ref, k0_ref, *, nbn, nbi):
             zeros,
             zeros,
         )
-        carry, runmax, runkap, endg, t1 = lax.fori_loop(0, nbi, ibody, init)
+
+        def nbody():
+            return lax.fori_loop(0, nbi, ibody, init)
+
+        if nb == 0:
+            # Always runs: carries the equal-length k=0 capture at n=0.
+            carry, runmax, runkap, endg, t1 = nbody()
+        else:
+            # Offset blocks wholly past the pair's valid range
+            # (n >= len1 - len2) are dead lanes in the epilogue: skip.
+            carry, runmax, runkap, endg, t1 = lax.cond(
+                n0 < len1 - l2, nbody, lambda: init
+            )
 
         sl = (0, 0, pl.ds(n0, _BLK))
         score_ref[sl] = t1 + runmax
@@ -121,14 +160,14 @@ def _kernel(len2_ref, codes_ref, a_ref, score_ref, k_ref, k0_ref, *, nbn, nbi):
 
 
 @functools.lru_cache(maxsize=32)
-def _pallas_call(nbn: int, nbi: int, wneed: int, b: int, interpret: bool):
-    kernel = functools.partial(_kernel, nbn=nbn, nbi=nbi)
+def _pallas_call(nbn: int, nbi: int, wneed: int, b: int, interpret: bool, bf16: bool):
+    kernel = functools.partial(_kernel, nbn=nbn, nbi=nbi, bf16=bf16)
     w = nbn * _BLK
     return pl.pallas_call(
         kernel,
         interpret=interpret,
         grid_spec=pltpu.PrefetchScalarGridSpec(
-            num_scalar_prefetch=1,  # lens [B] int32, whole array in SMEM
+            num_scalar_prefetch=1,  # [1 + B] int32 [len1, lens...] in SMEM
             grid=(b,),
             in_specs=[
                 pl.BlockSpec((1, nbi, _BLK, 1), lambda p, lens: (p, 0, 0, 0)),
@@ -148,13 +187,14 @@ def _pallas_call(nbn: int, nbi: int, wneed: int, b: int, interpret: bool):
     )
 
 
-def _pallas_rows(seq1ext, len1, rows, lens, val_flat):
+def _pallas_rows(seq1ext, len1, rows, lens, val_flat, bf16=False):
     """Score a [B, L2P] padded batch with the fused kernel; returns [B, 3]."""
     b, l2p = rows.shape
     w = seq1ext.shape[0] - l2p - 1  # == L1P (offset-axis extent)
     nbn, nbi = w // _BLK, l2p // _BLK
     wneed = w + l2p  # A columns reachable by n0 + i0 + 255
 
+    mxu_t = jnp.bfloat16 if bf16 else jnp.float32
     val27 = val_flat.reshape(ALPHABET_SIZE, ALPHABET_SIZE).astype(jnp.float32)
     oh1 = (
         seq1ext[:wneed, None].astype(jnp.int32)
@@ -162,16 +202,21 @@ def _pallas_rows(seq1ext, len1, rows, lens, val_flat):
     ).astype(jnp.float32)
     a_small = lax.dot_general(
         val27, oh1, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-    )  # [27, Wneed]
-    a_ext = jnp.zeros((_BLK, wneed), jnp.float32).at[:ALPHABET_SIZE].set(a_small)
+    )  # [27, Wneed]; integer entries |v| <= 128 on the bf16 path: exact cast
+    a_ext = (
+        jnp.zeros((_BLK, wneed), jnp.float32).at[:ALPHABET_SIZE].set(a_small)
+    ).astype(mxu_t)
 
     codes = rows.astype(jnp.int32).reshape(b, nbi, _BLK, 1)
+    meta = jnp.concatenate(
+        [jnp.reshape(len1, (1,)).astype(jnp.int32), lens.astype(jnp.int32)]
+    )
 
     # Off-TPU (the 8-virtual-device CPU test mesh) the Mosaic kernel cannot
     # lower; interpret mode runs the same kernel semantics for parity tests.
     interpret = jax.default_backend() != "tpu"
-    score_n, k_n, k0_n = _pallas_call(nbn, nbi, wneed, b, interpret)(
-        lens.astype(jnp.int32), codes, a_ext
+    score_n, k_n, k0_n = _pallas_call(nbn, nbi, wneed, b, interpret, bf16)(
+        meta, codes, a_ext
     )
     score_n, k_n, k0_n = score_n[:, 0, :], k_n[:, 0, :], k0_n[:, 0, :]
 
@@ -200,10 +245,14 @@ def _shapes_supported(l1p: int, l2p: int) -> bool:
     return l1p % _BLK == 0 and l2p % _BLK == 0
 
 
-def score_chunks_pallas_body(seq1ext, len1, seq2_chunks, len2_chunks, val_flat):
+def score_chunks_pallas_body(
+    seq1ext, len1, seq2_chunks, len2_chunks, val_flat, *, bf16=False
+):
     """Chunked-batch entry, same contract as the XLA bodies:
     [NC, CB, L2P] -> [NC, CB, 3].  Falls back to the XLA matmul body for
-    non-128-aligned shape buckets (tiny problems)."""
+    non-128-aligned shape buckets (tiny problems).  ``bf16`` must only be
+    set when ``bf16_exact(val_flat)`` holds (checked at dispatch sites on
+    concrete weights; this body may be traced with abstract values)."""
     nc, cb, l2p = seq2_chunks.shape
     l1p = seq1ext.shape[0] - l2p - 1
     if not _shapes_supported(l1p, l2p):
@@ -218,15 +267,16 @@ def score_chunks_pallas_body(seq1ext, len1, seq2_chunks, len2_chunks, val_flat):
         seq2_chunks.reshape(nc * cb, l2p),
         len2_chunks.reshape(nc * cb),
         val_flat,
+        bf16=bf16,
     )
     return out.reshape(nc, cb, 3)
 
 
-score_chunks_pallas = jax.jit(score_chunks_pallas_body)
+score_chunks_pallas = jax.jit(score_chunks_pallas_body, static_argnames=("bf16",))
 
 
 @functools.lru_cache(maxsize=32)
-def pallas_pair_scorer(l1p: int, l2p: int):
+def pallas_pair_scorer(l1p: int, l2p: int, bf16: bool = False):
     """Per-shard callable for the shard_map path: (seq1ext, len1,
     rows [BL, L2P], lens [BL], val_flat) -> [BL, 3].  Cached by shape
     bucket so the shard_map jit cache stays hot."""
@@ -243,7 +293,7 @@ def pallas_pair_scorer(l1p: int, l2p: int):
                 lens.reshape(1, bl),
                 val_flat,
             ).reshape(bl, 3)
-        return _pallas_rows(seq1ext, len1, rows, lens, val_flat)
+        return _pallas_rows(seq1ext, len1, rows, lens, val_flat, bf16=bf16)
 
     return fn
 
@@ -274,4 +324,5 @@ def score_batch_pallas(batch, val_flat):
         jnp.asarray(rows.reshape(1, batch.batch_size, batch.l2p)),
         jnp.asarray(lens.reshape(1, batch.batch_size)),
         jnp.asarray(val_flat),
+        bf16=bf16_exact(val_flat),
     ).reshape(batch.batch_size, 3)

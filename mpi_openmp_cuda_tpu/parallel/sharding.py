@@ -91,7 +91,9 @@ class BatchSharding:
                     "backend 'pallas' is not available in this build"
                 ) from e
             if mm_formulation_exact(val_flat):
-                mode = ("pallas", batch.l1p, batch.l2p)
+                from ..ops.pallas_scorer import bf16_exact
+
+                mode = ("pallas", batch.l1p, batch.l2p, bf16_exact(val_flat))
             else:
                 # Same float32 bound as the matmul path: route to int32.
                 mode = ("gather",)
@@ -126,14 +128,15 @@ class BatchSharding:
 def _sharded_fn(mesh, cb, mode: tuple):
     """Build (and cache) the jitted shard_map scorer for one mesh/chunk
     config; jit itself then caches per input-shape bucket.  ``mode`` is a
-    hashable formulation key — ('mm',), ('gather',) or ('pallas', l1p, l2p)
-    — never a closure object, so repeated calls hit the cache."""
+    hashable formulation key — ('mm',), ('gather',) or
+    ('pallas', l1p, l2p, bf16) — never a closure object, so repeated calls
+    hit the cache."""
     import jax
 
     if mode[0] == "pallas":
         from ..ops.pallas_scorer import pallas_pair_scorer
 
-        pair_like = pallas_pair_scorer(mode[1], mode[2])
+        pair_like = pallas_pair_scorer(mode[1], mode[2], mode[3])
         chunks_body = None
     elif mode[0] == "mm":
         from ..ops.matmul_scorer import score_chunks_mm_body as chunks_body

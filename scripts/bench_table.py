@@ -92,9 +92,9 @@ def measure(problem: Problem, backend: str, reps: int = 32):
         "steady_wall": steady,
         "e2e_wall": e2e,
         "eps": elements / steady,
-        # steady_state_wall clamps a <=0 slope to 1e-9/reps: per-run device
-        # time below timer resolution.
-        "clamped": steady <= 2e-9 / reps,
+        # steady_state_wall clamps a <=0 slope to its floor/reps: per-run
+        # device time below timer resolution.
+        "clamped": steady <= 2 * bench.STEADY_CLAMP_FLOOR / reps,
     }
 
 

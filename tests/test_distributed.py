@@ -36,10 +36,18 @@ def _launch_pair(*cli_args, stdin_path=None, coordinator_stdin=None):
     relaunched on a fresh port.
     """
     last = None
-    for _ in range(3):
-        outs = _launch_pair_once(
-            *cli_args, stdin_path=stdin_path, coordinator_stdin=coordinator_stdin
-        )
+    for attempt in range(3):
+        try:
+            outs = _launch_pair_once(
+                *cli_args, stdin_path=stdin_path, coordinator_stdin=coordinator_stdin
+            )
+        except subprocess.TimeoutExpired:
+            # A lost port race can also strand the worker on a foreign
+            # coordinator that won the port: it hangs instead of failing.
+            if attempt == 2:
+                raise
+            last = None
+            continue
         (rc0, _, err0) = outs[0]
         if rc0 != 0 and "address already in use" in err0.lower():
             last = outs

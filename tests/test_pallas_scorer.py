@@ -95,6 +95,42 @@ def test_pallas_sharded_huge_weights_exact():
     assert [tuple(int(x) for x in row) for row in got] == want
 
 
+@pytest.mark.parametrize("wmax", [128, 129])
+def test_pallas_bf16_gate_boundary(wmax):
+    # max|weight| == 128 rides the bf16 MXU feed; 129 stays on the f32
+    # kernel.  Both must be bit-exact against the oracle.
+    from mpi_openmp_cuda_tpu.ops.pallas_scorer import bf16_exact
+    from mpi_openmp_cuda_tpu.ops.values import value_table
+
+    weights = [wmax, 2, 3, 4]
+    assert bf16_exact(value_table(weights).reshape(-1)) == (wmax <= 128)
+    rng = np.random.default_rng(7)
+    seq1 = rng.integers(1, 27, size=260).astype(np.int8)
+    seqs = [
+        rng.integers(1, 27, size=int(rng.integers(1, 255))).astype(np.int8)
+        for _ in range(6)
+    ]
+    got = _score(seq1, seqs, weights)
+    want = [prefix_best(seq1, s, weights) for s in seqs]
+    assert [tuple(int(x) for x in row) for row in got] == want
+
+
+def test_pallas_offset_block_skip_near_equal_lengths():
+    # len2 close to len1 leaves valid offsets only in block nb=0; every
+    # other offset block is skipped per pair.  Cover the block-boundary
+    # cases len1 - len2 in {1, 127, 128, 129} plus equal length.
+    rng = np.random.default_rng(13)
+    len1 = 384  # 3 offset blocks
+    seq1 = rng.integers(1, 27, size=len1).astype(np.int8)
+    seqs = [
+        rng.integers(1, 27, size=len1 - d).astype(np.int8)
+        for d in (0, 1, 127, 128, 129, 256, 383)
+    ]
+    got = _score(seq1, seqs, W)
+    want = [prefix_best(seq1, s, W) for s in seqs]
+    assert [tuple(int(x) for x in row) for row in got] == want
+
+
 def test_pallas_sharded_matches_local():
     from mpi_openmp_cuda_tpu.parallel.sharding import BatchSharding
 
