@@ -107,7 +107,10 @@ def mm_formulation_exact(val_flat: np.ndarray) -> bool:
     matmul path (|score| <= BUF_SIZE_SEQ2 * max|value| < 2^24)."""
     from .matmul_scorer import MAX_EXACT_WEIGHT
 
-    return int(np.abs(np.asarray(val_flat)).max()) <= MAX_EXACT_WEIGHT
+    # int64: abs(int32 min) would wrap negative and mis-enable the gate.
+    return (
+        int(np.abs(np.asarray(val_flat, dtype=np.int64)).max()) <= MAX_EXACT_WEIGHT
+    )
 
 
 def xla_formulation_mode(backend: str, val_flat: np.ndarray) -> str:

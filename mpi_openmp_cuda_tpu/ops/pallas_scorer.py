@@ -9,12 +9,19 @@ delta-formulation pipeline so V never leaves VMEM:
   per pair (grid cell), per (offset-block nb, char-block ib) 128x128 tile:
     onehot(seq2 block)            [128, 128]   broadcast compare, VPU
     V tile = onehot @ A band      [128, 256]   MXU (A = val @ onehot(seq1).T,
-                                               rows padded 27 -> 128)
-    shear row r left by r         7x (roll + select), VPU  (the pad/reshape
-                                               trick is not lowerable in
-                                               Mosaic; log2(128) uniform
-                                               rolls implement the per-row
-                                               shift instead)
+                                               rows padded 27 -> 128, stored
+                                               lane-REVERSED)
+    shear row r left by r         ONE tpu.dynamic_rotate with stride=1 over
+                                               the row axis.  Mosaic's
+                                               strided rotate caps the
+                                               per-vreg shift at the 128
+                                               lane width and only rotates
+                                               one direction, so the kernel
+                                               runs in reversed lane
+                                               orientation end to end (A
+                                               pre-reversed host-side; the
+                                               XLA epilogue un-reverses each
+                                               128-lane offset block)
     dD = d0 - d1; block prefix    ltri128 @ dD on the MXU
     streaming carries             prefix carry, running (max, first-kappa),
                                   G[len2] capture, t1 totals — all lane
@@ -75,7 +82,11 @@ def bf16_exact(val_flat) -> bool:
     """True when the bf16 MXU feed is bit-exact for this value table."""
     import numpy as np
 
-    return int(np.abs(np.asarray(val_flat)).max()) <= MAX_BF16_EXACT_WEIGHT
+    # int64: abs(int32 min) would wrap negative and mis-enable the gate.
+    return (
+        int(np.abs(np.asarray(val_flat, dtype=np.int64)).max())
+        <= MAX_BF16_EXACT_WEIGHT
+    )
 
 
 def _kernel(meta_ref, codes_ref, a_ref, score_ref, k_ref, k0_ref, *, nbn, nbi, bf16):
@@ -84,7 +95,6 @@ def _kernel(meta_ref, codes_ref, a_ref, score_ref, k_ref, k0_ref, *, nbn, nbi, b
     l2 = meta_ref[1 + pl.program_id(0)]
     mxu_t = jnp.bfloat16 if bf16 else jnp.float32
 
-    ri = lax.broadcasted_iota(jnp.int32, (_BLK, 2 * _BLK), 0)
     ri1 = lax.broadcasted_iota(jnp.int32, (_BLK, _BLK), 0)
     ci1 = lax.broadcasted_iota(jnp.int32, (_BLK, _BLK), 1)
     ltri = (ri1 >= ci1).astype(mxu_t)
@@ -102,16 +112,24 @@ def _kernel(meta_ref, codes_ref, a_ref, score_ref, k_ref, k0_ref, *, nbn, nbi, b
             i0 = ib * _BLK
             codes = codes_ref[0, ib, :, :]  # [128, 1] int32, sublane-oriented
             oh = (codes == ci1).astype(mxu_t)  # [128, 128]
-            aband = a_ref[:, pl.ds(n0 + i0, 2 * _BLK)]
+            wneed = a_ref.shape[1]
+            # A is stored lane-reversed: this band covers original columns
+            # [n0+i0, n0+i0+256) in descending order.
+            astart = pl.multiple_of(wneed - (n0 + i0) - 2 * _BLK, _BLK)
+            aband = a_ref[:, pl.ds(astart, 2 * _BLK)]
+            # No explicit pad mask: row/col 0 of the value table are zeroed
+            # host-side (code 0 appears only as padding), so padded seq2
+            # chars and seq1 positions past len1 contribute exactly 0
+            # through the matmul itself.
             vp = jnp.dot(oh, aband, preferred_element_type=jnp.float32)
-            vp = jnp.where(ri < l2 - i0, vp, 0.0)  # mask chars past len2
-            # Shear: roll row r left by r, one bit at a time.
-            for b in range(7):
-                amt = 1 << b
-                rolled = pltpu.roll(vp, shift=2 * _BLK - amt, axis=1)
-                vp = jnp.where((ri & amt) != 0, rolled, vp)
-            d0 = vp[:, :_BLK]
-            d1 = vp[:, 1 : _BLK + 1]
+            # Shear row r left by r = strided rotate right by r on the
+            # reversed lanes; one hardware op replaces the 7-step
+            # roll+select ladder.  Rows use only lanes j >= r, so the
+            # rotate's wraparound never contaminates a consumed lane.
+            vp = pltpu.roll(vp, shift=0, axis=1, stride=1, stride_axis=0)
+            # Reversed-lane diagonals: lane m holds offset n = 127 - m.
+            d0 = vp[:, _BLK:]
+            d1 = vp[:, _BLK - 1 : 2 * _BLK - 1]
             dd = (d0 - d1).astype(mxu_t)  # integer, |dd| <= 256: bf16-exact
             lp = jnp.dot(ltri, dd, preferred_element_type=jnp.float32)
             g = lp + carry[None, :]
@@ -196,6 +214,10 @@ def _pallas_rows(seq1ext, len1, rows, lens, val_flat, bf16=False):
 
     mxu_t = jnp.bfloat16 if bf16 else jnp.float32
     val27 = val_flat.reshape(ALPHABET_SIZE, ALPHABET_SIZE).astype(jnp.float32)
+    # Code 0 appears only as padding (real chars encode to 1..26): zeroing
+    # its row/column makes padded positions self-masking inside the kernel's
+    # matmul, so the kernel needs no per-tile pad select.
+    val27 = val27.at[0, :].set(0.0).at[:, 0].set(0.0)
     oh1 = (
         seq1ext[:wneed, None].astype(jnp.int32)
         == jnp.arange(ALPHABET_SIZE, dtype=jnp.int32)[None, :]
@@ -203,8 +225,12 @@ def _pallas_rows(seq1ext, len1, rows, lens, val_flat, bf16=False):
     a_small = lax.dot_general(
         val27, oh1, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
     )  # [27, Wneed]; integer entries |v| <= 128 on the bf16 path: exact cast
+    # Lane-reversed storage: the kernel's strided-rotate shear only turns
+    # one way (see _kernel).
     a_ext = (
-        jnp.zeros((_BLK, wneed), jnp.float32).at[:ALPHABET_SIZE].set(a_small)
+        jnp.zeros((_BLK, wneed), jnp.float32)
+        .at[:ALPHABET_SIZE]
+        .set(a_small[:, ::-1])
     ).astype(mxu_t)
 
     codes = rows.astype(jnp.int32).reshape(b, nbi, _BLK, 1)
@@ -218,7 +244,12 @@ def _pallas_rows(seq1ext, len1, rows, lens, val_flat, bf16=False):
     score_n, k_n, k0_n = _pallas_call(nbn, nbi, wneed, b, interpret, bf16)(
         meta, codes, a_ext
     )
-    score_n, k_n, k0_n = score_n[:, 0, :], k_n[:, 0, :], k0_n[:, 0, :]
+
+    def unrev(x):
+        # Kernel lanes are reversed within each 128-lane offset block.
+        return x[:, 0, :].reshape(b, nbn, _BLK)[:, :, ::-1].reshape(b, w)
+
+    score_n, k_n, k0_n = unrev(score_n), unrev(k_n), unrev(k0_n)
 
     # Tiny [B, NOFF] epilogue in XLA: offset validity, first-max argmax,
     # equal-length / unsearchable selection.
