@@ -131,6 +131,26 @@ def test_pallas_offset_block_skip_near_equal_lengths():
     assert [tuple(int(x) for x in row) for row in got] == want
 
 
+def test_pallas_bucket_l2p_exceeds_l1p():
+    # A long unsearchable candidate (len2 > len1) forces a bucket with
+    # L2P (1152) much larger than L1P (256): nbn=2 offset blocks, nbi=9
+    # char blocks, and the A band slice walking the far end of the
+    # reversed layout.  Searchable pairs in the same bucket must still be
+    # exact, and the overlong one yields the reference sentinel.
+    rng = np.random.default_rng(17)
+    seq1 = rng.integers(1, 27, size=130).astype(np.int8)
+    seqs = [
+        rng.integers(1, 27, size=1100).astype(np.int8),  # > len1: sentinel
+        rng.integers(1, 27, size=100).astype(np.int8),
+        rng.integers(1, 27, size=130).astype(np.int8),  # equal length
+        rng.integers(1, 27, size=1).astype(np.int8),
+    ]
+    got = _score(seq1, seqs, W)
+    assert tuple(got[0]) == (INT32_MIN, 0, 0)
+    for row, s in zip(got[1:], seqs[1:]):
+        assert tuple(int(x) for x in row) == prefix_best(seq1, s, W)
+
+
 def test_pallas_sharded_matches_local():
     from mpi_openmp_cuda_tpu.parallel.sharding import BatchSharding
 
