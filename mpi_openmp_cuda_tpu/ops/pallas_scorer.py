@@ -205,8 +205,14 @@ def _pallas_call(nbn: int, nbi: int, wneed: int, b: int, interpret: bool, bf16: 
     )
 
 
-def _pallas_rows(seq1ext, len1, rows, lens, val_flat, bf16=False):
-    """Score a [B, L2P] padded batch with the fused kernel; returns [B, 3]."""
+def _pallas_offset_surfaces(seq1ext, len1, rows, lens, val_flat, bf16=False):
+    """Run the fused kernel; returns the raw per-offset surfaces
+    ``(score_n, k_n, k0_n)``, each ``[B, W]`` (W = offset-axis extent), in
+    standard lane orientation.  ``score_n[b, n]`` is the best score over all
+    mutants k at offset n (k=0 included), ``k_n`` the first-hit best k with
+    the k=0-wins-ties rule, ``k0_n`` the k=0 score.  No offset-validity
+    masking is applied here — callers mask with their own ``len1`` view
+    (the ring path passes a block-local effective len1)."""
     b, l2p = rows.shape
     w = seq1ext.shape[0] - l2p - 1  # == L1P (offset-axis extent)
     nbn, nbi = w // _BLK, l2p // _BLK
@@ -249,7 +255,16 @@ def _pallas_rows(seq1ext, len1, rows, lens, val_flat, bf16=False):
         # Kernel lanes are reversed within each 128-lane offset block.
         return x[:, 0, :].reshape(b, nbn, _BLK)[:, :, ::-1].reshape(b, w)
 
-    score_n, k_n, k0_n = unrev(score_n), unrev(k_n), unrev(k0_n)
+    return unrev(score_n), unrev(k_n), unrev(k0_n)
+
+
+def _pallas_rows(seq1ext, len1, rows, lens, val_flat, bf16=False):
+    """Score a [B, L2P] padded batch with the fused kernel; returns [B, 3]."""
+    b, l2p = rows.shape
+    w = seq1ext.shape[0] - l2p - 1
+    score_n, k_n, k0_n = _pallas_offset_surfaces(
+        seq1ext, len1, rows, lens, val_flat, bf16=bf16
+    )
 
     # Tiny [B, NOFF] epilogue in XLA: offset validity, first-max argmax,
     # equal-length / unsearchable selection.

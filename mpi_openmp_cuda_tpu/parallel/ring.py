@@ -80,22 +80,36 @@ class RingSharding:
     ) -> np.ndarray:
         """Returns [B, 3] int32 host array, input order.
 
-        The ring path has a single (gather) formulation — the window
-        assembly, not the per-cell lookup, is what it exists for — so only
-        the default 'xla' family is accepted; asking for 'pallas'/'oracle'
-        here fails fast rather than silently running something else.
+        Formulations: the XLA gather path (always available) and the fused
+        Pallas kernel run per shard on its ring-assembled window
+        ('pallas'; falls back to gather for overflow-risk weights or
+        non-128-aligned shape buckets, mirroring the batch-sharded path).
+        'oracle' fails fast rather than silently running something else.
         """
-        if backend not in ("xla", "xla-gather"):
+        if backend not in ("xla", "xla-gather", "pallas"):
             raise ValueError(
                 f"backend {backend!r} is not available on the sequence-parallel "
-                "ring path (it has a single XLA formulation); drop --backend "
-                "or use a batch-only mesh"
+                "ring path; drop --backend or use a batch-only mesh"
             )
         import jax.numpy as jnp
 
+        from ..ops.dispatch import mm_formulation_exact
+
+        mode: tuple = ("gather",)
+        if backend == "pallas":
+            try:
+                from ..ops.pallas_scorer import bf16_exact
+            except ModuleNotFoundError as e:
+                raise RuntimeError(
+                    "backend 'pallas' is not available in this build"
+                ) from e
+            if mm_formulation_exact(val_flat) and batch.l2p % 128 == 0:
+                mode = ("pallas", bf16_exact(val_flat))
+
         sp, dp = self.sp, self.dp
-        # Per-device offset-block size: sublane-aligned so the grid tiles.
-        bs = round_up(math.ceil(batch.l1p / sp), 8)
+        # Per-device offset-block size: sublane-aligned so the grid tiles
+        # (full 128-lane alignment for the Pallas kernel).
+        bs = round_up(math.ceil(batch.l1p / sp), 128 if mode[0] == "pallas" else 8)
 
         seq1pad = np.zeros(sp * bs, dtype=np.int32)
         take = min(seq1pad.size, batch.seq1ext.size)
@@ -117,15 +131,16 @@ class RingSharding:
         val_d = _put_global(
             np.asarray(val_flat, dtype=np.int32), NamedSharding(self.mesh, P())
         )
-        out = _ring_fn(self.mesh, bs, batch.l2p, cb)(
+        out = _ring_fn(self.mesh, bs, batch.l2p, cb, mode)(
             seq1_d, jnp.int32(batch.len1), rows_d, lens_d, val_d
         )
         return _fetch_global(out)[:b]
 
 
 @functools.lru_cache(maxsize=32)
-def _ring_fn(mesh, bs, l2p, cb):
-    """Jitted shard_map ring scorer for one (mesh, Bs, L2P, chunk) config."""
+def _ring_fn(mesh, bs, l2p, cb, mode: tuple = ("gather",)):
+    """Jitted shard_map ring scorer for one (mesh, Bs, L2P, chunk,
+    formulation) config.  ``mode`` is ('gather',) or ('pallas', bf16)."""
     import jax
     import jax.numpy as jnp
     from jax import lax
@@ -148,44 +163,73 @@ def _ring_fn(mesh, bs, l2p, cb):
             blk = lax.ppermute(blk, SEQ_AXIS, perm)
             win = lax.dynamic_update_slice(win, blk, (r * bs,))
 
-        n_local = jnp.arange(bs, dtype=jnp.int32)[:, None]
-        i = jnp.arange(l2p, dtype=jnp.int32)[None, :]
-        idx0 = n_local + i
-        g0 = jnp.take(win, idx0)
-        g1 = jnp.take(win, idx0 + 1)
-        kk = jnp.arange(l2p, dtype=jnp.int32)[None, :]
-        gn = d * bs + n_local
-
-        def pair_candidate(row, len2):
-            pair_base = row[None, :].astype(jnp.int32) * ALPHABET_SIZE
-            charmask = i < len2
-            v0 = jnp.where(charmask, jnp.take(val_flat, pair_base + g0), 0)
-            v1 = jnp.where(charmask, jnp.take(val_flat, pair_base + g1), 0)
-            c0 = jnp.cumsum(v0, axis=1)
-            c1 = jnp.cumsum(v1, axis=1)
-            t0 = c0[:, -1:]
-            t1 = c1[:, -1:]
-            scores = jnp.concatenate(
-                [t0, c0[:, :-1] + (t1 - c1[:, :-1])], axis=1
-            )
-            valid = (gn < jnp.maximum(len1 - len2, 0)) & (
-                (kk == 0) | (kk < len2)
-            )
-            flat = jnp.where(valid, scores, neg).reshape(-1)
-            bi = jnp.argmax(flat).astype(jnp.int32)
-            # eq: positional score at global n=0 — real only on device 0.
-            return jnp.stack(
-                [flat[bi], d * bs + bi // l2p, bi % l2p, c0[0, -1]]
-            )
-
-        def chunk_fn(args):
-            rows_c, lens_c = args
-            return jax.vmap(pair_candidate)(rows_c, lens_c)
-
         bl = rows.shape[0]
-        cand = lax.map(
-            chunk_fn, (rows.reshape(bl // cb, cb, l2p), lens.reshape(bl // cb, cb))
-        ).reshape(bl, 4)
+        if mode[0] == "pallas":
+            # Fused-kernel formulation: the shard's window is a
+            # self-contained Seq1 for the kernel; a block-local effective
+            # len1 makes its offset-block skip and the validity mask agree
+            # with the global bound gn < len1 - len2.
+            from ..ops.pallas_scorer import _NEG, _pallas_offset_surfaces
+
+            win_k = win[: bs + l2p + 1]
+            len1_eff = len1 - d * bs
+            score_n, k_n, k0_n = _pallas_offset_surfaces(
+                win_k, len1_eff, rows, lens, val_flat, bf16=mode[1]
+            )
+            nn = jnp.arange(bs, dtype=jnp.int32)[None, :]
+            valid = nn < jnp.maximum(len1_eff - lens, 0)[:, None]
+            negf = jnp.float32(_NEG)
+            score_m = jnp.where(valid, score_n, negf)
+            bi = jnp.argmax(score_m, axis=1).astype(jnp.int32)
+            bv = jnp.take_along_axis(score_m, bi[:, None], axis=1)[:, 0]
+            bk = jnp.take_along_axis(k_n, bi[:, None], axis=1)[:, 0]
+            # Masked lanes carry the f32 sentinel, far below int32 range:
+            # map an all-invalid shard to INT32_MIN before the int cast.
+            sc = jnp.where(
+                bv <= jnp.float32(INT32_MIN), neg, bv.astype(jnp.int32)
+            )
+            cand = jnp.stack(
+                [sc, d * bs + bi, bk, k0_n[:, 0].astype(jnp.int32)], axis=1
+            )
+        else:
+            n_local = jnp.arange(bs, dtype=jnp.int32)[:, None]
+            i = jnp.arange(l2p, dtype=jnp.int32)[None, :]
+            idx0 = n_local + i
+            g0 = jnp.take(win, idx0)
+            g1 = jnp.take(win, idx0 + 1)
+            kk = jnp.arange(l2p, dtype=jnp.int32)[None, :]
+            gn = d * bs + n_local
+
+            def pair_candidate(row, len2):
+                pair_base = row[None, :].astype(jnp.int32) * ALPHABET_SIZE
+                charmask = i < len2
+                v0 = jnp.where(charmask, jnp.take(val_flat, pair_base + g0), 0)
+                v1 = jnp.where(charmask, jnp.take(val_flat, pair_base + g1), 0)
+                c0 = jnp.cumsum(v0, axis=1)
+                c1 = jnp.cumsum(v1, axis=1)
+                t0 = c0[:, -1:]
+                t1 = c1[:, -1:]
+                scores = jnp.concatenate(
+                    [t0, c0[:, :-1] + (t1 - c1[:, :-1])], axis=1
+                )
+                valid = (gn < jnp.maximum(len1 - len2, 0)) & (
+                    (kk == 0) | (kk < len2)
+                )
+                flat = jnp.where(valid, scores, neg).reshape(-1)
+                bi = jnp.argmax(flat).astype(jnp.int32)
+                # eq: positional score at global n=0 — real on device 0.
+                return jnp.stack(
+                    [flat[bi], d * bs + bi // l2p, bi % l2p, c0[0, -1]]
+                )
+
+            def chunk_fn(args):
+                rows_c, lens_c = args
+                return jax.vmap(pair_candidate)(rows_c, lens_c)
+
+            cand = lax.map(
+                chunk_fn,
+                (rows.reshape(bl // cb, cb, l2p), lens.reshape(bl // cb, cb)),
+            ).reshape(bl, 4)
 
         # -- global combine: tiny all_gather of one candidate per device --
         gathered = lax.all_gather(cand, SEQ_AXIS)  # [sp, bl, 4]

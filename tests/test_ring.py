@@ -127,8 +127,60 @@ def test_ring_rejects_foreign_backend():
         RingSharding.over_devices(seq=8).score(
             pad_problem(np.array([1, 2, 3], dtype=np.int8), [np.array([1], dtype=np.int8)]),
             value_table(WEIGHTS).astype(np.int32).reshape(-1),
-            backend="pallas",
+            backend="oracle",
         )
+
+
+def _score_ring_backend(seq1, seqs, weights, sp, dp, backend, **pad_kw):
+    batch = pad_problem(seq1, seqs, **pad_kw)
+    val_flat = value_table(weights).astype(np.int32).reshape(-1)
+    out = RingSharding.over_devices(seq=sp, batch=dp).score(
+        batch, val_flat, backend=backend
+    )
+    return [tuple(int(x) for x in row) for row in out]
+
+
+def test_ring_pallas_matches_oracle(rng):
+    """The fused kernel per ring shard (sp and dp x sp meshes) must be
+    bit-exact vs the oracle, including equal-length / overlong / empty."""
+    seq1 = rng.integers(1, 27, size=300).astype(np.int8)
+    seqs = _rand_seqs(rng, 5, 1, 250) + [
+        seq1.copy(),  # equal length: device 0's k0 capture
+        rng.integers(1, 27, size=350).astype(np.int8),  # > len1: INT_MIN
+        np.zeros(0, dtype=np.int8),
+    ]
+    want = _oracle(seq1, seqs)
+    assert _score_ring_backend(seq1, seqs, WEIGHTS, 4, 1, "pallas") == want
+    assert _score_ring_backend(seq1, seqs, WEIGHTS, 4, 2, "pallas") == want
+
+
+def test_ring_pallas_long_context_beyond_reference_cap(rng):
+    seq1 = rng.integers(1, 27, size=4000).astype(np.int8)
+    seqs = _rand_seqs(rng, 3, 100, 600)
+    got = _score_ring_backend(
+        seq1, seqs, WEIGHTS, 8, 1, "pallas", enforce_caps=False
+    )
+    assert got == _oracle(seq1, seqs)
+
+
+def test_ring_pallas_tiebreak_parity(rng):
+    seq1 = rng.integers(1, 3, size=200).astype(np.int8)
+    seqs = _rand_seqs(rng, 6, 1, 60, alpha=2)
+    w = [1, 1, 1, 1]
+    assert _score_ring_backend(seq1, seqs, w, 4, 1, "pallas") == [
+        prefix_best(seq1, s, w) for s in seqs
+    ]
+
+
+def test_ring_pallas_huge_weights_fall_back_exact(rng):
+    """Overflow-risk weights must route to the exact gather formulation,
+    same as the batch-sharded pallas path."""
+    seq1 = rng.integers(1, 27, size=150).astype(np.int8)
+    seqs = _rand_seqs(rng, 4, 1, 120)
+    w = [100000, 50000, 3, 4]
+    assert _score_ring_backend(seq1, seqs, w, 4, 1, "pallas") == [
+        prefix_best(seq1, s, w) for s in seqs
+    ]
 
 
 def test_ring_matches_fixture_golden():
