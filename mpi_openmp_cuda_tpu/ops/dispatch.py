@@ -113,6 +113,21 @@ def mm_formulation_exact(val_flat: np.ndarray) -> bool:
     )
 
 
+def choose_pallas_formulation(val_flat: np.ndarray, dims: tuple[int, ...]) -> tuple:
+    """The single source of the fused-kernel eligibility policy, shared by
+    the batch-sharded and ring paths: ('pallas', bf16) when float32 math is
+    exact for these weights and every dimension in ``dims`` is 128-aligned;
+    ('gather',) otherwise.  Raises the friendly RuntimeError when the pallas
+    module itself is unavailable."""
+    try:
+        from .pallas_scorer import bf16_exact
+    except ModuleNotFoundError as e:
+        raise RuntimeError("backend 'pallas' is not available in this build") from e
+    if mm_formulation_exact(val_flat) and all(d % 128 == 0 for d in dims):
+        return ("pallas", bf16_exact(val_flat))
+    return ("gather",)
+
+
 def xla_formulation_mode(backend: str, val_flat: np.ndarray) -> str:
     """'mm' or 'gather' for an 'xla*' backend string — the single source of
     truth for the formulation choice, shared by the local and sharded paths."""

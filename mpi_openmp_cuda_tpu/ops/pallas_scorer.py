@@ -99,15 +99,14 @@ def _kernel(meta_ref, codes_ref, a_ref, score_ref, k_ref, k0_ref, *, nbn, nbi, b
     ci1 = lax.broadcasted_iota(jnp.int32, (_BLK, _BLK), 1)
     ltri = (ri1 >= ci1).astype(mxu_t)
 
+    # Char-blocks wholly past len2 contribute nothing (masked rows, zero
+    # deltas, no captures): the dynamic trip count skips them entirely.
+    nbi_live = jnp.minimum((l2 + _BLK - 1) // _BLK, nbi)
+
     for nb in range(nbn):
         n0 = nb * _BLK
 
         def ibody(ib, car):
-            # Char-blocks wholly past len2 contribute nothing (masked rows,
-            # zero deltas, no captures): skip their compute entirely.
-            return lax.cond(ib * _BLK < l2, _ibody, lambda _, c: c, ib, car)
-
-        def _ibody(ib, car):
             carry, runmax, runkap, endg, t1 = car
             i0 = ib * _BLK
             codes = codes_ref[0, ib, :, :]  # [128, 1] int32, sublane-oriented
@@ -159,7 +158,7 @@ def _kernel(meta_ref, codes_ref, a_ref, score_ref, k_ref, k0_ref, *, nbn, nbi, b
         )
 
         def nbody():
-            return lax.fori_loop(0, nbi, ibody, init)
+            return lax.fori_loop(0, nbi_live, ibody, init)
 
         if nb == 0:
             # Always runs: carries the equal-length k=0 capture at n=0.

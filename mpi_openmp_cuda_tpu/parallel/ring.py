@@ -93,18 +93,12 @@ class RingSharding:
             )
         import jax.numpy as jnp
 
-        from ..ops.dispatch import mm_formulation_exact
+        from ..ops.dispatch import choose_pallas_formulation
 
         mode: tuple = ("gather",)
         if backend == "pallas":
-            try:
-                from ..ops.pallas_scorer import bf16_exact
-            except ModuleNotFoundError as e:
-                raise RuntimeError(
-                    "backend 'pallas' is not available in this build"
-                ) from e
-            if mm_formulation_exact(val_flat) and batch.l2p % 128 == 0:
-                mode = ("pallas", bf16_exact(val_flat))
+            # Bs (the kernel's L1P) is forced to a 128 multiple below.
+            mode = choose_pallas_formulation(val_flat, (batch.l2p,))
 
         sp, dp = self.sp, self.dp
         # Per-device offset-block size: sublane-aligned so the grid tiles

@@ -79,21 +79,15 @@ class BatchSharding:
         """Returns [B, 3] int32 host array, input order."""
         import jax.numpy as jnp
 
-        from ..ops.dispatch import mm_formulation_exact, xla_formulation_mode
+        from ..ops.dispatch import choose_pallas_formulation, xla_formulation_mode
 
         if backend == "pallas":
-            # Import check up front for a friendly error; the cached
-            # shard_map factory re-imports by shape key (stable identity).
-            try:
-                from ..ops import pallas_scorer  # noqa: F401
-            except ModuleNotFoundError as e:
-                raise RuntimeError(
-                    "backend 'pallas' is not available in this build"
-                ) from e
-            if mm_formulation_exact(val_flat):
-                from ..ops.pallas_scorer import bf16_exact
-
-                mode = ("pallas", batch.l1p, batch.l2p, bf16_exact(val_flat))
+            # Shared eligibility policy (exactness + import guard); shape
+            # alignment is handled per-shard by pallas_pair_scorer's own
+            # fallback, so no dims are pinned here.
+            fm = choose_pallas_formulation(val_flat, ())
+            if fm[0] == "pallas":
+                mode = ("pallas", batch.l1p, batch.l2p, fm[1])
             else:
                 # Same float32 bound as the matmul path: route to int32.
                 mode = ("gather",)

@@ -172,6 +172,30 @@ def test_ring_pallas_tiebreak_parity(rng):
     ]
 
 
+def test_ring_pallas_mode_engages(rng, monkeypatch):
+    """Guard the eligibility gate itself: an eligible batch must actually
+    reach the fused kernel — otherwise a gate regression would silently
+    route every 'pallas' ring run to the gather fallback while the parity
+    tests keep passing."""
+    import mpi_openmp_cuda_tpu.ops.pallas_scorer as ps
+
+    calls = []
+    orig = ps._pallas_offset_surfaces
+
+    def spy(*a, **k):
+        calls.append(1)
+        return orig(*a, **k)
+
+    monkeypatch.setattr(ps, "_pallas_offset_surfaces", spy)
+    # Distinctive sizes: the jitted ring fn is cached by shape, so reusing
+    # another test's bucket would skip tracing (and the spy) entirely.
+    seq1 = rng.integers(1, 27, size=333).astype(np.int8)
+    seqs = [rng.integers(1, 27, size=n).astype(np.int8) for n in (150, 170, 190)]
+    got = _score_ring_backend(seq1, seqs, WEIGHTS, 4, 1, "pallas")
+    assert calls, "eligible batch never engaged the fused kernel"
+    assert got == _oracle(seq1, seqs)
+
+
 def test_ring_pallas_huge_weights_fall_back_exact(rng):
     """Overflow-risk weights must route to the exact gather formulation,
     same as the batch-sharded pallas path."""
