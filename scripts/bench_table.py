@@ -98,14 +98,21 @@ def measure(problem: Problem, backend: str, reps: int = 32):
     }
 
 
+# A workload below this many equivalent comparisons cannot fill the chip:
+# its steady wall is the per-dispatch floor, so a throughput ratio would
+# measure launch overhead, not compute.
+LATENCY_BOUND_ELEMENTS = 10**7
+
+
 def row(config: str, hw: str, m: dict) -> str:
-    if m["clamped"]:
+    if m["clamped"] or m["elements"] < LATENCY_BOUND_ELEMENTS:
+        wall = "< 1" if m["clamped"] else f"{m['steady_wall']*1e6:.3g}"
         measured = (
-            f"latency-bound: steady wall < 1 us "
-            f"(workload {m['elements']:,} elem; e2e {m['e2e_wall']*1e3:.3g} ms "
-            f"is host-link latency)"
+            f"latency-bound: steady wall {wall} us "
+            f"dispatch floor (workload {m['elements']:,} elem; "
+            f"e2e {m['e2e_wall']*1e3:.3g} ms is host-link latency)"
         )
-        vs = "n/a (sub-resolution)"
+        vs = "n/a (latency-bound)"
     else:
         measured = (
             f"{m['eps']:.3g} elem/s/chip "
