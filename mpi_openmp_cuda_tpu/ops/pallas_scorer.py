@@ -21,7 +21,7 @@ delta-formulation pipeline so V never leaves VMEM:
                                                orientation end to end (A
                                                pre-reversed host-side; the
                                                XLA epilogue un-reverses each
-                                               128-lane offset block)
+                                               offset super-block)
     dD = d0 - d1; block prefix    ltri128 @ dD on the MXU
     streaming carries             prefix carry, running (max, first-kappa),
                                   G[len2] capture, t1 totals — all lane
@@ -89,21 +89,37 @@ def bf16_exact(val_flat) -> bool:
     )
 
 
+def _superblock(nbn: int) -> int:
+    """Offset blocks processed per inner iteration.  Adjacent offset blocks
+    share all but 128 of their A-band columns, so a wider super-block cuts
+    the one-hot matmul's MACs (band width (SB+1)*128 instead of SB*2*128)
+    and amortises per-iteration overhead; the strided rotate's shift stays
+    the row index <= 127, within Mosaic's per-vreg cap, at any width.
+    Bounded at 4 so the dead-offset skip keeps useful granularity."""
+    for cand in (4, 2):
+        if nbn % cand == 0:
+            return cand
+    return 1
+
+
 def _kernel(meta_ref, codes_ref, a_ref, score_ref, k_ref, k0_ref, *, nbn, nbi, bf16):
-    """One grid cell scores one pair across all offset blocks."""
+    """One grid cell scores one pair across all offset super-blocks."""
     len1 = meta_ref[0]  # scalar-prefetch SMEM array: [len1, lens...]
     l2 = meta_ref[1 + pl.program_id(0)]
     mxu_t = jnp.bfloat16 if bf16 else jnp.float32
+    sb = _superblock(nbn)
+    sbw = sb * _BLK  # offset lanes per super-block
 
     ri1 = lax.broadcasted_iota(jnp.int32, (_BLK, _BLK), 0)
     ci1 = lax.broadcasted_iota(jnp.int32, (_BLK, _BLK), 1)
+    riw = lax.broadcasted_iota(jnp.int32, (_BLK, sbw), 0)
     ltri = (ri1 >= ci1).astype(mxu_t)
 
     # Char-blocks wholly past len2 contribute nothing (masked rows, zero
     # deltas, no captures): the dynamic trip count skips them entirely.
     nbi_live = jnp.minimum((l2 + _BLK - 1) // _BLK, nbi)
 
-    for nb in range(nbn):
+    for nb in range(0, nbn, sb):
         n0 = nb * _BLK
 
         def ibody(ib, car):
@@ -113,9 +129,9 @@ def _kernel(meta_ref, codes_ref, a_ref, score_ref, k_ref, k0_ref, *, nbn, nbi, b
             oh = (codes == ci1).astype(mxu_t)  # [128, 128]
             wneed = a_ref.shape[1]
             # A is stored lane-reversed: this band covers original columns
-            # [n0+i0, n0+i0+256) in descending order.
-            astart = pl.multiple_of(wneed - (n0 + i0) - 2 * _BLK, _BLK)
-            aband = a_ref[:, pl.ds(astart, 2 * _BLK)]
+            # [n0+i0, n0+i0+sbw+128) in descending order.
+            astart = pl.multiple_of(wneed - (n0 + i0) - (sbw + _BLK), _BLK)
+            aband = a_ref[:, pl.ds(astart, sbw + _BLK)]
             # No explicit pad mask: row/col 0 of the value table are zeroed
             # host-side (code 0 appears only as padding), so padded seq2
             # chars and seq1 positions past len1 contribute exactly 0
@@ -126,33 +142,33 @@ def _kernel(meta_ref, codes_ref, a_ref, score_ref, k_ref, k0_ref, *, nbn, nbi, b
             # roll+select ladder.  Rows use only lanes j >= r, so the
             # rotate's wraparound never contaminates a consumed lane.
             vp = pltpu.roll(vp, shift=0, axis=1, stride=1, stride_axis=0)
-            # Reversed-lane diagonals: lane m holds offset n = 127 - m.
+            # Reversed-lane diagonals: lane m holds offset n0 + sbw-1-m.
             d0 = vp[:, _BLK:]
-            d1 = vp[:, _BLK - 1 : 2 * _BLK - 1]
+            d1 = vp[:, _BLK - 1 : sbw + _BLK - 1]
             dd = (d0 - d1).astype(mxu_t)  # integer, |dd| <= 256: bf16-exact
             lp = jnp.dot(ltri, dd, preferred_element_type=jnp.float32)
             g = lp + carry[None, :]
-            valid_row = ri1 < l2 - i0  # kappa = i0+r+1 in 1..len2
+            valid_row = riw < l2 - i0  # kappa = i0+r+1 in 1..len2
             gm = jnp.where(valid_row, g, _NEG)
-            bmax = jnp.max(gm, axis=0)  # [128]
+            bmax = jnp.max(gm, axis=0)  # [sbw]
             brow = jnp.min(
-                jnp.where(gm == bmax[None, :], ri1, _BIGROW), axis=0
+                jnp.where(gm == bmax[None, :], riw, _BIGROW), axis=0
             )
             upd = bmax > runmax
             runmax = jnp.where(upd, bmax, runmax)
             runkap = jnp.where(upd, i0 + brow + 1, runkap)
             endg = endg + jnp.sum(
-                jnp.where(ri1 == l2 - 1 - i0, g, 0.0), axis=0
+                jnp.where(riw == l2 - 1 - i0, g, 0.0), axis=0
             )
             t1 = t1 + jnp.sum(d1, axis=0)
             carry = carry + lp[_BLK - 1, :]
             return carry, runmax, runkap, endg, t1
 
-        zeros = jnp.zeros((_BLK,), jnp.float32)
+        zeros = jnp.zeros((sbw,), jnp.float32)
         init = (
             zeros,
-            jnp.full((_BLK,), _NEG),
-            jnp.zeros((_BLK,), jnp.int32),
+            jnp.full((sbw,), _NEG),
+            jnp.zeros((sbw,), jnp.int32),
             zeros,
             zeros,
         )
@@ -164,13 +180,13 @@ def _kernel(meta_ref, codes_ref, a_ref, score_ref, k_ref, k0_ref, *, nbn, nbi, b
             # Always runs: carries the equal-length k=0 capture at n=0.
             carry, runmax, runkap, endg, t1 = nbody()
         else:
-            # Offset blocks wholly past the pair's valid range
+            # Super-blocks wholly past the pair's valid range
             # (n >= len1 - len2) are dead lanes in the epilogue: skip.
             carry, runmax, runkap, endg, t1 = lax.cond(
                 n0 < len1 - l2, nbody, lambda: init
             )
 
-        sl = (0, 0, pl.ds(n0, _BLK))
+        sl = (0, 0, pl.ds(n0, sbw))
         score_ref[sl] = t1 + runmax
         k_ref[sl] = jnp.where(endg == runmax, 0, runkap)  # k=0 wins ties
         k0_ref[sl] = t1 + endg
@@ -215,7 +231,7 @@ def _pallas_offset_surfaces(seq1ext, len1, rows, lens, val_flat, bf16=False):
     b, l2p = rows.shape
     w = seq1ext.shape[0] - l2p - 1  # == L1P (offset-axis extent)
     nbn, nbi = w // _BLK, l2p // _BLK
-    wneed = w + l2p  # A columns reachable by n0 + i0 + 255
+    wneed = w + l2p  # A columns reachable by n0 + i0 + sbw + 127
 
     mxu_t = jnp.bfloat16 if bf16 else jnp.float32
     val27 = val_flat.reshape(ALPHABET_SIZE, ALPHABET_SIZE).astype(jnp.float32)
@@ -250,9 +266,11 @@ def _pallas_offset_surfaces(seq1ext, len1, rows, lens, val_flat, bf16=False):
         meta, codes, a_ext
     )
 
+    sbw = _superblock(nbn) * _BLK
+
     def unrev(x):
-        # Kernel lanes are reversed within each 128-lane offset block.
-        return x[:, 0, :].reshape(b, nbn, _BLK)[:, :, ::-1].reshape(b, w)
+        # Kernel lanes are reversed within each offset super-block.
+        return x[:, 0, :].reshape(b, w // sbw, sbw)[:, :, ::-1].reshape(b, w)
 
     return unrev(score_n), unrev(k_n), unrev(k0_n)
 
