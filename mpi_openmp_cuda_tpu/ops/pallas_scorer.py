@@ -95,8 +95,10 @@ def _superblock(nbn: int) -> int:
     the one-hot matmul's MACs (band width (SB+1)*128 instead of SB*2*128)
     and amortises per-iteration overhead; the strided rotate's shift stays
     the row index <= 127, within Mosaic's per-vreg cap, at any width.
-    Bounded at 4 so the dead-offset skip keeps useful granularity."""
-    for cand in (4, 2):
+    Bounded at 8 — wider still trades away the dead-offset skip's
+    granularity faster than it saves MACs (the band-sharing saving is
+    (SB+1)/SB, already within 12% of its limit at SB=8)."""
+    for cand in (8, 6, 4, 2):
         if nbn % cand == 0:
             return cand
     return 1

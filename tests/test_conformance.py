@@ -58,11 +58,17 @@ def _problems(rng):
     # both the 8-device dp mesh and the 2x4 mesh).
     seq1b = rng.integers(1, 3, size=180).astype(np.int8)
     out.append((seq1b, [rng.integers(1, 3, size=n).astype(np.int8) for n in (7, 30, 64, 120, 179)]))
-    # Bucket C: len1 ~ 450 -> l1p = 512, the widest (sb=4) Pallas
-    # super-block; candidate lengths straddle its skip boundaries.
+    # Bucket C: len1 ~ 450 -> l1p = 512 (sb=4 Pallas super-block);
+    # candidate lengths straddle its skip boundaries.
     seq1c = rng.integers(1, 27, size=450).astype(np.int8)
     out.append(
         (seq1c, [rng.integers(1, 27, size=n).astype(np.int8) for n in (40, 200, 330, 449)])
+    )
+    # Bucket D: len1 ~ 1000 -> l1p = 1024 (nbn=8: the widest sb=8
+    # super-block); short candidates keep the interpret-mode cost low.
+    seq1d = rng.integers(1, 27, size=1000).astype(np.int8)
+    out.append(
+        (seq1d, [rng.integers(1, 27, size=n).astype(np.int8) for n in (25, 100, 400)])
     )
     return out
 

@@ -131,6 +131,20 @@ def test_pallas_offset_block_skip_near_equal_lengths():
     assert [tuple(int(x) for x in row) for row in got] == want
 
 
+def test_pallas_superblock_six():
+    # len1 ~ 700 -> l1p = 768, nbn = 6: the sb=6 super-block branch (a
+    # non-power-of-two 896-lane band).  input3 exercises it on hardware;
+    # this keeps it covered in the interpret-mode suite too.
+    rng = np.random.default_rng(23)
+    seq1 = rng.integers(1, 27, size=700).astype(np.int8)
+    seqs = [
+        rng.integers(1, 27, size=n).astype(np.int8) for n in (30, 120, 640, 699)
+    ]
+    got = _score(seq1, seqs, W)
+    want = [prefix_best(seq1, s, W) for s in seqs]
+    assert [tuple(int(x) for x in row) for row in got] == want
+
+
 def test_pallas_bucket_l2p_exceeds_l1p():
     # A long unsearchable candidate (len2 > len1) forces a bucket with
     # L2P (1152) much larger than L1P (256): nbn=2 offset blocks, nbi=9
