@@ -11,6 +11,7 @@ internally to bound live memory.
 
 from __future__ import annotations
 
+import functools
 import math
 from dataclasses import dataclass
 
@@ -106,11 +107,9 @@ def mm_formulation_exact(val_flat: np.ndarray) -> bool:
     """True when every partial sum stays an exact float32 integer on the
     matmul path (|score| <= BUF_SIZE_SEQ2 * max|value| < 2^24)."""
     from .matmul_scorer import MAX_EXACT_WEIGHT
+    from .values import max_abs_value
 
-    # int64: abs(int32 min) would wrap negative and mis-enable the gate.
-    return (
-        int(np.abs(np.asarray(val_flat, dtype=np.int64)).max()) <= MAX_EXACT_WEIGHT
-    )
+    return max_abs_value(val_flat) <= MAX_EXACT_WEIGHT
 
 
 def choose_pallas_formulation(val_flat: np.ndarray, dims: tuple[int, ...]) -> tuple:
@@ -152,15 +151,12 @@ def resolve_chunks_body(backend: str, val_flat: np.ndarray):
     composition), including the float32-exactness fallback: a 'pallas'
     request with overflow-risk weights gets the exact int32 gather body —
     the same routing the production score paths apply."""
-    if backend == "pallas" and mm_formulation_exact(val_flat):
-        import functools
-
-        from .pallas_scorer import bf16_exact, score_chunks_pallas_body
-
-        return functools.partial(
-            score_chunks_pallas_body, bf16=bf16_exact(val_flat)
-        )
     if backend == "pallas":
+        fm = choose_pallas_formulation(val_flat, ())
+        if fm[0] == "pallas":
+            from .pallas_scorer import score_chunks_pallas_body
+
+            return functools.partial(score_chunks_pallas_body, bf16=fm[1])
         backend = "xla-gather"
     if xla_formulation_mode(backend, val_flat) == "mm":
         from .matmul_scorer import score_chunks_mm_body

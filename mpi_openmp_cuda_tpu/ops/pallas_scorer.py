@@ -80,13 +80,9 @@ MAX_BF16_EXACT_WEIGHT = 128
 
 def bf16_exact(val_flat) -> bool:
     """True when the bf16 MXU feed is bit-exact for this value table."""
-    import numpy as np
+    from .values import max_abs_value
 
-    # int64: abs(int32 min) would wrap negative and mis-enable the gate.
-    return (
-        int(np.abs(np.asarray(val_flat, dtype=np.int64)).max())
-        <= MAX_BF16_EXACT_WEIGHT
-    )
+    return max_abs_value(val_flat) <= MAX_BF16_EXACT_WEIGHT
 
 
 def _superblock(nbn: int) -> int:

@@ -28,3 +28,9 @@ def signed_weights(weights) -> np.ndarray:
 def value_table(weights) -> np.ndarray:
     """[27, 27] int32 table of signed pair values for the given weights."""
     return signed_weights(weights)[build_class_matrix()]
+
+
+def max_abs_value(val_flat) -> int:
+    """Largest |entry| of a value table, for the float exactness gates.
+    int64: abs(int32 min) would wrap negative and mis-enable a gate."""
+    return int(np.abs(np.asarray(val_flat, dtype=np.int64)).max())
