@@ -18,7 +18,7 @@ PY_CFLAGS  := $(shell $(PYCONFIG) --includes)
 PY_LDFLAGS := $(shell $(PYCONFIG) --ldflags --embed)
 INPUT      ?= /root/reference/input5.txt
 
-.PHONY: build run run2 runOn2 test bench clean
+.PHONY: build run run2 runOn2 test bench bench-table clean
 
 build: final
 
@@ -47,6 +47,10 @@ test:
 
 bench:
 	$(PYTHON) bench.py
+
+# The full BASELINE.md config table (input2/3/5 + max-size synthetic).
+bench-table:
+	$(PYTHON) scripts/bench_table.py
 
 clean:
 	rm -f final

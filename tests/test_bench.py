@@ -1,0 +1,39 @@
+"""Smoke test for the driver's benchmark hook.
+
+The round driver runs ``python bench.py`` on real TPU hardware and records
+the single JSON line it prints; a bitrotten bench silently zeroes the
+round's perf record.  This drives the real script as a subprocess on the
+CPU backend with a small fixture workload and asserts the JSON contract.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+from test_cli import ENV, REPO
+
+
+def test_bench_emits_contract_json_line():
+    env = {
+        **ENV,
+        "BENCH_INPUT": os.path.join(REPO, "tests", "fixtures", "stress_small.txt"),
+        "BENCH_REPS": "1",
+        "BENCH_AMORT_REPS": "2",
+    }
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py")],
+        capture_output=True,
+        text=True,
+        env=env,
+        cwd=REPO,
+        timeout=280,
+    )
+    assert proc.returncode == 0, proc.stderr
+    lines = [l for l in proc.stdout.splitlines() if l.strip()]
+    assert len(lines) == 1, f"expected exactly one stdout line, got {lines!r}"
+    rec = json.loads(lines[0])
+    assert set(rec) == {"metric", "value", "unit", "vs_baseline"}
+    assert rec["unit"] == "elements/s/chip"
+    assert rec["value"] > 0 and rec["vs_baseline"] > 0
+    assert "stress_small.txt" in rec["metric"]
