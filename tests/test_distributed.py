@@ -28,7 +28,7 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def _launch_pair(*cli_args, stdin_path=None, coordinator_stdin=None):
+def _launch_pair(*cli_args, stdin_path=None, coordinator_stdin=None, devices_per_proc=1):
     """Run coordinator+worker; returns (proc0, proc1) CompletedProcess-like.
 
     _free_port() is inherently TOCTOU-racy (the port is released before the
@@ -39,7 +39,10 @@ def _launch_pair(*cli_args, stdin_path=None, coordinator_stdin=None):
     for attempt in range(3):
         try:
             outs = _launch_pair_once(
-                *cli_args, stdin_path=stdin_path, coordinator_stdin=coordinator_stdin
+                *cli_args,
+                stdin_path=stdin_path,
+                coordinator_stdin=coordinator_stdin,
+                devices_per_proc=devices_per_proc,
             )
         except subprocess.TimeoutExpired:
             # A lost port race can also strand the worker on a foreign
@@ -56,14 +59,15 @@ def _launch_pair(*cli_args, stdin_path=None, coordinator_stdin=None):
     return last
 
 
-def _launch_pair_once(*cli_args, stdin_path=None, coordinator_stdin=None):
+def _launch_pair_once(*cli_args, stdin_path=None, coordinator_stdin=None, devices_per_proc=1):
     port = _free_port()
     procs = []
     for pid in (0, 1):
         env = {
             **ENV,
-            # One CPU device per process -> a 2-device global mesh.
-            "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+            # devices_per_proc CPU devices per process -> a
+            # 2*devices_per_proc-device global mesh.
+            "XLA_FLAGS": f"--xla_force_host_platform_device_count={devices_per_proc}",
             "JAX_COORDINATOR_ADDRESS": f"127.0.0.1:{port}",
             "JAX_NUM_PROCESSES": "2",
             "JAX_PROCESS_ID": str(pid),
@@ -110,6 +114,21 @@ def test_two_process_job_coordinator_prints_worker_silent():
     assert rc1 == 0, f"worker failed:\n{err1}"
     assert out0 == golden("mixedcase")
     assert out1 == ""  # workers print nothing (main.c:199-211)
+
+
+@pytest.mark.slow
+def test_two_process_two_devices_each():
+    # Real pods have many chips per host: 2 processes x 2 local devices
+    # gives a 4-device global mesh where each process only addresses half
+    # the shards — the make_array_from_callback addressable-slice logic
+    # that the 1-device-per-process tests cannot exercise.
+    (rc0, out0, err0), (rc1, out1, err1) = _launch_pair(
+        stdin_path=fixture_path("mixedcase"), devices_per_proc=2
+    )
+    assert rc0 == 0, f"coordinator failed:\n{err0}"
+    assert rc1 == 0, f"worker failed:\n{err1}"
+    assert out0 == golden("mixedcase")
+    assert out1 == ""
 
 
 @pytest.mark.slow
