@@ -106,29 +106,19 @@ def _launch_pair_once(*cli_args, stdin_path=None, coordinator_stdin=None, device
 
 
 @pytest.mark.slow
-def test_two_process_job_coordinator_prints_worker_silent():
+@pytest.mark.parametrize("devices_per_proc", [1, 2])
+def test_two_process_job_coordinator_prints_worker_silent(devices_per_proc):
+    # devices_per_proc=2 mirrors real pods (many chips per host): a
+    # 4-device global mesh where each process only addresses half the
+    # shards — the make_array_from_callback addressable-slice logic the
+    # 1-device-per-process case cannot exercise.
     (rc0, out0, err0), (rc1, out1, err1) = _launch_pair(
-        stdin_path=fixture_path("mixedcase")
+        stdin_path=fixture_path("mixedcase"), devices_per_proc=devices_per_proc
     )
     assert rc0 == 0, f"coordinator failed:\n{err0}"
     assert rc1 == 0, f"worker failed:\n{err1}"
     assert out0 == golden("mixedcase")
     assert out1 == ""  # workers print nothing (main.c:199-211)
-
-
-@pytest.mark.slow
-def test_two_process_two_devices_each():
-    # Real pods have many chips per host: 2 processes x 2 local devices
-    # gives a 4-device global mesh where each process only addresses half
-    # the shards — the make_array_from_callback addressable-slice logic
-    # that the 1-device-per-process tests cannot exercise.
-    (rc0, out0, err0), (rc1, out1, err1) = _launch_pair(
-        stdin_path=fixture_path("mixedcase"), devices_per_proc=2
-    )
-    assert rc0 == 0, f"coordinator failed:\n{err0}"
-    assert rc1 == 0, f"worker failed:\n{err1}"
-    assert out0 == golden("mixedcase")
-    assert out1 == ""
 
 
 @pytest.mark.slow
