@@ -114,16 +114,17 @@ def mm_formulation_exact(val_flat: np.ndarray) -> bool:
 
 def choose_pallas_formulation(val_flat: np.ndarray, dims: tuple[int, ...]) -> tuple:
     """The single source of the fused-kernel eligibility policy, shared by
-    the batch-sharded and ring paths: ('pallas', bf16) when float32 math is
-    exact for these weights and every dimension in ``dims`` is 128-aligned;
-    ('gather',) otherwise.  Raises the friendly RuntimeError when the pallas
-    module itself is unavailable."""
+    the batch-sharded and ring paths: ('pallas', feed) — feed being the
+    fastest exact MXU operand type ('i8'/'bf16'/'f32') — when float32 math
+    is exact for these weights and every dimension in ``dims`` is
+    128-aligned; ('gather',) otherwise.  Raises the friendly RuntimeError
+    when the pallas module itself is unavailable."""
     try:
-        from .pallas_scorer import bf16_exact
+        from .pallas_scorer import mxu_feed
     except ModuleNotFoundError as e:
         raise RuntimeError("backend 'pallas' is not available in this build") from e
     if mm_formulation_exact(val_flat) and all(d % 128 == 0 for d in dims):
-        return ("pallas", bf16_exact(val_flat))
+        return ("pallas", mxu_feed(val_flat))
     return ("gather",)
 
 
@@ -156,7 +157,7 @@ def resolve_chunks_body(backend: str, val_flat: np.ndarray):
         if fm[0] == "pallas":
             from .pallas_scorer import score_chunks_pallas_body
 
-            return functools.partial(score_chunks_pallas_body, bf16=fm[1])
+            return functools.partial(score_chunks_pallas_body, feed=fm[1])
         backend = "xla-gather"
     if xla_formulation_mode(backend, val_flat) == "mm":
         from .matmul_scorer import score_chunks_mm_body

@@ -46,13 +46,15 @@ Two workload-adaptive fast paths on top of the baseline kernel:
   epilogue masks their lanes anyway).  For near-Seq1-length sequences this
   removes most of the grid; block nb=0 always runs because it carries the
   equal-length k=0 capture.
-* **bf16 MXU feed** — when every pair value satisfies |v| <= 128, the two
-  matmul operands are fed to the MXU as bfloat16 with float32 accumulation.
-  This is *exact*: the one-hot factors are 0/1, V entries are integers
+* **narrow MXU feeds** — ``mxu_feed`` picks the fastest exact operand
+  type per value table.  |v| <= 127: the one-hot matmul runs int8 x int8
+  with int32 accumulation (exact by construction, the MXU's fastest
+  path).  |v| <= 128: bfloat16 operands with float32 accumulation —
+  exact because one-hot factors are 0/1, V entries are integers
   |v| <= 128, the delta d0-d1 is an integer of magnitude <= 256 (every
   integer up to 2^8 is exactly representable in bf16's 8 mantissa bits),
-  and all accumulation happens in float32 (preferred_element_type), where
-  partial sums stay below 2^24.  Weights above 128 keep the f32 kernel.
+  and float32 partial sums stay below 2^24.  The delta (ltri) matmul
+  runs bf16 on both narrow feeds; larger weights keep the f32 kernel.
 """
 
 from __future__ import annotations
@@ -76,13 +78,26 @@ _BIGROW = 1 << 30
 # |pair value| bound below which feeding the MXU in bfloat16 stays exact
 # (see module docstring); checked on concrete weights at dispatch time.
 MAX_BF16_EXACT_WEIGHT = 128
+# int8 range: with |v| <= 127 the one-hot matmul runs as int8 x int8 with
+# int32 accumulation — exact by construction and the MXU's fastest feed.
+MAX_I8_EXACT_WEIGHT = 127
+
+_FEED_DTYPES = {"i8": jnp.int8, "bf16": jnp.bfloat16, "f32": jnp.float32}
 
 
-def bf16_exact(val_flat) -> bool:
-    """True when the bf16 MXU feed is bit-exact for this value table."""
+def mxu_feed(val_flat) -> str:
+    """Fastest exact MXU operand type for this value table: 'i8' (int8
+    operands, int32 accumulation) when |v| <= 127, 'bf16' (bf16 operands,
+    f32 accumulation) at exactly 128, 'f32' otherwise (up to the matmul
+    path's 4095 bound; beyond that dispatch routes to the gather body)."""
     from .values import max_abs_value
 
-    return max_abs_value(val_flat) <= MAX_BF16_EXACT_WEIGHT
+    m = max_abs_value(val_flat)
+    if m <= MAX_I8_EXACT_WEIGHT:
+        return "i8"
+    if m <= MAX_BF16_EXACT_WEIGHT:
+        return "bf16"
+    return "f32"
 
 
 def _superblock(nbn: int) -> int:
@@ -100,18 +115,22 @@ def _superblock(nbn: int) -> int:
     return 1
 
 
-def _kernel(meta_ref, codes_ref, a_ref, score_ref, k_ref, k0_ref, *, nbn, nbi, bf16):
+def _kernel(meta_ref, codes_ref, a_ref, score_ref, k_ref, k0_ref, *, nbn, nbi, feed):
     """One grid cell scores one pair across all offset super-blocks."""
     len1 = meta_ref[0]  # scalar-prefetch SMEM array: [len1, lens...]
     l2 = meta_ref[1 + pl.program_id(0)]
-    mxu_t = jnp.bfloat16 if bf16 else jnp.float32
+    # First (one-hot) matmul operand type; a_ref arrives pre-cast.
+    oh_t = _FEED_DTYPES[feed]
+    # Delta matmul runs bf16 whenever exact (|dd| <= 256, integers): both
+    # the i8 and bf16 feeds qualify.
+    dd_t = jnp.float32 if feed == "f32" else jnp.bfloat16
     sb = _superblock(nbn)
     sbw = sb * _BLK  # offset lanes per super-block
 
     ri1 = lax.broadcasted_iota(jnp.int32, (_BLK, _BLK), 0)
     ci1 = lax.broadcasted_iota(jnp.int32, (_BLK, _BLK), 1)
     riw = lax.broadcasted_iota(jnp.int32, (_BLK, sbw), 0)
-    ltri = (ri1 >= ci1).astype(mxu_t)
+    ltri = (ri1 >= ci1).astype(dd_t)
 
     # Char-blocks wholly past len2 contribute nothing (masked rows, zero
     # deltas, no captures): the dynamic trip count skips them entirely.
@@ -124,7 +143,7 @@ def _kernel(meta_ref, codes_ref, a_ref, score_ref, k_ref, k0_ref, *, nbn, nbi, b
             carry, runmax, runkap, endg, t1 = car
             i0 = ib * _BLK
             codes = codes_ref[0, ib, :, :]  # [128, 1] int32, sublane-oriented
-            oh = (codes == ci1).astype(mxu_t)  # [128, 128]
+            oh = (codes == ci1).astype(oh_t)  # [128, 128]
             wneed = a_ref.shape[1]
             # A is stored lane-reversed: this band covers original columns
             # [n0+i0, n0+i0+sbw+128) in descending order.
@@ -134,7 +153,9 @@ def _kernel(meta_ref, codes_ref, a_ref, score_ref, k_ref, k0_ref, *, nbn, nbi, b
             # host-side (code 0 appears only as padding), so padded seq2
             # chars and seq1 positions past len1 contribute exactly 0
             # through the matmul itself.
-            vp = jnp.dot(oh, aband, preferred_element_type=jnp.float32)
+            acc_t = jnp.int32 if feed == "i8" else jnp.float32
+            vp = jnp.dot(oh, aband, preferred_element_type=acc_t)
+            vp = vp.astype(jnp.float32)  # int32 entries <= 127: exact
             # Shear row r left by r = strided rotate right by r on the
             # reversed lanes; one hardware op replaces the 7-step
             # roll+select ladder.  Rows use only lanes j >= r, so the
@@ -143,7 +164,7 @@ def _kernel(meta_ref, codes_ref, a_ref, score_ref, k_ref, k0_ref, *, nbn, nbi, b
             # Reversed-lane diagonals: lane m holds offset n0 + sbw-1-m.
             d0 = vp[:, _BLK:]
             d1 = vp[:, _BLK - 1 : sbw + _BLK - 1]
-            dd = (d0 - d1).astype(mxu_t)  # integer, |dd| <= 256: bf16-exact
+            dd = (d0 - d1).astype(dd_t)  # integer, |dd| <= 256: bf16-exact
             lp = jnp.dot(ltri, dd, preferred_element_type=jnp.float32)
             g = lp + carry[None, :]
             valid_row = riw < l2 - i0  # kappa = i0+r+1 in 1..len2
@@ -191,8 +212,8 @@ def _kernel(meta_ref, codes_ref, a_ref, score_ref, k_ref, k0_ref, *, nbn, nbi, b
 
 
 @functools.lru_cache(maxsize=32)
-def _pallas_call(nbn: int, nbi: int, wneed: int, b: int, interpret: bool, bf16: bool):
-    kernel = functools.partial(_kernel, nbn=nbn, nbi=nbi, bf16=bf16)
+def _pallas_call(nbn: int, nbi: int, wneed: int, b: int, interpret: bool, feed: str):
+    kernel = functools.partial(_kernel, nbn=nbn, nbi=nbi, feed=feed)
     w = nbn * _BLK
     return pl.pallas_call(
         kernel,
@@ -218,7 +239,7 @@ def _pallas_call(nbn: int, nbi: int, wneed: int, b: int, interpret: bool, bf16: 
     )
 
 
-def _pallas_offset_surfaces(seq1ext, len1, rows, lens, val_flat, bf16=False):
+def _pallas_offset_surfaces(seq1ext, len1, rows, lens, val_flat, feed="f32"):
     """Run the fused kernel; returns the raw per-offset surfaces
     ``(score_n, k_n, k0_n)``, each ``[B, W]`` (W = offset-axis extent), in
     standard lane orientation.  ``score_n[b, n]`` is the best score over all
@@ -231,7 +252,7 @@ def _pallas_offset_surfaces(seq1ext, len1, rows, lens, val_flat, bf16=False):
     nbn, nbi = w // _BLK, l2p // _BLK
     wneed = w + l2p  # A columns reachable by n0 + i0 + sbw + 127
 
-    mxu_t = jnp.bfloat16 if bf16 else jnp.float32
+    a_t = _FEED_DTYPES[feed]
     val27 = val_flat.reshape(ALPHABET_SIZE, ALPHABET_SIZE).astype(jnp.float32)
     # Code 0 appears only as padding (real chars encode to 1..26): zeroing
     # its row/column makes padded positions self-masking inside the kernel's
@@ -250,7 +271,7 @@ def _pallas_offset_surfaces(seq1ext, len1, rows, lens, val_flat, bf16=False):
         jnp.zeros((_BLK, wneed), jnp.float32)
         .at[:ALPHABET_SIZE]
         .set(a_small[:, ::-1])
-    ).astype(mxu_t)
+    ).astype(a_t)
 
     codes = rows.astype(jnp.int32).reshape(b, nbi, _BLK, 1)
     meta = jnp.concatenate(
@@ -260,7 +281,7 @@ def _pallas_offset_surfaces(seq1ext, len1, rows, lens, val_flat, bf16=False):
     # Off-TPU (the 8-virtual-device CPU test mesh) the Mosaic kernel cannot
     # lower; interpret mode runs the same kernel semantics for parity tests.
     interpret = jax.default_backend() != "tpu"
-    score_n, k_n, k0_n = _pallas_call(nbn, nbi, wneed, b, interpret, bf16)(
+    score_n, k_n, k0_n = _pallas_call(nbn, nbi, wneed, b, interpret, feed)(
         meta, codes, a_ext
     )
 
@@ -273,12 +294,12 @@ def _pallas_offset_surfaces(seq1ext, len1, rows, lens, val_flat, bf16=False):
     return unrev(score_n), unrev(k_n), unrev(k0_n)
 
 
-def _pallas_rows(seq1ext, len1, rows, lens, val_flat, bf16=False):
+def _pallas_rows(seq1ext, len1, rows, lens, val_flat, feed="f32"):
     """Score a [B, L2P] padded batch with the fused kernel; returns [B, 3]."""
     b, l2p = rows.shape
     w = seq1ext.shape[0] - l2p - 1
     score_n, k_n, k0_n = _pallas_offset_surfaces(
-        seq1ext, len1, rows, lens, val_flat, bf16=bf16
+        seq1ext, len1, rows, lens, val_flat, feed=feed
     )
 
     # Tiny [B, NOFF] epilogue in XLA: offset validity, first-max argmax,
@@ -307,13 +328,13 @@ def _shapes_supported(l1p: int, l2p: int) -> bool:
 
 
 def score_chunks_pallas_body(
-    seq1ext, len1, seq2_chunks, len2_chunks, val_flat, *, bf16=False
+    seq1ext, len1, seq2_chunks, len2_chunks, val_flat, *, feed="f32"
 ):
     """Chunked-batch entry, same contract as the XLA bodies:
     [NC, CB, L2P] -> [NC, CB, 3].  Falls back to the XLA matmul body for
-    non-128-aligned shape buckets (tiny problems).  ``bf16`` must only be
-    set when ``bf16_exact(val_flat)`` holds (checked at dispatch sites on
-    concrete weights; this body may be traced with abstract values)."""
+    non-128-aligned shape buckets (tiny problems).  ``feed`` must come
+    from ``mxu_feed(val_flat)`` on concrete weights (checked at dispatch
+    sites; this body may be traced with abstract values)."""
     nc, cb, l2p = seq2_chunks.shape
     l1p = seq1ext.shape[0] - l2p - 1
     if not _shapes_supported(l1p, l2p):
@@ -328,16 +349,16 @@ def score_chunks_pallas_body(
         seq2_chunks.reshape(nc * cb, l2p),
         len2_chunks.reshape(nc * cb),
         val_flat,
-        bf16=bf16,
+        feed=feed,
     )
     return out.reshape(nc, cb, 3)
 
 
-score_chunks_pallas = jax.jit(score_chunks_pallas_body, static_argnames=("bf16",))
+score_chunks_pallas = jax.jit(score_chunks_pallas_body, static_argnames=("feed",))
 
 
 @functools.lru_cache(maxsize=32)
-def pallas_pair_scorer(l1p: int, l2p: int, bf16: bool = False):
+def pallas_pair_scorer(l1p: int, l2p: int, feed: str = "f32"):
     """Per-shard callable for the shard_map path: (seq1ext, len1,
     rows [BL, L2P], lens [BL], val_flat) -> [BL, 3].  Cached by shape
     bucket so the shard_map jit cache stays hot."""
@@ -354,7 +375,7 @@ def pallas_pair_scorer(l1p: int, l2p: int, bf16: bool = False):
                 lens.reshape(1, bl),
                 val_flat,
             ).reshape(bl, 3)
-        return _pallas_rows(seq1ext, len1, rows, lens, val_flat, bf16=bf16)
+        return _pallas_rows(seq1ext, len1, rows, lens, val_flat, feed=feed)
 
     return fn
 
@@ -385,5 +406,5 @@ def score_batch_pallas(batch, val_flat):
         jnp.asarray(rows.reshape(1, batch.batch_size, batch.l2p)),
         jnp.asarray(lens.reshape(1, batch.batch_size)),
         jnp.asarray(val_flat),
-        bf16=bf16_exact(val_flat),
+        feed=mxu_feed(val_flat),
     ).reshape(batch.batch_size, 3)

@@ -134,7 +134,7 @@ class RingSharding:
 @functools.lru_cache(maxsize=32)
 def _ring_fn(mesh, bs, l2p, cb, mode: tuple = ("gather",)):
     """Jitted shard_map ring scorer for one (mesh, Bs, L2P, chunk,
-    formulation) config.  ``mode`` is ('gather',) or ('pallas', bf16)."""
+    formulation) config.  ``mode`` is ('gather',) or ('pallas', feed)."""
     import jax
     import jax.numpy as jnp
     from jax import lax
@@ -168,7 +168,7 @@ def _ring_fn(mesh, bs, l2p, cb, mode: tuple = ("gather",)):
             win_k = win[: bs + l2p + 1]
             len1_eff = len1 - d * bs
             score_n, k_n, k0_n = _pallas_offset_surfaces(
-                win_k, len1_eff, rows, lens, val_flat, bf16=mode[1]
+                win_k, len1_eff, rows, lens, val_flat, feed=mode[1]
             )
             nn = jnp.arange(bs, dtype=jnp.int32)[None, :]
             valid = nn < jnp.maximum(len1_eff - lens, 0)[:, None]

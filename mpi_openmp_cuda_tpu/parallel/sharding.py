@@ -123,7 +123,7 @@ def _sharded_fn(mesh, cb, mode: tuple):
     """Build (and cache) the jitted shard_map scorer for one mesh/chunk
     config; jit itself then caches per input-shape bucket.  ``mode`` is a
     hashable formulation key — ('mm',), ('gather',) or
-    ('pallas', l1p, l2p, bf16) — never a closure object, so repeated calls
+    ('pallas', l1p, l2p, feed) — never a closure object, so repeated calls
     hit the cache."""
     import jax
 

@@ -23,10 +23,10 @@ from mpi_openmp_cuda_tpu.ops.oracle import score_batch_oracle
 from mpi_openmp_cuda_tpu.parallel.ring import RingSharding
 from mpi_openmp_cuda_tpu.parallel.sharding import BatchSharding
 
-# Weight vectors straddling the exactness gates: bf16 (|w| <= 128),
-# f32-matmul (|w| <= 4095), and the int32-gather fallback beyond.
+# Weight vectors straddling the exactness gates: i8 (|w| <= 127), bf16
+# (== 128), f32-matmul (<= 4095), and the int32-gather fallback beyond.
 WEIGHT_REGIMES = [
-    [10, 2, 3, 4],  # fixtures' regime, bf16-eligible
+    [10, 2, 3, 4],  # fixtures' regime, int8 MXU feed
     [128, 2, 3, 4],  # bf16 boundary
     [129, 2, 3, 4],  # just past bf16, f32 kernel
     [4095, 7, 1, 2],  # f32 boundary

@@ -95,15 +95,16 @@ def test_pallas_sharded_huge_weights_exact():
     assert [tuple(int(x) for x in row) for row in got] == want
 
 
-@pytest.mark.parametrize("wmax", [128, 129])
-def test_pallas_bf16_gate_boundary(wmax):
-    # max|weight| == 128 rides the bf16 MXU feed; 129 stays on the f32
-    # kernel.  Both must be bit-exact against the oracle.
-    from mpi_openmp_cuda_tpu.ops.pallas_scorer import bf16_exact
+@pytest.mark.parametrize("wmax", [127, 128, 129])
+def test_pallas_mxu_feed_gate_boundary(wmax):
+    # max|weight| == 127 rides the int8 MXU feed, 128 the bf16 feed, and
+    # 129 stays on the f32 kernel.  All must be bit-exact vs the oracle.
+    from mpi_openmp_cuda_tpu.ops.pallas_scorer import mxu_feed
     from mpi_openmp_cuda_tpu.ops.values import value_table
 
     weights = [wmax, 2, 3, 4]
-    assert bf16_exact(value_table(weights).reshape(-1)) == (wmax <= 128)
+    val = value_table(weights).reshape(-1)
+    assert mxu_feed(val) == {127: "i8", 128: "bf16", 129: "f32"}[wmax]
     rng = np.random.default_rng(7)
     seq1 = rng.integers(1, 27, size=260).astype(np.int8)
     seqs = [
