@@ -140,7 +140,7 @@ def _kernel(meta_ref, codes_ref, a_ref, score_ref, k_ref, k0_ref, *, nbn, nbi, f
         n0 = nb * _BLK
 
         def ibody(ib, car):
-            carry, runmax, runkap, endg, t1 = car
+            carry, runmax, runkap, t1 = car
             i0 = ib * _BLK
             codes = codes_ref[0, ib, :, :]  # [128, 1] int32, sublane-oriented
             oh = (codes == ci1).astype(oh_t)  # [128, 128]
@@ -167,28 +167,26 @@ def _kernel(meta_ref, codes_ref, a_ref, score_ref, k_ref, k0_ref, *, nbn, nbi, f
             dd = (d0 - d1).astype(dd_t)  # integer, |dd| <= 256: bf16-exact
             lp = jnp.dot(ltri, dd, preferred_element_type=jnp.float32)
             g = lp + carry[None, :]
-            valid_row = riw < l2 - i0  # kappa = i0+r+1 in 1..len2
-            gm = jnp.where(valid_row, g, _NEG)
-            bmax = jnp.max(gm, axis=0)  # [sbw]
+            # No kappa-validity mask: rows past len2 have zero deltas (the
+            # self-masking table), so their g DUPLICATES the last valid
+            # row's value — the max is unchanged, and the min-index
+            # tie-break below always picks the real (lower) row.
+            bmax = jnp.max(g, axis=0)  # [sbw]
             brow = jnp.min(
-                jnp.where(gm == bmax[None, :], riw, _BIGROW), axis=0
+                jnp.where(g == bmax[None, :], riw, _BIGROW), axis=0
             )
             upd = bmax > runmax
             runmax = jnp.where(upd, bmax, runmax)
             runkap = jnp.where(upd, i0 + brow + 1, runkap)
-            endg = endg + jnp.sum(
-                jnp.where(riw == l2 - 1 - i0, g, 0.0), axis=0
-            )
             t1 = t1 + jnp.sum(d1, axis=0)
             carry = carry + lp[_BLK - 1, :]
-            return carry, runmax, runkap, endg, t1
+            return carry, runmax, runkap, t1
 
         zeros = jnp.zeros((sbw,), jnp.float32)
         init = (
             zeros,
             jnp.full((sbw,), _NEG),
             jnp.zeros((sbw,), jnp.int32),
-            zeros,
             zeros,
         )
 
@@ -197,14 +195,17 @@ def _kernel(meta_ref, codes_ref, a_ref, score_ref, k_ref, k0_ref, *, nbn, nbi, f
 
         if nb == 0:
             # Always runs: carries the equal-length k=0 capture at n=0.
-            carry, runmax, runkap, endg, t1 = nbody()
+            carry, runmax, runkap, t1 = nbody()
         else:
             # Super-blocks wholly past the pair's valid range
             # (n >= len1 - len2) are dead lanes in the epilogue: skip.
-            carry, runmax, runkap, endg, t1 = lax.cond(
+            carry, runmax, runkap, t1 = lax.cond(
                 n0 < len1 - l2, nbody, lambda: init
             )
 
+        # Zero deltas past len2 also mean the final prefix carry IS
+        # G[len2] — the k=0 candidate — with no separate capture pass.
+        endg = carry
         sl = (0, 0, pl.ds(n0, sbw))
         score_ref[sl] = t1 + runmax
         k_ref[sl] = jnp.where(endg == runmax, 0, runkap)  # k=0 wins ties
