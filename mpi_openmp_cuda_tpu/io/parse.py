@@ -16,6 +16,7 @@ from typing import TextIO
 import numpy as np
 
 from ..models.encoding import encode_normalized
+from ..utils.constants import INT32_MIN
 
 
 class InputFormatError(ValueError):
@@ -52,6 +53,13 @@ def parse_problem(stream: TextIO) -> Problem:
         weights = [int(t) for t in tokens[:4]]
     except ValueError as e:
         raise InputFormatError(f"bad weight token: {e}") from e
+    for w in weights:
+        # The reference reads weights as C int (main.c:76); out-of-range
+        # values must fail here, not as an opaque overflow downstream.
+        # INT32_MIN itself is excluded: weights w2..w4 are negated into an
+        # int32 table (values.signed_weights), and -INT32_MIN overflows.
+        if not INT32_MIN < w < 2**31:
+            raise InputFormatError(f"weight {w} outside 32-bit integer range")
     seq1 = tokens[4]
     try:
         n = int(tokens[5])

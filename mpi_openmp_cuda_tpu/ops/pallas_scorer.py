@@ -132,8 +132,9 @@ def _kernel(meta_ref, codes_ref, a_ref, score_ref, k_ref, k0_ref, *, nbn, nbi, f
     riw = lax.broadcasted_iota(jnp.int32, (_BLK, sbw), 0)
     ltri = (ri1 >= ci1).astype(dd_t)
 
-    # Char-blocks wholly past len2 contribute nothing (masked rows, zero
-    # deltas, no captures): the dynamic trip count skips them entirely.
+    # Char-blocks wholly past len2 contribute nothing (the self-masking
+    # table makes their deltas exactly zero): the dynamic trip count skips
+    # them entirely.
     nbi_live = jnp.minimum((l2 + _BLK - 1) // _BLK, nbi)
 
     for nb in range(0, nbn, sb):

@@ -130,3 +130,34 @@ def test_guarded_stdout_restores_fd1_on_broken_pipe():
     )
     assert proc.returncode == 0
     assert "FD1_RESTORED" in proc.stderr
+
+
+def test_parse_fuzz_never_crashes():
+    # Arbitrary byte soup must either parse (if it happens to be valid) or
+    # raise one of the two documented error types — never an unhandled
+    # exception (IndexError, UnicodeDecodeError, ...).
+    import io as _io
+
+    import numpy as np
+
+    from mpi_openmp_cuda_tpu.io.parse import InputFormatError, parse_problem
+    from mpi_openmp_cuda_tpu.models.encoding import InvalidSequenceError
+
+    rng = np.random.default_rng(1234)
+    corpora = []
+    for _ in range(200):
+        n = int(rng.integers(0, 120))
+        corpora.append(bytes(rng.integers(0, 256, size=n, dtype=np.uint8)))
+    # Structured-but-wrong cases the raw soup rarely hits:
+    corpora += [
+        b"", b"\n\n\n", b"1 2 3", b"1 2 3 4", b"1 2 3 4\nABC",
+        b"1 2 3 4\nABC\n-1", b"1 2 3 4\nABC\n2\nA", b"1 2 3 4\nABC\n1\nA1C",
+        b"9999999999999999999999 2 3 4\nABC\n0",
+        b"1 2 3 4\nABC\nnotanumber\nA",
+    ]
+    for raw in corpora:
+        text = raw.decode("utf-8", errors="replace")
+        try:
+            parse_problem(_io.StringIO(text))
+        except (InputFormatError, InvalidSequenceError):
+            pass
