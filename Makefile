@@ -18,7 +18,7 @@ PY_CFLAGS  := $(shell $(PYCONFIG) --includes)
 PY_LDFLAGS := $(shell $(PYCONFIG) --ldflags --embed)
 INPUT      ?= /root/reference/input5.txt
 
-.PHONY: build run run2 runOn2 test bench bench-table clean
+.PHONY: build run run2 runOn2 test bench bench-table check clean
 
 build: final
 
@@ -44,6 +44,16 @@ runOn2:
 
 test:
 	$(PYTHON) -m pytest tests/ -q
+
+# Everything a round-end check runs: suite, driver hooks, native goldens.
+# `final` is an ordered prerequisite of `test` here: the suite's native
+# tests rebuild it via a nested make, which must not race this one.
+check: final
+	$(MAKE) test
+	XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+	    DRYRUN_DEVICES=8 $(PYTHON) __graft_entry__.py
+	JAX_PLATFORMS=cpu ./final < tests/fixtures/tiny.txt > /tmp/check_tiny.out
+	diff /tmp/check_tiny.out tests/fixtures/tiny.out
 
 bench:
 	$(PYTHON) bench.py

@@ -47,6 +47,23 @@ def rng() -> np.random.Generator:
     return np.random.default_rng(0)
 
 
+@pytest.fixture(autouse=True, scope="module")
+def _clear_jax_caches_between_modules():
+    """Drop compiled executables at module boundaries.
+
+    The full suite compiles hundreds of distinct programs; with all of
+    them kept live, the XLA CPU compiler has been observed to segfault on
+    a later (otherwise-fine) compile.  Cross-module jit-cache reuse is
+    rare (modules use distinct shape buckets), so clearing costs little.
+    The framework's own lru_caches hold jitted *wrappers*, which re-trace
+    transparently after a clear.
+    """
+    yield
+    import jax
+
+    jax.clear_caches()
+
+
 def pytest_configure(config):
     config.addinivalue_line(
         "markers", "slow: multi-process / long-running integration tests"
