@@ -187,11 +187,18 @@ def main() -> None:
 
     assert (np.asarray(out) == np.asarray(first)).all(), "nondeterministic bench run"
 
-    wall = steady_state_wall(
-        # 256 amortised reps: the per-rep device time (~0.2 ms on the
-        # stress fixture) must dominate host-link jitter (~ms) for the
-        # slope to be stable run-to-run.
-        problem, backend, reps=int(os.environ.get("BENCH_AMORT_REPS", "256"))
+    # 256 amortised reps per measurement (the per-rep device time must
+    # dominate host-link jitter for a stable slope), and a median of 3
+    # measurements: single runs still swing ~±30% with device/tunnel load,
+    # and the driver records exactly one bench invocation per round.
+    reps = int(os.environ.get("BENCH_AMORT_REPS", "256"))
+    wall = float(
+        np.median(
+            [
+                steady_state_wall(problem, backend, reps=reps)
+                for _ in range(int(os.environ.get("BENCH_MEDIAN", "3")))
+            ]
+        )
     )
 
     elements = brute_force_elements(
