@@ -93,7 +93,7 @@ def pick_backend() -> str:
 STEADY_CLAMP_FLOOR = 1e-9
 
 
-def steady_state_wall(problem, backend: str, reps: int) -> float:
+def steady_state_wall(problem, backend: str, reps: int, medians: int = 1) -> float:
     """Per-run device wall-clock with host round-trip latency amortised.
 
     Remote-tunnelled TPU setups add a fixed ~10-100 ms host<->device
@@ -102,7 +102,9 @@ def steady_state_wall(problem, backend: str, reps: int) -> float:
     jitted computation (each rep permutes the batch within chunks via roll,
     so nothing can be hoisted out of the loop; results are
     permutation-invariant) and fetch once; the slope between a short and a
-    long loop is the true per-run time.
+    long loop is the true per-run time.  ``medians`` repeats the timed
+    slope measurement (reusing the already-compiled programs) and returns
+    the median — single slopes swing with device/tunnel load.
     """
     import jax
     import jax.numpy as jnp
@@ -146,17 +148,23 @@ def steady_state_wall(problem, backend: str, reps: int) -> float:
 
         return jax.jit(f)
 
-    walls = {}
+    fns = {}
     for k in (1, 1 + reps):
-        f = make(k)
-        int(f(*args))  # warm/compile + force
-        times = []
-        for _ in range(3):
-            t0 = time.perf_counter()
-            int(f(*args))
-            times.append(time.perf_counter() - t0)
-        walls[k] = float(np.median(times))
-    return max(walls[1 + reps] - walls[1], STEADY_CLAMP_FLOOR) / reps
+        fns[k] = make(k)
+        int(fns[k](*args))  # warm/compile + force, once per program
+
+    def one_slope() -> float:
+        walls = {}
+        for k, f in fns.items():
+            times = []
+            for _ in range(3):
+                t0 = time.perf_counter()
+                int(f(*args))
+                times.append(time.perf_counter() - t0)
+            walls[k] = float(np.median(times))
+        return max(walls[1 + reps] - walls[1], STEADY_CLAMP_FLOOR) / reps
+
+    return float(np.median([one_slope() for _ in range(max(1, medians))]))
 
 
 def main() -> None:
@@ -189,16 +197,13 @@ def main() -> None:
 
     # 256 amortised reps per measurement (the per-rep device time must
     # dominate host-link jitter for a stable slope), and a median of 3
-    # measurements: single runs still swing ~±30% with device/tunnel load,
-    # and the driver records exactly one bench invocation per round.
-    reps = int(os.environ.get("BENCH_AMORT_REPS", "256"))
-    wall = float(
-        np.median(
-            [
-                steady_state_wall(problem, backend, reps=reps)
-                for _ in range(int(os.environ.get("BENCH_MEDIAN", "3")))
-            ]
-        )
+    # measurements: single slopes still swing ~±30% with device/tunnel
+    # load, and the driver records exactly one bench invocation per round.
+    wall = steady_state_wall(
+        problem,
+        backend,
+        reps=int(os.environ.get("BENCH_AMORT_REPS", "256")),
+        medians=max(1, int(os.environ.get("BENCH_MEDIAN", "3"))),
     )
 
     elements = brute_force_elements(

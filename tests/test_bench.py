@@ -20,6 +20,7 @@ def test_bench_emits_contract_json_line():
         "BENCH_INPUT": os.path.join(REPO, "tests", "fixtures", "stress_small.txt"),
         "BENCH_REPS": "1",
         "BENCH_AMORT_REPS": "2",
+        "BENCH_MEDIAN": "1",
     }
     proc = subprocess.run(
         [sys.executable, os.path.join(REPO, "bench.py")],
