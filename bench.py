@@ -202,8 +202,8 @@ def main() -> None:
     wall = steady_state_wall(
         problem,
         backend,
-        reps=int(os.environ.get("BENCH_AMORT_REPS", "256")),
-        medians=max(1, int(os.environ.get("BENCH_MEDIAN", "3"))),
+        reps=max(1, int(os.environ.get("BENCH_AMORT_REPS", "256"))),
+        medians=int(os.environ.get("BENCH_MEDIAN", "3")),
     )
 
     elements = brute_force_elements(

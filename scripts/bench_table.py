@@ -81,7 +81,7 @@ def measure(problem: Problem, backend: str, reps: int = 32):
         run()
         times.append(time.perf_counter() - t0)
     e2e = float(np.median(times))
-    steady = bench.steady_state_wall(problem, backend, reps=reps)
+    steady = bench.steady_state_wall(problem, backend, reps=reps, medians=3)
     elements = bench.brute_force_elements(
         problem.seq1_codes.size, [c.size for c in problem.seq2_codes]
     )
