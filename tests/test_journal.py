@@ -116,3 +116,33 @@ def test_cli_journal_flag(tmp_path, capsys):
         assert rc == 0
         out = capsys.readouterr().out
         assert out == "#0: score: 27, n: 0, k: 5\n"
+
+
+def test_cli_journal_composes_with_mesh(tmp_path, capsys):
+    """--journal + --mesh: the journal chunks its rescoring through the
+    sharded scorer; a resume run with a complete journal reprints without
+    touching the mesh, and both runs match the golden output."""
+    import os
+
+    from conftest import reference_fixture
+    from mpi_openmp_cuda_tpu.io.cli import run
+
+    golden_path = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "golden", "input6.out"
+    )
+    with open(golden_path) as f:
+        want = f.read()
+    jpath = str(tmp_path / "journal.jsonl")
+    for _ in range(2):  # second run resumes from the complete journal
+        rc = run(
+            [
+                "--input",
+                reference_fixture("input6.txt"),
+                "--mesh",
+                "4",
+                "--journal",
+                jpath,
+            ]
+        )
+        assert rc == 0
+        assert capsys.readouterr().out == want
