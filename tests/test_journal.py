@@ -119,30 +119,28 @@ def test_cli_journal_flag(tmp_path, capsys):
 
 
 def test_cli_journal_composes_with_mesh(tmp_path, capsys):
-    """--journal + --mesh: the journal chunks its rescoring through the
-    sharded scorer; a resume run with a complete journal reprints without
-    touching the mesh, and both runs match the golden output."""
+    """--journal + --mesh: the journal routes its scoring through the
+    sharded scorer, and a resume run with a complete journal reprints
+    from the journal (no rescoring, journal untouched) with both runs
+    matching the golden output."""
     import os
 
     from conftest import reference_fixture
     from mpi_openmp_cuda_tpu.io.cli import run
+    from test_cli import golden
 
-    golden_path = os.path.join(
-        os.path.dirname(os.path.abspath(__file__)), "golden", "input6.out"
-    )
-    with open(golden_path) as f:
-        want = f.read()
+    want = golden("input6.out")
     jpath = str(tmp_path / "journal.jsonl")
-    for _ in range(2):  # second run resumes from the complete journal
-        rc = run(
-            [
-                "--input",
-                reference_fixture("input6.txt"),
-                "--mesh",
-                "4",
-                "--journal",
-                jpath,
-            ]
-        )
-        assert rc == 0
-        assert capsys.readouterr().out == want
+    args = [
+        "--input", reference_fixture("input6.txt"), "--mesh", "4",
+        "--journal", jpath,
+    ]
+    assert run(args) == 0
+    assert capsys.readouterr().out == want
+    before = (os.path.getmtime(jpath), open(jpath).read())
+
+    # Resume: the complete journal must satisfy the run without a single
+    # append (bytes and mtime unchanged).
+    assert run(args) == 0
+    assert capsys.readouterr().out == want
+    assert (os.path.getmtime(jpath), open(jpath).read()) == before
