@@ -260,31 +260,32 @@ class AlignmentScorer:
     def _score_local(self, batch: PaddedBatch, val_flat: np.ndarray) -> np.ndarray:
         import jax.numpy as jnp
 
-        if self.backend == "pallas":
-            try:
-                from .pallas_scorer import score_batch_pallas
-            except ModuleNotFoundError as e:
-                raise RuntimeError(
-                    "backend 'pallas' is not available in this build"
-                ) from e
-
-            return np.asarray(
-                score_batch_pallas(batch, jnp.asarray(val_flat))
-            )[: batch.batch_size]
-
-        fn = resolve_xla_formulation(self.backend, val_flat)
-
         b = batch.batch_size
         cb = choose_chunk(batch, self.chunk_budget)
         bp = round_up(b, cb)
         rows, lens = pad_batch_rows(batch, bp)
-        out = fn(
+        args = (
             jnp.asarray(batch.seq1ext),
             jnp.int32(batch.len1),
             jnp.asarray(rows.reshape(bp // cb, cb, batch.l2p)),
             jnp.asarray(lens.reshape(bp // cb, cb)),
             jnp.asarray(val_flat),
         )
+        if self.backend == "pallas":
+            # Same eligibility policy as the sharded paths; the chunked
+            # [NC, CB] shape buckets match the bench/sharded programs, so
+            # batch sizes within one bucket share a single compilation.
+            fm = choose_pallas_formulation(val_flat, ())
+            if fm[0] == "pallas":
+                from .pallas_scorer import score_chunks_pallas
+
+                out = score_chunks_pallas(*args, feed=fm[1])
+            else:
+                from .xla_scorer import score_chunks
+
+                out = score_chunks(*args)
+        else:
+            out = resolve_xla_formulation(self.backend, val_flat)(*args)
         return np.asarray(out).reshape(bp, 3)[:b]
 
     # -- text-level API ----------------------------------------------------

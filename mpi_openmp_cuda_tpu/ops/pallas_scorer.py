@@ -380,33 +380,3 @@ def pallas_pair_scorer(l1p: int, l2p: int, feed: str = "f32"):
         return _pallas_rows(seq1ext, len1, rows, lens, val_flat, feed=feed)
 
     return fn
-
-
-def score_batch_pallas(batch, val_flat):
-    """PaddedBatch entry used by ops.dispatch; returns [B, 3] (device)."""
-    from .dispatch import mm_formulation_exact
-
-    if not mm_formulation_exact(val_flat):
-        # Same float32 bound as the matmul path; route to exact int32 XLA.
-        from .dispatch import pad_batch_rows
-        from .xla_scorer import score_chunks
-
-        rows, lens = pad_batch_rows(batch, batch.batch_size)
-        return score_chunks(
-            jnp.asarray(batch.seq1ext),
-            jnp.int32(batch.len1),
-            jnp.asarray(rows.reshape(1, batch.batch_size, batch.l2p)),
-            jnp.asarray(lens.reshape(1, batch.batch_size)),
-            jnp.asarray(val_flat),
-        ).reshape(batch.batch_size, 3)
-    from .dispatch import pad_batch_rows
-
-    rows, lens = pad_batch_rows(batch, batch.batch_size)
-    return score_chunks_pallas(
-        jnp.asarray(batch.seq1ext),
-        jnp.int32(batch.len1),
-        jnp.asarray(rows.reshape(1, batch.batch_size, batch.l2p)),
-        jnp.asarray(lens.reshape(1, batch.batch_size)),
-        jnp.asarray(val_flat),
-        feed=mxu_feed(val_flat),
-    ).reshape(batch.batch_size, 3)
