@@ -75,6 +75,10 @@ def _problems(rng):
 
 @pytest.mark.parametrize("weights", WEIGHT_REGIMES, ids=lambda w: f"w{w[0]}")
 def test_all_paths_agree_with_oracle(weights, rng):
+    from mpi_openmp_cuda_tpu.ops.dispatch import mm_formulation_exact
+    from mpi_openmp_cuda_tpu.ops.pallas_scorer import mxu_feed
+    from mpi_openmp_cuda_tpu.ops.values import value_table
+
     paths = {
         "xla": AlignmentScorer("xla"),
         "xla-gather": AlignmentScorer("xla-gather"),
@@ -90,9 +94,26 @@ def test_all_paths_agree_with_oracle(weights, rng):
             "pallas", sharding=RingSharding.over_devices(seq=4, batch=2)
         ),
     }
-    for seq1, seqs in _problems(rng):
+    # The bf16/f32 MXU feeds compile kernel programs that differ from the
+    # int8 feed only in operand/accumulator dtypes, and each interpret-mode
+    # compile costs seconds on the CPU test mesh.  The full path x bucket
+    # matrix therefore runs for the int8-feed regimes (the fixtures'
+    # production programs) and for the gather fallback (no kernel at all);
+    # the wider-weight regimes keep every XLA path but exercise the pallas
+    # kernel end-to-end only on the local path over buckets A and C (the
+    # corner-case bucket and the sb=4 super-block bucket).  Feed *routing*
+    # at the 127/128/129 boundaries is unit-tested in test_pallas_scorer.
+    val_flat = value_table(weights).reshape(-1)
+    full_pallas = mxu_feed(val_flat) == "i8" or not mm_formulation_exact(val_flat)
+    for bucket, (seq1, seqs) in enumerate(_problems(rng)):
         want = score_batch_oracle(seq1, seqs, weights)
         for name, scorer in paths.items():
+            if (
+                "pallas" in name
+                and not full_pallas
+                and not (name == "pallas" and bucket in (0, 2))
+            ):
+                continue
             got = scorer.score_codes(seq1, seqs, weights)
             assert [
                 tuple(int(x) for x in row) for row in got
