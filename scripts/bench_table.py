@@ -125,7 +125,9 @@ def row(config: str, hw: str, m: dict) -> str:
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--cpu", action="store_true", help="measure the CPU config row only")
-    ap.add_argument("--reps", type=int, default=32)
+    # 1024 amortised reps, matching bench.py: the device-time increment
+    # must dominate the host link's ±25 ms jitter (see bench.py).
+    ap.add_argument("--reps", type=int, default=1024)
     args = ap.parse_args()
 
     print("| Config | Hardware | Measured | vs est. reference (2.0e9 elem/s) |")
@@ -138,9 +140,10 @@ def main() -> None:
         ("input2.txt, 1 TPU chip", "input2.txt", "pallas", args.reps),
         ("input3.txt, 1 TPU chip", "input3.txt", "pallas", args.reps),
         ("input5.txt, 1 TPU chip", "input5.txt", "pallas", args.reps),
-        # 64 amortised reps: the per-rep device time must dominate
-        # host-link jitter for a stable slope (see bench.py).
-        ("synthetic max-size (~2.3e11 elem)", None, "pallas", 64),
+        # Fewer reps here: at ~2 ms/rep the 256-rep increment (~0.5 s)
+        # already dominates host-link jitter, and 1024 would double the
+        # script's runtime for no precision gain.
+        ("synthetic max-size (~2.3e11 elem)", None, "pallas", 256),
     ):
         problem = synthetic_max() if name is None else fixture_problem(name)
         m = measure(problem, backend, reps)
