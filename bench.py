@@ -181,8 +181,10 @@ def steady_state_wall(problem, backend: str, reps: int, medians: int = 1) -> flo
     slopes = [min_wall_slope(progs) for _ in range(max(1, medians))]
     # Spread only signals interference when the timed increment is itself
     # well above link jitter; latency-bound micro-workloads (sub-us slopes)
-    # spread arbitrarily and meaninglessly.
-    if max(slopes) * reps > 0.1 and max(slopes) > 2.5 * min(slopes) > 0:
+    # spread arbitrarily and meaninglessly.  Gate on the UNcontaminated
+    # (minimum) increment: a single jitter-inflated slope must not re-open
+    # the gate it is supposed to be filtered by.
+    if min(slopes) * reps > 0.1 and max(slopes) > 2.5 * min(slopes) > 0:
         # A co-tenant saturating the (shared, tunnelled) chip inflates
         # every slope it overlaps; the median cannot recover if the load
         # spans the whole invocation.  Flag it so a recorded outlier is

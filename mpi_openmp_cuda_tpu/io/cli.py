@@ -25,6 +25,13 @@ def _nonnegative_int(text: str) -> int:
     return value
 
 
+def _positive_int(text: str) -> int:
+    value = int(text)
+    if value < 1:
+        raise argparse.ArgumentTypeError(f"must be >= 1, got {value}")
+    return value
+
+
 def build_arg_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="mpi_openmp_cuda_tpu",
@@ -94,11 +101,43 @@ def build_arg_parser() -> argparse.ArgumentParser:
         help="retry the scoring phase up to N times on transient device "
         "failure (combine with --journal to resume mid-batch)",
     )
+    p.add_argument(
+        "--stream",
+        type=_positive_int,
+        default=None,
+        metavar="CHUNK",
+        help="pipelined mode: parse and score CHUNK sequences at a time, "
+        "overlapping host parsing with asynchronous device compute; host "
+        "memory stays bounded by CHUNK; byte-identical output, flushed "
+        "after the whole stream succeeds (fail-stop: no partial results)",
+    )
     return p
 
 
 class FeatureUnavailableError(RuntimeError):
     pass
+
+
+def _retrying(fn, retries: int, describe: str):
+    """Run ``fn()`` with driver-level retries on transient failure.
+
+    The single source of the transient-vs-programming classification:
+    (ValueError, TypeError) are shape/programming errors and always
+    propagate; anything else is retried up to ``retries`` times.
+    """
+    for attempt in range(retries + 1):
+        try:
+            return fn()
+        except (ValueError, TypeError):
+            raise
+        except Exception as e:
+            if attempt >= retries:
+                raise
+            print(
+                f"mpi_openmp_cuda_tpu: {describe} attempt {attempt + 1} "
+                f"failed ({e}); retrying",
+                file=sys.stderr,
+            )
 
 
 def _feature_import(what: str, importer):
@@ -168,6 +207,74 @@ def _build_sharding(mesh_arg: str | None):
     )
 
 
+def _run_streaming(args, timer: PhaseTimer) -> int:
+    """The --stream pipeline: parse and score CHUNK sequences at a time
+    with one chunk in flight on the device.
+
+    While chunk i computes (JAX dispatch is asynchronous), the host parses
+    and submits chunk i+1, then materialises chunk i — the host-IO /
+    device-compute overlap tier (SURVEY §2.4 PP row).  Host memory is
+    bounded by the chunk size (plus one ~30-byte line per result).
+    Formatted output is buffered and flushed only after the whole stream
+    succeeds, preserving the fail-stop contract: a truncated or invalid
+    batch emits nothing on stdout, exactly like the non-streaming path.
+    """
+    import io
+
+    from .parse import open_input, parse_stream_header
+
+    with timer.phase("setup"):
+        sharding = _build_sharding(args.mesh)
+        scorer = AlignmentScorer(backend=args.backend, sharding=sharding)
+
+    all_results = [] if args.json else None
+    lines = io.StringIO()
+
+    with open_input(args.input) as stream:
+        with timer.phase("parse_header"):
+            header = parse_stream_header(stream)
+        with timer.phase("stream"), device_trace(args.trace):
+            pending = None  # (PendingResult, start_index, codes)
+
+            def _finish(p, start, codes):
+                first = [p]
+
+                def attempt():
+                    # First attempt materialises the async dispatch; any
+                    # retry rescores the chunk synchronously from codes.
+                    if first:
+                        return first.pop().result()
+                    return scorer.score_codes(
+                        header.seq1_codes, codes, header.weights
+                    )
+
+                res = _retrying(attempt, args.retries, "chunk scoring")
+                print_results(res, out=lines, start=start)
+                if all_results is not None:
+                    all_results.extend(res)
+
+            for start, codes in header.iter_chunks(args.stream):
+                cur = _retrying(
+                    lambda codes=codes: scorer.score_codes_async(
+                        header.seq1_codes, codes, header.weights
+                    ),
+                    args.retries,
+                    "chunk dispatch",
+                )
+                if pending is not None:
+                    _finish(*pending)
+                pending = (cur, start, codes)
+            if pending is not None:
+                _finish(*pending)
+    sys.stdout.write(lines.getvalue())
+    if args.json:
+        write_json_sidecar(
+            all_results, args.json, meta={"backend": args.backend}
+        )
+    timer.report()
+    return 0
+
+
 def run(argv: list[str] | None = None) -> int:
     from ..utils.platform import apply_platform_override
 
@@ -183,11 +290,27 @@ def run(argv: list[str] | None = None) -> int:
              "hosts' collective schedules"),
             ("--retries", args.retries, "a retry loop on one host would "
              "rerun collectives the other hosts never re-enter"),
+            ("--stream", args.stream, "only the coordinator reads stdin; "
+             "the problem broadcast is whole-batch"),
         ):
             if bad:
                 print(
                     f"mpi_openmp_cuda_tpu: error: {flag} cannot be combined "
                     f"with --distributed ({why})",
+                    file=sys.stderr,
+                )
+                return 1
+    if args.stream:
+        for flag, bad, why in (
+            ("--journal", args.journal, "the journal fingerprints the "
+             "whole problem up front"),
+            ("--selfcheck", args.selfcheck, "selfcheck re-verifies against "
+             "the fully-materialised problem"),
+        ):
+            if bad:
+                print(
+                    f"mpi_openmp_cuda_tpu: error: {flag} cannot be combined "
+                    f"with --stream ({why})",
                     file=sys.stderr,
                 )
                 return 1
@@ -207,6 +330,8 @@ def run(argv: list[str] | None = None) -> int:
                 raise
 
     try:
+        if args.stream:
+            return _run_streaming(args, timer)
         coordinator = True
         if args.distributed:
             # Collective backends may write banners straight to fd 1 from
@@ -274,20 +399,7 @@ def run(argv: list[str] | None = None) -> int:
             )
 
         with timer.phase("score"), device_trace(args.trace):
-            for attempt in range(args.retries + 1):
-                try:
-                    results = _score_once()
-                    break
-                except (ValueError, TypeError):
-                    raise  # programming/shape errors are not transient
-                except Exception as e:
-                    if attempt >= args.retries:
-                        raise
-                    print(
-                        f"mpi_openmp_cuda_tpu: scoring attempt "
-                        f"{attempt + 1} failed ({e}); retrying",
-                        file=sys.stderr,
-                    )
+            results = _retrying(_score_once, args.retries, "scoring")
         if args.selfcheck:
             with timer.phase("selfcheck"):
 

@@ -18,10 +18,14 @@ def format_result(i: int, score: int, n: int, k: int) -> str:
 
 
 def print_results(
-    results: Iterable[Sequence[int]], out: TextIO | None = None
+    results: Iterable[Sequence[int]],
+    out: TextIO | None = None,
+    start: int = 0,
 ) -> None:
+    """``start`` offsets the printed indices — the streaming pipeline
+    prints chunk by chunk while keeping global input-order numbering."""
     out = out or sys.stdout
-    for i, (score, n, k) in enumerate(results):
+    for i, (score, n, k) in enumerate(results, start=start):
         print(format_result(i, int(score), int(n), int(k)), file=out)
 
 

@@ -1,0 +1,113 @@
+"""Streaming pipeline tests (--stream): chunked parse -> async score ->
+print with one chunk in flight.  Output must be byte-identical to the
+non-streaming path for every chunk size, including chunk sizes that do not
+divide N and chunks larger than N (SURVEY §2.4 PP row: the host-IO /
+device-compute overlap tier)."""
+
+import io
+
+import numpy as np
+import pytest
+
+from conftest import reference_fixture
+
+from test_cli import golden, run_cli
+
+from mpi_openmp_cuda_tpu.io.parse import (
+    InputFormatError,
+    parse_problem,
+    parse_stream_header,
+)
+from mpi_openmp_cuda_tpu.ops.dispatch import AlignmentScorer
+from mpi_openmp_cuda_tpu.ops.oracle import score_batch_oracle
+
+
+@pytest.mark.parametrize("chunk", [1, 3, 64])
+def test_stream_fixture_byte_exact(chunk):
+    path = reference_fixture("input1.txt")  # N=10: uneven for chunk=3
+    proc = run_cli("--stream", str(chunk), stdin_path=path)
+    assert proc.stdout == golden("input1.out")
+
+
+def test_stream_with_mesh_and_json(tmp_path):
+    path = reference_fixture("input6.txt")
+    sidecar = tmp_path / "out.json"
+    proc = run_cli(
+        "--stream", "2", "--mesh", "4", "--json", str(sidecar),
+        stdin_path=path,
+    )
+    assert proc.stdout == golden("input6.out")
+    import json
+
+    payload = json.loads(sidecar.read_text())
+    want = [
+        line.split() for line in golden("input6.out").strip().splitlines()
+    ]
+    assert len(payload["results"]) == len(want)
+    for row, text in zip(payload["results"], want):
+        # "#i: score: S, n: N, k: K"
+        assert row["score"] == int(text[2].rstrip(","))
+
+
+def test_stream_rejects_journal_and_selfcheck(tmp_path):
+    path = reference_fixture("input5.txt")
+    for flag in (("--journal", str(tmp_path / "j.jsonl")), ("--selfcheck",)):
+        proc = run_cli("--stream", "2", *flag, stdin_path=path, check=False)
+        assert proc.returncode != 0
+        assert "cannot be combined with --stream" in proc.stderr
+
+
+def test_stream_header_then_chunks_matches_parse_problem(rng):
+    seqs = ["ab", "CDEF", "ghij", "KL", "mnopq"]
+    text = "10 2 3 4\nAbCdEfGh\n5\n" + "\n".join(seqs) + "\n"
+    header = parse_stream_header(io.StringIO(text))
+    whole = parse_problem(io.StringIO(text))
+    assert header.weights == whole.weights
+    assert header.num_seq2 == 5
+    assert np.array_equal(header.seq1_codes, whole.seq1_codes)
+    got = []
+    for start, codes in header.iter_chunks(2):
+        assert start == len(got)
+        got.extend(codes)
+    assert len(got) == 5
+    for a, b in zip(got, whole.seq2_codes):
+        assert np.array_equal(a, b)
+
+
+def test_stream_truncated_input_emits_nothing(tmp_path):
+    # Fail-stop: a stream that dies mid-batch must not leave partial
+    # results on stdout (same contract as the non-streaming path).
+    bad = tmp_path / "trunc.txt"
+    bad.write_text("10 2 3 4\nABCDEFGH\n5\nAB\nCD\n")
+    proc = run_cli("--stream", "2", "--input", str(bad), check=False)
+    assert proc.returncode != 0
+    assert proc.stdout == ""
+    assert "ended at 2" in proc.stderr
+
+
+def test_stream_truncated_batch_raises():
+    header = parse_stream_header(io.StringIO("10 2 3 4\nABCD\n3\nAB\n"))
+    with pytest.raises(InputFormatError, match="ended at 1"):
+        for _ in header.iter_chunks(2):
+            pass
+
+
+def test_stream_tiny_buffer_token_reassembly():
+    # Tokens split across read-buffer boundaries must reassemble.
+    from mpi_openmp_cuda_tpu.io.parse import _iter_tokens
+
+    text = "10 2 3 4  ABCDEFGH  2  ABCDE FGHIJ \n"
+    toks = list(_iter_tokens(io.StringIO(text), bufsize=3))
+    assert toks == text.split()
+
+
+def test_score_codes_async_matches_sync(rng):
+    seq1 = rng.integers(1, 27, size=90).astype(np.int8)
+    seqs = [rng.integers(1, 27, size=int(n)).astype(np.int8) for n in (5, 40, 89)]
+    weights = [10, 2, 3, 4]
+    scorer = AlignmentScorer("xla")
+    pending = scorer.score_codes_async(seq1, seqs, weights)
+    got = [tuple(int(x) for x in row) for row in pending.result()]
+    assert got == score_batch_oracle(seq1, seqs, weights)
+    # empty batch contract
+    assert scorer.score_codes_async(seq1, [], weights).result().shape == (0, 3)
