@@ -107,9 +107,10 @@ def build_arg_parser() -> argparse.ArgumentParser:
         default=None,
         metavar="CHUNK",
         help="pipelined mode: parse and score CHUNK sequences at a time, "
-        "overlapping host parsing with asynchronous device compute; host "
-        "memory stays bounded by CHUNK; byte-identical output, flushed "
-        "after the whole stream succeeds (fail-stop: no partial results)",
+        "overlapping host parsing with asynchronous device compute; live "
+        "host memory is bounded by CHUNK plus one buffered output line "
+        "per result; byte-identical output, flushed after the whole "
+        "stream succeeds (fail-stop: no partial results)",
     )
     return p
 
@@ -284,36 +285,33 @@ def run(argv: list[str] | None = None) -> int:
     # Static argument-compatibility checks: fail before any expensive phase
     # (a multi-host job should not complete init + broadcast just to learn
     # its flags conflict).
-    if args.distributed:
-        for flag, bad, why in (
-            ("--journal", args.journal, "resume would desynchronise the "
-             "hosts' collective schedules"),
-            ("--retries", args.retries, "a retry loop on one host would "
-             "rerun collectives the other hosts never re-enter"),
-            ("--stream", args.stream, "only the coordinator reads stdin; "
-             "the problem broadcast is whole-batch"),
-        ):
+    def _reject_combos(base: str, pairs) -> bool:
+        for flag, bad, why in pairs:
             if bad:
                 print(
                     f"mpi_openmp_cuda_tpu: error: {flag} cannot be combined "
-                    f"with --distributed ({why})",
+                    f"with {base} ({why})",
                     file=sys.stderr,
                 )
-                return 1
-    if args.stream:
-        for flag, bad, why in (
-            ("--journal", args.journal, "the journal fingerprints the "
-             "whole problem up front"),
-            ("--selfcheck", args.selfcheck, "selfcheck re-verifies against "
-             "the fully-materialised problem"),
-        ):
-            if bad:
-                print(
-                    f"mpi_openmp_cuda_tpu: error: {flag} cannot be combined "
-                    f"with --stream ({why})",
-                    file=sys.stderr,
-                )
-                return 1
+                return True
+        return False
+
+    if args.distributed and _reject_combos("--distributed", (
+        ("--journal", args.journal, "resume would desynchronise the "
+         "hosts' collective schedules"),
+        ("--retries", args.retries, "a retry loop on one host would "
+         "rerun collectives the other hosts never re-enter"),
+        ("--stream", args.stream, "only the coordinator reads stdin; "
+         "the problem broadcast is whole-batch"),
+    )):
+        return 1
+    if args.stream and _reject_combos("--stream", (
+        ("--journal", args.journal, "the journal fingerprints the "
+         "whole problem up front"),
+        ("--selfcheck", args.selfcheck, "selfcheck re-verifies against "
+         "the fully-materialised problem"),
+    )):
+        return 1
 
     guard = None
     out_stream = None  # None -> sys.stdout

@@ -170,11 +170,11 @@ def _kernel(meta_ref, codes_ref, a_ref, score_ref, k_ref, k0_ref, *, nbn, nbi, f
             # rotate's wraparound never contaminates a consumed lane.
             vp = pltpu.roll(vp, shift=0, axis=1, stride=1, stride_axis=0)
             # Reversed-lane diagonals: lane m holds offset n0 + sbw-1-m.
-            d0 = vp[:, _BLK:]
-            d1 = vp[:, _BLK - 1 : sbw + _BLK - 1]
             if feed == "f32":
                 # f32 MXU runs at ~1/8 the bf16 rate: one fused matmul on
                 # the delta, t1 via a VPU sublane reduction.
+                d0 = vp[:, _BLK:]
+                d1 = vp[:, _BLK - 1 : sbw + _BLK - 1]
                 dd = (d0 - d1).astype(dd_t)
                 lp = jnp.dot(ltri, dd, preferred_element_type=jnp.float32)
                 t1 = t1 + jnp.sum(d1, axis=0)
@@ -184,9 +184,17 @@ def _kernel(meta_ref, codes_ref, a_ref, score_ref, k_ref, k0_ref, *, nbn, nbi, f
                 # increment.  The second cheap bf16 matmul replaces two
                 # full-tile VPU passes (the dd subtract and the t1 sublane
                 # reduction), worth ~1.35x on the i8 feed (BASELINE.md).
-                # d0/d1 entries are integers |v| <= 128: bf16-exact.
-                pa = jnp.dot(ltri, d0.astype(dd_t), preferred_element_type=jnp.float32)
-                pb = jnp.dot(ltri, d1.astype(dd_t), preferred_element_type=jnp.float32)
+                # One full-width bf16 cast feeds both operand slices
+                # (entries are integers |v| <= 128: bf16-exact).
+                vb = vp.astype(dd_t)
+                pa = jnp.dot(
+                    ltri, vb[:, _BLK:], preferred_element_type=jnp.float32
+                )
+                pb = jnp.dot(
+                    ltri,
+                    vb[:, _BLK - 1 : sbw + _BLK - 1],
+                    preferred_element_type=jnp.float32,
+                )
                 lp = pa - pb
                 t1 = t1 + pb[_BLK - 1, :]
             g = lp + carry[None, :]

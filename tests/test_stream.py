@@ -57,7 +57,7 @@ def test_stream_rejects_journal_and_selfcheck(tmp_path):
         assert "cannot be combined with --stream" in proc.stderr
 
 
-def test_stream_header_then_chunks_matches_parse_problem(rng):
+def test_stream_header_then_chunks_matches_parse_problem():
     seqs = ["ab", "CDEF", "ghij", "KL", "mnopq"]
     text = "10 2 3 4\nAbCdEfGh\n5\n" + "\n".join(seqs) + "\n"
     header = parse_stream_header(io.StringIO(text))
