@@ -72,20 +72,9 @@ def pick_backend() -> str:
     forced = os.environ.get("BENCH_BACKEND")
     if forced:
         return forced
-    try:
-        import jax
+    from mpi_openmp_cuda_tpu.ops.dispatch import resolve_auto_backend
 
-        on_tpu = jax.devices()[0].platform == "tpu"
-    except Exception:
-        on_tpu = False
-    if on_tpu:
-        try:
-            import mpi_openmp_cuda_tpu.ops.pallas_scorer  # noqa: F401
-
-            return "pallas"
-        except Exception:
-            pass
-    return "xla"
+    return resolve_auto_backend()
 
 
 # Floor for a non-positive measured slope (sub-timer-resolution workloads);

@@ -45,10 +45,11 @@ def build_arg_parser() -> argparse.ArgumentParser:
     )
     p.add_argument(
         "--backend",
-        choices=("xla", "xla-gather", "pallas", "oracle"),
-        default="xla",
-        help="compute path: pure-XLA MXU formulation (default), gather "
-        "formulation, Pallas TPU kernel, or host numpy oracle",
+        choices=("auto", "xla", "xla-gather", "pallas", "oracle"),
+        default="auto",
+        help="compute path (default auto: fused Pallas TPU kernel on a "
+        "real TPU, pure-XLA MXU formulation elsewhere); or force xla, "
+        "xla-gather, pallas, or the host numpy oracle",
     )
     p.add_argument(
         "--mesh",
@@ -270,7 +271,7 @@ def _run_streaming(args, timer: PhaseTimer) -> int:
     sys.stdout.write(lines.getvalue())
     if args.json:
         write_json_sidecar(
-            all_results, args.json, meta={"backend": args.backend}
+            all_results, args.json, meta={"backend": scorer.backend}
         )
     timer.report()
     return 0
@@ -419,7 +420,7 @@ def run(argv: list[str] | None = None) -> int:
                 print_results(results, out=out_stream)
                 if args.json:
                     write_json_sidecar(
-                        results, args.json, meta={"backend": args.backend}
+                        results, args.json, meta={"backend": scorer.backend}
                     )
         timer.report()
         # Close the guard while still inside the try: the final flush of

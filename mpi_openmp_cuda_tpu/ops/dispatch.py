@@ -103,6 +103,43 @@ def choose_chunk_rows(per_pair: int, budget: int, per_dev_rows: int) -> int:
     return cb
 
 
+def resolve_auto_backend() -> str:
+    """'pallas' when the runtime default backend is a real TPU and the
+    pallas module imports, else 'xla'.
+
+    The policy behind the CLI's / native driver's / bench's 'auto'
+    default: on TPU the fused kernel is the fastest exact path (with its
+    own per-call routing for wide weights and unaligned buckets); off-TPU
+    pallas would run interpret mode, far slower than the XLA formulation.
+    """
+    try:
+        import jax
+
+        on_tpu = jax.default_backend() == "tpu"
+    except Exception:
+        on_tpu = False
+    if on_tpu:
+        try:
+            from . import pallas_scorer  # noqa: F401
+
+            return "pallas"
+        except Exception as e:
+            # Never silent: a broken pallas build on TPU downgrades the
+            # default path 26x, and in a multi-host job a host resolving
+            # differently from its peers would desynchronise collectives —
+            # the operator must see why this host chose 'xla'.
+            import sys
+
+            print(
+                "mpi_openmp_cuda_tpu: warning: backend 'auto' fell back to "
+                f"'xla' on a TPU host (pallas import failed: {e}); pass an "
+                "explicit --backend to silence or to fail fast",
+                file=sys.stderr,
+            )
+            return "xla"
+    return "xla"
+
+
 def mm_formulation_exact(val_flat: np.ndarray) -> bool:
     """True when every partial sum stays an exact float32 integer on the
     matmul path (|score| <= BUF_SIZE_SEQ2 * max|value| < 2^24)."""
@@ -202,11 +239,12 @@ class AlignmentScorer:
     """Front door to the accelerated scoring paths (the C2 offload ABI's
     Python-side equivalent).
 
-    backend: 'xla' (default: the gather-free MXU matmul formulation, with
-    an automatic fall-back to the gather formulation when weight magnitudes
-    could exceed float32 integer exactness), 'xla-gather' (force the
-    int32 gather formulation), 'pallas' (TPU kernel), or 'oracle' (host
-    numpy — the always-correct reference path).
+    backend: 'auto' (pallas on a real TPU, xla otherwise — see
+    resolve_auto_backend), 'xla' (the gather-free MXU matmul formulation,
+    with an automatic fall-back to the gather formulation when weight
+    magnitudes could exceed float32 integer exactness), 'xla-gather'
+    (force the int32 gather formulation), 'pallas' (TPU kernel), or
+    'oracle' (host numpy — the always-correct reference path).
     """
 
     def __init__(
@@ -215,6 +253,8 @@ class AlignmentScorer:
         chunk_budget: int = DEFAULT_CHUNK_BUDGET,
         sharding=None,
     ):
+        if backend == "auto":
+            backend = resolve_auto_backend()
         if backend not in ("xla", "xla-gather", "pallas", "oracle"):
             raise ValueError(f"unknown backend {backend!r}")
         self.backend = backend

@@ -110,7 +110,7 @@ extern "C" void send_divided_Seq2_To_Cuda(char *seq2_divided, int seq2_size,
 
   ensure_python();
   const char *backend = std::getenv("TPU_SEQALIGN_BACKEND");
-  if (!backend || !*backend) backend = "xla";
+  if (!backend || !*backend) backend = "auto";
   const int mesh = env_int("TPU_SEQALIGN_MESH", 0);
 
   PyObject *mod = PyImport_ImportModule("mpi_openmp_cuda_tpu.native_bridge");

@@ -101,6 +101,13 @@ def test_stream_tiny_buffer_token_reassembly():
     assert toks == text.split()
 
 
+def test_auto_backend_resolves_off_tpu():
+    # On the CPU test mesh 'auto' must pick the XLA formulation (pallas
+    # would run interpret mode); on a real TPU it resolves to 'pallas'
+    # (exercised by the driver-hook and bench runs on hardware).
+    assert AlignmentScorer("auto").backend == "xla"
+
+
 def test_score_codes_async_matches_sync(rng):
     seq1 = rng.integers(1, 27, size=90).astype(np.int8)
     seqs = [rng.integers(1, 27, size=int(n)).astype(np.int8) for n in (5, 40, 89)]
