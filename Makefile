@@ -55,6 +55,12 @@ check: final
 	JAX_PLATFORMS=cpu ./final < tests/fixtures/tiny.txt > /tmp/check_tiny.out
 	diff /tmp/check_tiny.out tests/fixtures/tiny.out
 
+# Hardware conformance: every backend x MXU-feed regime vs the oracle on
+# the REAL device (interpret-mode tests cannot see Mosaic/MXU-precision
+# divergences).  Run after any kernel or numerics change.
+check-tpu:
+	$(PYTHON) scripts/tpu_conformance.py
+
 bench:
 	$(PYTHON) bench.py
 

@@ -9,8 +9,13 @@ the hardware's strengths:
   seq1[j]]`` becomes ``onehot(seq2) @ (val @ onehot(seq1).T)`` — the
   ``[27, W]`` right factor is shared by the whole batch, so each pair costs
   one ``[L2P, 27] x [27, W]`` matmul.  Integer values < 2^24 are exact in
-  float32 (the dispatch layer falls back to the gather path for weights
-  that could overflow this).
+  float32 *accumulation*, but TPU MXUs MULTIPLY f32 at bf16 precision by
+  default (single pass), which silently rounds values above 2^8 — every
+  f32 matmul here therefore runs ``Precision.HIGHEST`` (multi-pass bf16),
+  exact for these operands because one side is always 0/1 and the other's
+  values fit 16 mantissa bits (|v| <= 4095, |d0-d1| <= 8190).  The
+  dispatch layer falls back to the gather path for weights that could
+  overflow the 2^24 accumulation bound.
 * **Diagonal shear via pad+reshape (zero data movement).**  Appending one
   zero column's worth of padding to ``V``'s flat buffer and re-viewing it
   with row stride W+1 shifts row i left by i: ``D[i, n] = V[i, i+n]`` —
@@ -80,7 +85,11 @@ def _block_prefix(d: jax.Array) -> jax.Array:
     ltri = (ii[:, None] >= ii[None, :]).astype(d.dtype)
     blocks = d.reshape(nb, _SCAN_BLOCK, w)
     within = jnp.einsum(
-        "kb,nbw->nkw", ltri, blocks, preferred_element_type=d.dtype
+        "kb,nbw->nkw",
+        ltri,
+        blocks,
+        preferred_element_type=d.dtype,
+        precision=lax.Precision.HIGHEST,
     )
     carry = jnp.cumsum(within[:, -1, :], axis=0) - within[:, -1, :]
     return (within + carry[:, None, :]).reshape(m, w)
@@ -112,6 +121,7 @@ def _score_pair_mm(a_right, len1, seq2row, len2, noff):
         a_right,
         (((1,), (0,)), ((), ())),
         preferred_element_type=jnp.float32,
+        precision=lax.Precision.HIGHEST,
     )  # [L2P, W]
 
     d = _shear(v)  # [L2P, W+1]
@@ -164,6 +174,7 @@ def score_chunks_mm_body(seq1ext, len1, seq2_chunks, len2_chunks, val_flat):
         oh1,
         (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32,
+        precision=lax.Precision.HIGHEST,
     )  # [27, W]
 
     def chunk_fn(args):

@@ -42,9 +42,11 @@ order (cudaFunctions.cu:161) is preserved: strictly-greater running updates
 keep the smallest kappa, first-hit row selection uses a min-index reduction,
 and k=0 (kappa = len2) outranks equal-scoring k >= 1 via the G[len2]
 capture.  Float32 math is exact for |weight| <= 4095 (same bound as the
-matmul path); the module transparently falls back to the XLA bodies for
-larger weights or for shape buckets that are not 128-aligned (e.g. the
-tiny-shape multi-chip dryrun).
+matmul path; f32-feed matmuls run Precision.HIGHEST because TPU MXUs
+multiply f32 at bf16 precision by default — see ops/matmul_scorer.py);
+the module transparently falls back to the XLA bodies for larger weights
+or for shape buckets that are not 128-aligned (e.g. the tiny-shape
+multi-chip dryrun).
 
 Two workload-adaptive fast paths on top of the baseline kernel:
 
@@ -162,7 +164,14 @@ def _kernel(meta_ref, codes_ref, a_ref, score_ref, k_ref, k0_ref, *, nbn, nbi, f
             # chars and seq1 positions past len1 contribute exactly 0
             # through the matmul itself.
             acc_t = jnp.int32 if feed == "i8" else jnp.float32
-            vp = jnp.dot(oh, aband, preferred_element_type=acc_t)
+            # TPU MXUs multiply f32 at bf16 precision by default; the f32
+            # feed (128 < |v| <= 4095) needs multi-pass HIGHEST to stay
+            # exact (one operand is 0/1, values fit 16 mantissa bits).
+            # The i8/bf16 feeds are exact natively.
+            prec = lax.Precision.HIGHEST if feed == "f32" else None
+            vp = jnp.dot(
+                oh, aband, preferred_element_type=acc_t, precision=prec
+            )
             vp = vp.astype(jnp.float32)  # int32 entries <= 127: exact
             # Shear row r left by r = strided rotate right by r on the
             # reversed lanes; one hardware op replaces the 7-step
@@ -176,7 +185,12 @@ def _kernel(meta_ref, codes_ref, a_ref, score_ref, k_ref, k0_ref, *, nbn, nbi, f
                 d0 = vp[:, _BLK:]
                 d1 = vp[:, _BLK - 1 : sbw + _BLK - 1]
                 dd = (d0 - d1).astype(dd_t)
-                lp = jnp.dot(ltri, dd, preferred_element_type=jnp.float32)
+                lp = jnp.dot(
+                    ltri,
+                    dd,
+                    preferred_element_type=jnp.float32,
+                    precision=lax.Precision.HIGHEST,  # |dd| <= 8190 > 2^8
+                )
                 t1 = t1 + jnp.sum(d1, axis=0)
             else:
                 # Split prefix matmuls: lp = ltri@d0 - ltri@d1, and row 127
@@ -294,7 +308,11 @@ def _pallas_offset_surfaces(seq1ext, len1, rows, lens, val_flat, feed="f32"):
         == jnp.arange(ALPHABET_SIZE, dtype=jnp.int32)[None, :]
     ).astype(jnp.float32)
     a_small = lax.dot_general(
-        val27, oh1, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        val27,
+        oh1,
+        (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+        precision=lax.Precision.HIGHEST,  # f32-feed values exceed 2^8
     )  # [27, Wneed]; integer entries |v| <= 128 on the bf16 path: exact cast
     # Lane-reversed storage: the kernel's strided-rotate shear only turns
     # one way (see _kernel).

@@ -1,0 +1,111 @@
+"""Hardware conformance check: every backend x MXU-feed regime vs the oracle,
+ON THE ACTUAL DEVICE.
+
+The pytest suite runs on a virtual CPU mesh where the Pallas kernel executes
+in interpret mode and XLA matmuls multiply f32 natively — both can pass
+where real-TPU lowering diverges.  This caught a real defect: TPU MXUs
+multiply f32 at bf16 precision by default, silently rounding pair values
+above 2^8 on the f32 feed and the XLA mm path (fixed with
+``Precision.HIGHEST``; see ops/matmul_scorer.py docstring).  Run this on
+the real chip after ANY kernel or numerics change:
+
+    python scripts/tpu_conformance.py
+
+Exit 0 = every (backend, weight-regime) pair matches the host oracle
+bit-exactly on shapes that exercise all three feeds, the offset-block
+skip boundaries, equal-length, overlong, and tie-heavy cases.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+from mpi_openmp_cuda_tpu.ops.dispatch import AlignmentScorer
+from mpi_openmp_cuda_tpu.ops.oracle import score_batch_oracle
+
+# One regime per MXU feed plus the boundaries and the gather fallback.
+WEIGHT_REGIMES = [
+    [10, 2, 3, 4],     # i8 feed (fixtures' regime)
+    [127, 2, 3, 4],    # i8 upper boundary
+    [128, 2, 3, 4],    # bf16 boundary
+    [300, 7, 1, 2],    # f32 feed (the regime the default precision broke)
+    [4095, 1, 1, 1],   # f32 upper boundary
+    [4096, 1, 1, 1],   # int32 gather fallback
+    [1, 1, 1, 1],      # maximal ties
+]
+
+BACKENDS = ["pallas", "xla", "xla-gather"]
+
+
+def problems():
+    rng = np.random.default_rng(11)
+    seq1 = rng.integers(1, 27, size=700).astype(np.int8)
+    seqs = [
+        rng.integers(1, 27, size=int(n)).astype(np.int8)
+        for n in (60, 250, 512, 699, 30)
+    ]
+    seqs.append(seq1.copy())           # equal length
+    seqs.append(rng.integers(1, 27, size=701).astype(np.int8))  # overlong
+    seqs.append(np.zeros(0, dtype=np.int8))                      # empty
+    yield seq1, seqs
+    # low-entropy tie storm, smaller bucket
+    seq1b = rng.integers(1, 3, size=300).astype(np.int8)
+    yield seq1b, [rng.integers(1, 3, size=n).astype(np.int8) for n in (7, 150, 299)]
+
+
+def main() -> int:
+    # Respect an explicit JAX_PLATFORMS choice (TPU site hooks can clobber
+    # it): a CPU-forced run must hit the platform gate below, not silently
+    # land back on the TPU.
+    from mpi_openmp_cuda_tpu.utils.platform import apply_platform_override
+
+    apply_platform_override()
+    import jax
+
+    device = jax.devices()[0]
+    print(f"device: {device.device_kind} ({device.platform})", file=sys.stderr)
+    if device.platform != "tpu":
+        # Off-TPU this script cannot see the divergences it exists to
+        # catch (interpret-mode Pallas, native f32 multiplies): passing
+        # here would be false assurance.
+        print(
+            "tpu_conformance: FAIL — not running on a TPU (platform "
+            f"{device.platform!r}); run on the real chip",
+            file=sys.stderr,
+        )
+        return 1
+    failures = 0
+    for backend in BACKENDS:
+        scorer = AlignmentScorer(backend)
+        for weights in WEIGHT_REGIMES:
+            for pi, (seq1, seqs) in enumerate(problems()):
+                got = [
+                    tuple(int(x) for x in r)
+                    for r in scorer.score_codes(seq1, seqs, weights)
+                ]
+                want = score_batch_oracle(seq1, seqs, weights)
+                tag = f"{backend} w={weights[0]} problem={pi}"
+                if got == want:
+                    print(f"OK   {tag}", file=sys.stderr)
+                else:
+                    failures += 1
+                    bad = [i for i, (g, w) in enumerate(zip(got, want)) if g != w]
+                    print(
+                        f"FAIL {tag}: rows {bad}: "
+                        f"got={[got[i] for i in bad]} want={[want[i] for i in bad]}",
+                        file=sys.stderr,
+                    )
+    if failures:
+        print(f"tpu_conformance: {failures} FAILURES", file=sys.stderr)
+        return 1
+    print("tpu_conformance: all regimes bit-exact on device", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
