@@ -220,8 +220,16 @@ def _run_streaming(args, timer: PhaseTimer) -> int:
     Formatted output is buffered and flushed only after the whole stream
     succeeds, preserving the fail-stop contract: a truncated or invalid
     batch emits nothing on stdout, exactly like the non-streaming path.
+
+    With --journal, a StreamJournal composes resume with the bounded
+    memory: the header fingerprints (weights, Seq1, N) and every record
+    carries a per-sequence content hash, so a preempted run rescores only
+    the sequences the journal has no (hash-matching) entry for.
     """
+    import contextlib
     import io
+
+    import numpy as np
 
     from .parse import open_input, parse_stream_header
 
@@ -235,37 +243,109 @@ def _run_streaming(args, timer: PhaseTimer) -> int:
     with open_input(args.input) as stream:
         with timer.phase("parse_header"):
             header = parse_stream_header(stream)
-        with timer.phase("stream"), device_trace(args.trace):
-            pending = None  # (PendingResult, start_index, codes)
+        journal, seq_hash, mismatch_error, done = None, None, None, {}
+        if args.journal:
 
-            def _finish(p, start, codes):
-                first = [p]
+            def _imp():
+                from ..utils.journal import (
+                    JournalMismatchError,
+                    StreamJournal,
+                    seq_hash,
+                )
+
+                return StreamJournal, seq_hash, JournalMismatchError
+
+            StreamJournal, seq_hash, mismatch_error = _feature_import(
+                "--journal resume", _imp
+            )
+            journal = StreamJournal(
+                args.journal, header.weights, header.seq1_codes, header.num_seq2
+            )
+            done = journal.load()
+
+        def _submit(start, codes):
+            """Dispatch a chunk; returns (promise, start, codes, pend, rows,
+            hashes).  pend is None without a journal (whole chunk scored);
+            with one, only hash-missing sequences are dispatched and rows
+            pre-holds the journalled results."""
+            if journal is None:
+                promise = _retrying(
+                    lambda: scorer.score_codes_async(
+                        header.seq1_codes, codes, header.weights
+                    ),
+                    args.retries,
+                    "chunk dispatch",
+                )
+                return (promise, start, codes, None, None, None)
+            hashes = [seq_hash(c) for c in codes]
+            pend = []
+            rows = np.zeros((len(codes), 3), dtype=np.int32)
+            for j, h in enumerate(hashes):
+                rec = done.get(start + j)
+                if rec is not None and rec[0] == h:
+                    rows[j] = rec[1]
+                elif rec is not None:
+                    raise mismatch_error(
+                        f"journal entry for sequence {start + j} does not "
+                        "match the input (sequence changed); delete the "
+                        "journal or pass a fresh --journal path"
+                    )
+                else:
+                    pend.append(j)
+            promise = None
+            if pend:
+                promise = _retrying(
+                    lambda: scorer.score_codes_async(
+                        header.seq1_codes,
+                        [codes[j] for j in pend],
+                        header.weights,
+                    ),
+                    args.retries,
+                    "chunk dispatch",
+                )
+            return (promise, start, codes, pend, rows, hashes)
+
+        def _finish(promise, start, codes, pend, rows, hashes):
+            res = None
+            if promise is not None:
+                first = [promise]
 
                 def attempt():
                     # First attempt materialises the async dispatch; any
                     # retry rescores the chunk synchronously from codes.
                     if first:
                         return first.pop().result()
+                    sub = codes if pend is None else [codes[j] for j in pend]
                     return scorer.score_codes(
-                        header.seq1_codes, codes, header.weights
+                        header.seq1_codes, sub, header.weights
                     )
 
                 res = _retrying(attempt, args.retries, "chunk scoring")
-                print_results(res, out=lines, start=start)
-                if all_results is not None:
-                    all_results.extend(res)
+            if pend is None:
+                out = res
+            else:
+                out = rows
+                if res is not None:
+                    for j, row in zip(pend, res):
+                        out[j] = row
+                    journal.append(
+                        [start + j for j in pend],
+                        [hashes[j] for j in pend],
+                        res,
+                    )
+            print_results(out, out=lines, start=start)
+            if all_results is not None:
+                all_results.extend(out)
 
+        with timer.phase("stream"), device_trace(args.trace), (
+            journal if journal is not None else contextlib.nullcontext()
+        ):
+            pending = None
             for start, codes in header.iter_chunks(args.stream):
-                cur = _retrying(
-                    lambda codes=codes: scorer.score_codes_async(
-                        header.seq1_codes, codes, header.weights
-                    ),
-                    args.retries,
-                    "chunk dispatch",
-                )
+                cur = _submit(start, codes)
                 if pending is not None:
                     _finish(*pending)
-                pending = (cur, start, codes)
+                pending = cur
             if pending is not None:
                 _finish(*pending)
     sys.stdout.write(lines.getvalue())
@@ -307,8 +387,6 @@ def run(argv: list[str] | None = None) -> int:
     )):
         return 1
     if args.stream and _reject_combos("--stream", (
-        ("--journal", args.journal, "the journal fingerprints the "
-         "whole problem up front"),
         ("--selfcheck", args.selfcheck, "selfcheck re-verifies against "
          "the fully-materialised problem"),
     )):

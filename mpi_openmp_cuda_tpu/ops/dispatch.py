@@ -176,9 +176,11 @@ def xla_formulation_mode(backend: str, val_flat: np.ndarray) -> str:
 def resolve_xla_formulation(backend: str, val_flat: np.ndarray):
     """Pick the jitted chunked scorer for an 'xla*' backend string."""
     if xla_formulation_mode(backend, val_flat) == "mm":
-        from .matmul_scorer import score_chunks_mm
+        from .matmul_scorer import mm_precision, score_chunks_mm
 
-        return score_chunks_mm
+        return functools.partial(
+            score_chunks_mm, mm_precision=mm_precision(val_flat)
+        )
     from .xla_scorer import score_chunks
 
     return score_chunks
@@ -197,9 +199,11 @@ def resolve_chunks_body(backend: str, val_flat: np.ndarray):
             return functools.partial(score_chunks_pallas_body, feed=fm[1])
         backend = "xla-gather"
     if xla_formulation_mode(backend, val_flat) == "mm":
-        from .matmul_scorer import score_chunks_mm_body
+        from .matmul_scorer import mm_precision, score_chunks_mm_body
 
-        return score_chunks_mm_body
+        return functools.partial(
+            score_chunks_mm_body, mm_precision=mm_precision(val_flat)
+        )
     from .xla_scorer import score_chunks_body
 
     return score_chunks_body

@@ -46,6 +46,23 @@ _NEG = jnp.float32(-(2.0**40))
 # integer (|score| <= BUF_SIZE_SEQ2 * max_w < 2^24).
 MAX_EXACT_WEIGHT = 4095
 
+# Up to this bound the MXU's DEFAULT f32 precision (single-pass bf16
+# multiplies) is already exact: one operand is 0/1 and |d0-d1| <= 2*128
+# = 2^8 fits bf16's mantissa.  Above it the matmuls must run
+# Precision.HIGHEST (multi-pass) to stay exact on TPU hardware.
+MAX_NATIVE_PRECISION_WEIGHT = 128
+
+
+def mm_precision(val_flat) -> "lax.Precision | None":
+    """Static matmul precision for a CONCRETE value table: None (default,
+    fastest) when single-pass bf16 multiplies are exact for these values,
+    Precision.HIGHEST otherwise."""
+    from .values import max_abs_value
+
+    if max_abs_value(val_flat) <= MAX_NATIVE_PRECISION_WEIGHT:
+        return None
+    return lax.Precision.HIGHEST
+
 
 def _onehot(codes, width: int) -> jax.Array:
     return (
@@ -67,7 +84,7 @@ def _shear(v: jax.Array) -> jax.Array:
 _SCAN_BLOCK = 128  # MXU-native tile edge
 
 
-def _block_prefix(d: jax.Array) -> jax.Array:
+def _block_prefix(d: jax.Array, precision) -> jax.Array:
     """Inclusive prefix sum over axis 0 via a two-level block-scan.
 
     ``jnp.cumsum`` over a 1280-long axis and a full [M, M] triangular
@@ -79,7 +96,7 @@ def _block_prefix(d: jax.Array) -> jax.Array:
     """
     m, w = d.shape
     if m % _SCAN_BLOCK != 0:  # bucketing guarantees this; stay safe anyway
-        return jnp.cumsum(d, axis=0)
+        return jnp.cumsum(d, axis=0)  # adds: exact at any precision
     nb = m // _SCAN_BLOCK
     ii = jnp.arange(_SCAN_BLOCK)
     ltri = (ii[:, None] >= ii[None, :]).astype(d.dtype)
@@ -89,13 +106,13 @@ def _block_prefix(d: jax.Array) -> jax.Array:
         ltri,
         blocks,
         preferred_element_type=d.dtype,
-        precision=lax.Precision.HIGHEST,
+        precision=precision,
     )
     carry = jnp.cumsum(within[:, -1, :], axis=0) - within[:, -1, :]
     return (within + carry[:, None, :]).reshape(m, w)
 
 
-def _score_pair_mm(a_right, len1, seq2row, len2, noff):
+def _score_pair_mm(a_right, len1, seq2row, len2, noff, precision):
     """Score one pair against the shared right factor ``a_right`` =
     val @ onehot(seq1).T, shape [27, W].  Returns (score, n, k) int32.
 
@@ -121,14 +138,14 @@ def _score_pair_mm(a_right, len1, seq2row, len2, noff):
         a_right,
         (((1,), (0,)), ((), ())),
         preferred_element_type=jnp.float32,
-        precision=lax.Precision.HIGHEST,
+        precision=precision,
     )  # [L2P, W]
 
     d = _shear(v)  # [L2P, W+1]
     d0 = d[:, :noff]
     d1 = d[:, 1 : noff + 1]
     t1 = jnp.sum(d1, axis=0)  # [NOFF] shifted totals
-    g = _block_prefix(d0 - d1)  # [L2P, NOFF]; row r = kappa (r+1)
+    g = _block_prefix(d0 - d1, precision)  # [L2P, NOFF]; row r = kappa (r+1)
 
     # Valid kappa = 1..len2  <=>  rows 0..len2-1.
     gm = jnp.where((i < len2)[:, None], g, _NEG)
@@ -159,9 +176,22 @@ def _score_pair_mm(a_right, len1, seq2row, len2, noff):
     return jnp.stack([score, out_n, out_k])
 
 
-def score_chunks_mm_body(seq1ext, len1, seq2_chunks, len2_chunks, val_flat):
+def score_chunks_mm_body(
+    seq1ext,
+    len1,
+    seq2_chunks,
+    len2_chunks,
+    val_flat,
+    *,
+    mm_precision=lax.Precision.HIGHEST,
+):
     """MXU-path analogue of xla_scorer.score_chunks_body: [NC, CB, L2P]
-    chunked batch -> [NC, CB, 3] int32."""
+    chunked batch -> [NC, CB, 3] int32.
+
+    ``mm_precision`` must be static (jit static_argname / partial) and
+    come from :func:`mm_precision` on the concrete weights; the HIGHEST
+    default is always exact, merely slower than needed for small weights.
+    """
     nc, cb, l2p = seq2_chunks.shape
     noff = seq1ext.shape[0] - l2p - 1  # == L1P, same convention as gather path
     w = noff
@@ -174,16 +204,16 @@ def score_chunks_mm_body(seq1ext, len1, seq2_chunks, len2_chunks, val_flat):
         oh1,
         (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32,
-        precision=lax.Precision.HIGHEST,
+        precision=mm_precision,
     )  # [27, W]
 
     def chunk_fn(args):
         rows, lens = args
         return jax.vmap(
-            lambda r, l: _score_pair_mm(a_right, len1, r, l, noff)
+            lambda r, l: _score_pair_mm(a_right, len1, r, l, noff, mm_precision)
         )(rows, lens)
 
     return lax.map(chunk_fn, (seq2_chunks, len2_chunks))
 
 
-score_chunks_mm = jax.jit(score_chunks_mm_body)
+score_chunks_mm = jax.jit(score_chunks_mm_body, static_argnames=("mm_precision",))

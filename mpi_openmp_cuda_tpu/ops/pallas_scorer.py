@@ -389,8 +389,15 @@ def score_chunks_pallas_body(
     if not _shapes_supported(l1p, l2p):
         from .matmul_scorer import score_chunks_mm_body
 
+        # feed is static: only the f32 feed's values exceed the MXU's
+        # default-precision exactness bound (matmul_scorer.mm_precision).
         return score_chunks_mm_body(
-            seq1ext, len1, seq2_chunks, len2_chunks, val_flat
+            seq1ext,
+            len1,
+            seq2_chunks,
+            len2_chunks,
+            val_flat,
+            mm_precision=lax.Precision.HIGHEST if feed == "f32" else None,
         )
     out = _pallas_rows(
         seq1ext,
@@ -423,6 +430,7 @@ def pallas_pair_scorer(l1p: int, l2p: int, feed: str = "f32"):
                 rows.reshape(bl, 1, l2p).transpose(1, 0, 2),
                 lens.reshape(1, bl),
                 val_flat,
+                mm_precision=lax.Precision.HIGHEST if feed == "f32" else None,
             ).reshape(bl, 3)
         return _pallas_rows(seq1ext, len1, rows, lens, val_flat, feed=feed)
 

@@ -92,7 +92,13 @@ class BatchSharding:
                 # Same float32 bound as the matmul path: route to int32.
                 mode = ("gather",)
         else:
-            mode = (xla_formulation_mode(backend, val_flat),)
+            m = xla_formulation_mode(backend, val_flat)
+            if m == "mm":
+                from ..ops.matmul_scorer import mm_precision
+
+                mode = ("mm", mm_precision(val_flat))
+            else:
+                mode = (m,)
 
         d = self.n_devices
         b = batch.batch_size
@@ -122,7 +128,7 @@ class BatchSharding:
 def _sharded_fn(mesh, cb, mode: tuple):
     """Build (and cache) the jitted shard_map scorer for one mesh/chunk
     config; jit itself then caches per input-shape bucket.  ``mode`` is a
-    hashable formulation key — ('mm',), ('gather',) or
+    hashable formulation key — ('mm', precision), ('gather',) or
     ('pallas', l1p, l2p, feed) — never a closure object, so repeated calls
     hit the cache."""
     import jax
@@ -133,8 +139,9 @@ def _sharded_fn(mesh, cb, mode: tuple):
         pair_like = pallas_pair_scorer(mode[1], mode[2], mode[3])
         chunks_body = None
     elif mode[0] == "mm":
-        from ..ops.matmul_scorer import score_chunks_mm_body as chunks_body
+        from ..ops.matmul_scorer import score_chunks_mm_body
 
+        chunks_body = functools.partial(score_chunks_mm_body, mm_precision=mode[1])
         pair_like = None
     else:
         from ..ops.xla_scorer import score_chunks_body as chunks_body

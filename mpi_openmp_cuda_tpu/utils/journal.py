@@ -12,6 +12,16 @@ result ``{"index": i, "score": S, "n": N, "k": K}``.  A journal whose
 fingerprint does not match the current problem is rejected (fail-stop, not
 silent corruption).  Appends are flushed + fsync'd per chunk so a kill at
 any point loses at most the in-flight chunk.
+
+Two variants share the on-disk shape:
+
+* :class:`ResultJournal` — whole-batch mode: the fingerprint covers every
+  sequence up front (the problem is fully materialised anyway).
+* :class:`StreamJournal` — ``--stream`` mode: the problem is never held in
+  memory at once, so the header fingerprints only (weights, Seq1, N) and
+  every record carries a short per-sequence content hash instead; on
+  resume an entry is trusted only if its hash matches the re-parsed
+  sequence (a changed input fails fast, same contract as batch mode).
 """
 
 from __future__ import annotations
@@ -23,6 +33,7 @@ import os
 import numpy as np
 
 _FORMAT = "mpi_openmp_cuda_tpu.journal.v1"
+_STREAM_FORMAT = "mpi_openmp_cuda_tpu.stream-journal.v1"
 
 # Sequences scored per journal append.  Small enough that a preemption
 # loses little work; large enough to amortise dispatch overhead.
@@ -31,6 +42,57 @@ DEFAULT_CHUNK = 64
 
 class JournalMismatchError(RuntimeError):
     """Journal on disk belongs to a different problem (or is corrupt)."""
+
+
+def _read_records(path, fmt, fingerprint, parse_rec, foreign_hint="", mismatch_hint=""):
+    """Shared journal reader: header validation + tolerant record parse.
+
+    ``parse_rec(rec) -> (key, value)``; malformed lines (a torn tail from a
+    mid-write kill) are skipped — those sequences simply get rescored.
+    """
+    if not os.path.exists(path):
+        return {}
+    done = {}
+    with open(path, "r", encoding="utf-8") as f:
+        header_line = f.readline()
+        if not header_line.strip():
+            return {}
+        try:
+            header = json.loads(header_line)
+        except json.JSONDecodeError as e:
+            raise JournalMismatchError(
+                f"journal {path!r}: unreadable header: {e}"
+            ) from e
+        if header.get("format") != fmt:
+            raise JournalMismatchError(
+                f"journal {path!r}: not a {fmt} file{foreign_hint}"
+            )
+        if header.get("fingerprint") != fingerprint:
+            raise JournalMismatchError(
+                f"journal {path!r} was written for a different problem"
+                f"{mismatch_hint}; delete it (or pass a fresh --journal "
+                "path) to rescore"
+            )
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+                key, value = parse_rec(rec)
+                done[key] = value
+            except (json.JSONDecodeError, KeyError, ValueError):
+                continue
+    return done
+
+
+def _write_records(f, recs) -> None:
+    """Append JSON records, then flush + fsync (a kill loses at most the
+    in-flight chunk)."""
+    for rec in recs:
+        f.write(json.dumps(rec) + "\n")
+    f.flush()
+    os.fsync(f.fileno())
 
 
 def problem_fingerprint(problem) -> str:
@@ -45,6 +107,111 @@ def problem_fingerprint(problem) -> str:
     return h.hexdigest()
 
 
+def stream_fingerprint(weights, seq1_codes, num_seq2: int) -> str:
+    """Header hash for streaming mode: (weights, Seq1, N) only — the batch
+    itself is validated per record via :func:`seq_hash`."""
+    h = hashlib.sha256()
+    h.update(json.dumps([int(w) for w in weights]).encode())
+    h.update(np.asarray(seq1_codes).tobytes())
+    h.update(np.int64(num_seq2).tobytes())
+    return h.hexdigest()
+
+
+def seq_hash(codes) -> str:
+    """Short per-sequence content hash (16 hex chars: collision odds over
+    even a billion-sequence batch are negligible, and a collision only
+    risks skipping a rescore, never wrong output for an unchanged input)."""
+    return hashlib.sha256(np.asarray(codes).tobytes()).hexdigest()[:16]
+
+
+class StreamJournal:
+    """Per-sequence journal for the --stream pipeline.
+
+    Usage: construct, :meth:`load` the validated done-map, then use as a
+    context manager and :meth:`append` each freshly scored chunk::
+
+        journal = StreamJournal(path, weights, seq1_codes, n)
+        done = journal.load()
+        with journal:
+            journal.append(indices, hashes, rows)
+    """
+
+    def __init__(self, path: str, weights, seq1_codes, num_seq2: int):
+        self.path = path
+        self.fingerprint = stream_fingerprint(weights, seq1_codes, num_seq2)
+        self._f = None
+        self._fresh = True
+
+    def load(self) -> dict[int, tuple[str, tuple[int, int, int]]]:
+        """index -> (seq_hash, (score, n, k)); rejects foreign journals."""
+        done = _read_records(
+            self.path,
+            _STREAM_FORMAT,
+            self.fingerprint,
+            lambda rec: (
+                int(rec["index"]),
+                (
+                    str(rec["h"]),
+                    (int(rec["score"]), int(rec["n"]), int(rec["k"])),
+                ),
+            ),
+            foreign_hint=" (a whole-batch journal cannot resume a --stream run)",
+            mismatch_hint=" (weights/Seq1/N changed)",
+        )
+        self._fresh = not done
+        return done
+
+    def __enter__(self):
+        fresh = self._fresh or not os.path.exists(self.path)
+        if not fresh:
+            _repair_torn_tail(self.path)
+        self._f = open(self.path, "w" if fresh else "a", encoding="utf-8")
+        if fresh:
+            self._f.write(
+                json.dumps(
+                    {"format": _STREAM_FORMAT, "fingerprint": self.fingerprint}
+                )
+                + "\n"
+            )
+            self._f.flush()
+            os.fsync(self._f.fileno())
+        return self
+
+    def __exit__(self, *exc):
+        closing, self._f = self._f, None
+        closing.close()
+        return False
+
+    def append(self, indices, hashes, rows) -> None:
+        _write_records(
+            self._f,
+            (
+                {
+                    "index": int(i),
+                    "h": h,
+                    "score": int(score),
+                    "n": int(n),
+                    "k": int(k),
+                }
+                for i, h, (score, n, k) in zip(indices, hashes, rows)
+            ),
+        )
+
+
+def _repair_torn_tail(path: str) -> None:
+    """Append a newline if a mid-write kill left a torn final line (gluing
+    the next record onto the fragment would lose it on the next resume)."""
+    with open(path, "rb") as rf:
+        rf.seek(0, os.SEEK_END)
+        if rf.tell() == 0:
+            return
+        rf.seek(-1, os.SEEK_END)
+        torn = rf.read(1) != b"\n"
+    if torn:
+        with open(path, "a", encoding="utf-8") as f:
+            f.write("\n")
+
+
 class ResultJournal:
     """Journalled scoring: skip already-scored sequences on restart."""
 
@@ -55,55 +222,24 @@ class ResultJournal:
     # -- on-disk state -----------------------------------------------------
     def _read(self, fingerprint: str) -> dict[int, tuple[int, int, int]]:
         """Load completed entries; reject foreign or malformed journals."""
-        if not os.path.exists(self.path):
-            return {}
-        done: dict[int, tuple[int, int, int]] = {}
-        with open(self.path, "r", encoding="utf-8") as f:
-            header_line = f.readline()
-            if not header_line.strip():
-                return {}
-            try:
-                header = json.loads(header_line)
-            except json.JSONDecodeError as e:
-                raise JournalMismatchError(
-                    f"journal {self.path!r}: unreadable header: {e}"
-                ) from e
-            if header.get("format") != _FORMAT:
-                raise JournalMismatchError(
-                    f"journal {self.path!r}: not a {_FORMAT} file"
-                )
-            if header.get("fingerprint") != fingerprint:
-                raise JournalMismatchError(
-                    f"journal {self.path!r} was written for a different problem; "
-                    "delete it (or pass a fresh --journal path) to rescore"
-                )
-            for line in f:
-                line = line.strip()
-                if not line:
-                    continue
-                try:
-                    rec = json.loads(line)
-                    done[int(rec["index"])] = (
-                        int(rec["score"]),
-                        int(rec["n"]),
-                        int(rec["k"]),
-                    )
-                except (json.JSONDecodeError, KeyError, ValueError):
-                    # A torn final line from a mid-write kill is expected;
-                    # that sequence simply gets rescored.
-                    continue
-        return done
+        return _read_records(
+            self.path,
+            _FORMAT,
+            fingerprint,
+            lambda rec: (
+                int(rec["index"]),
+                (int(rec["score"]), int(rec["n"]), int(rec["k"])),
+            ),
+        )
 
     def _append(self, f, indices, rows) -> None:
-        for i, (score, n, k) in zip(indices, rows):
-            f.write(
-                json.dumps(
-                    {"index": int(i), "score": int(score), "n": int(n), "k": int(k)}
-                )
-                + "\n"
-            )
-        f.flush()
-        os.fsync(f.fileno())
+        _write_records(
+            f,
+            (
+                {"index": int(i), "score": int(score), "n": int(n), "k": int(k)}
+                for i, (score, n, k) in zip(indices, rows)
+            ),
+        )
 
     # -- the resumable scoring loop ---------------------------------------
     def score_with_resume(self, scorer, problem) -> np.ndarray:
@@ -121,19 +257,8 @@ class ResultJournal:
         fresh = not os.path.exists(self.path) or not done
         mode = "w" if fresh else "a"
         if not fresh:
-            # A kill mid-write can leave a torn final line with no trailing
-            # newline; appending straight onto it would glue the next record
-            # to the fragment and lose it on the following resume.
-            with open(self.path, "rb") as rf:
-                rf.seek(0, os.SEEK_END)
-                if rf.tell() > 0:
-                    rf.seek(-1, os.SEEK_END)
-                    needs_newline = rf.read(1) != b"\n"
-                else:
-                    needs_newline = False
+            _repair_torn_tail(self.path)
         with open(self.path, mode, encoding="utf-8") as f:
-            if not fresh and needs_newline:
-                f.write("\n")
             if fresh:
                 f.write(
                     json.dumps(

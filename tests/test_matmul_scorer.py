@@ -70,10 +70,24 @@ def test_exactness_guard_falls_back_to_gather():
     huge = value_table([MAX_EXACT_WEIGHT + 1, 2, 3, 4]).reshape(-1)
     assert mm_formulation_exact(small)
     assert not mm_formulation_exact(huge)
-    from mpi_openmp_cuda_tpu.ops.matmul_scorer import score_chunks_mm
+    import jax
+
+    from mpi_openmp_cuda_tpu.ops.matmul_scorer import (
+        MAX_NATIVE_PRECISION_WEIGHT,
+        score_chunks_mm,
+    )
     from mpi_openmp_cuda_tpu.ops.xla_scorer import score_chunks
 
-    assert resolve_xla_formulation("xla", small) is score_chunks_mm
+    fn = resolve_xla_formulation("xla", small)
+    assert fn.func is score_chunks_mm
+    # Small weights: default MXU precision is already exact -> fastest.
+    assert fn.keywords == {"mm_precision": None}
+    wide = value_table([MAX_NATIVE_PRECISION_WEIGHT + 1, 2, 3, 4]).reshape(-1)
+    fn = resolve_xla_formulation("xla", wide)
+    assert fn.func is score_chunks_mm
+    # Above the single-pass bf16 bound: multi-pass HIGHEST keeps exactness
+    # on real TPU MXUs (default f32 multiplies round values above 2^8).
+    assert fn.keywords == {"mm_precision": jax.lax.Precision.HIGHEST}
     assert resolve_xla_formulation("xla", huge) is score_chunks
     assert resolve_xla_formulation("xla-gather", small) is score_chunks
 

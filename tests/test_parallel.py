@@ -99,7 +99,7 @@ def test_sharded_output_is_batch_sharded():
     rows, lens = np.zeros((16, batch.l2p), np.int32), np.zeros(16, np.int32)
     rows[:16] = batch.seq2
     lens[:16] = batch.len2
-    out = _sharded_fn(mesh, 2, ("mm",))(
+    out = _sharded_fn(mesh, 2, ("mm", None))(
         _put_global(np.asarray(batch.seq1ext, np.int32), replicated(mesh)),
         jnp.int32(batch.len1),
         _put_global(rows, batch_sharded(mesh)),

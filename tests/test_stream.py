@@ -49,12 +49,72 @@ def test_stream_with_mesh_and_json(tmp_path):
         assert row["score"] == int(text[2].rstrip(","))
 
 
-def test_stream_rejects_journal_and_selfcheck(tmp_path):
+def test_stream_rejects_selfcheck(tmp_path):
     path = reference_fixture("input5.txt")
-    for flag in (("--journal", str(tmp_path / "j.jsonl")), ("--selfcheck",)):
-        proc = run_cli("--stream", "2", *flag, stdin_path=path, check=False)
-        assert proc.returncode != 0
-        assert "cannot be combined with --stream" in proc.stderr
+    proc = run_cli("--stream", "2", "--selfcheck", stdin_path=path, check=False)
+    assert proc.returncode != 0
+    assert "cannot be combined with --stream" in proc.stderr
+
+
+def test_stream_journal_resume(tmp_path):
+    path = reference_fixture("input1.txt")
+    j = str(tmp_path / "j.jsonl")
+    proc = run_cli("--stream", "3", "--journal", j, stdin_path=path)
+    assert proc.stdout == golden("input1.out")
+    full = open(j).read().splitlines()
+    assert len(full) == 1 + 10  # header + one record per sequence
+
+    # Rerun: everything resumes from the journal, no new records.
+    proc = run_cli("--stream", "3", "--journal", j, stdin_path=path)
+    assert proc.stdout == golden("input1.out")
+    assert len(open(j).read().splitlines()) == 1 + 10
+
+    # Truncate to header + 4 records: the rerun rescores only the rest,
+    # with byte-identical output.
+    with open(j, "w") as f:
+        f.write("\n".join(full[:5]) + "\n")
+    proc = run_cli("--stream", "3", "--journal", j, stdin_path=path)
+    assert proc.stdout == golden("input1.out")
+    assert len(open(j).read().splitlines()) == 1 + 10
+
+
+def test_stream_journal_rejects_changed_input(tmp_path):
+    src = reference_fixture("input6.txt")
+    j = str(tmp_path / "j.jsonl")
+    proc = run_cli("--stream", "2", "--journal", j, stdin_path=src)
+    assert proc.stdout == golden("input6.out")
+
+    # Same header shape (weights/Seq1/N) but a mutated sequence: the
+    # per-record hash must catch it.
+    text = open(src).read().split()
+    text[7] = text[7][:-1] + ("A" if text[7][-1] != "A" else "B")
+    mutated = tmp_path / "mutated.txt"
+    mutated.write_text(" ".join(text) + "\n")
+    proc = run_cli(
+        "--stream", "2", "--journal", j, "--input", str(mutated), check=False
+    )
+    assert proc.returncode != 0
+    assert "does not match the input" in proc.stderr
+    # Different Seq1 entirely: header fingerprint mismatch.
+    text[4] = text[4][::-1] + "Q"
+    mutated.write_text(" ".join(text) + "\n")
+    proc = run_cli(
+        "--stream", "2", "--journal", j, "--input", str(mutated), check=False
+    )
+    assert proc.returncode != 0
+    assert "different problem" in proc.stderr
+
+
+def test_stream_journal_and_batch_journal_are_mutually_foreign(tmp_path):
+    path = reference_fixture("input6.txt")
+    jb = str(tmp_path / "batch.jsonl")
+    js = str(tmp_path / "stream.jsonl")
+    run_cli("--journal", jb, stdin_path=path)
+    run_cli("--stream", "2", "--journal", js, stdin_path=path)
+    proc = run_cli("--stream", "2", "--journal", jb, stdin_path=path, check=False)
+    assert proc.returncode != 0 and "stream-journal" in proc.stderr
+    proc = run_cli("--journal", js, stdin_path=path, check=False)
+    assert proc.returncode != 0
 
 
 def test_stream_header_then_chunks_matches_parse_problem():
