@@ -161,6 +161,41 @@ def test_stream_tiny_buffer_token_reassembly():
     assert toks == text.split()
 
 
+def test_stream_retries_transient_dispatch_failure(monkeypatch, capsys):
+    # One injected transient failure at chunk dispatch: --retries 1 must
+    # recover with byte-identical output; without retries it must fail
+    # with nothing on stdout.
+    from mpi_openmp_cuda_tpu.io import cli
+
+    path = reference_fixture("input6.txt")
+    real = cli.AlignmentScorer
+
+    def flaky(fail_on_call):
+        calls = {"n": 0}
+
+        class Flaky(real):
+            def score_codes_async(self, *a, **k):
+                calls["n"] += 1
+                if calls["n"] == fail_on_call:
+                    raise RuntimeError("injected transient device failure")
+                return super().score_codes_async(*a, **k)
+
+        return Flaky
+
+    monkeypatch.setattr(cli, "AlignmentScorer", flaky(2))
+    rc = cli.run(["--stream", "2", "--retries", "1", "--input", path])
+    cap = capsys.readouterr()
+    assert rc == 0
+    assert cap.out == golden("input6.out")
+    assert "retrying" in cap.err
+
+    monkeypatch.setattr(cli, "AlignmentScorer", flaky(2))
+    rc = cli.run(["--stream", "2", "--input", path])
+    cap = capsys.readouterr()
+    assert rc == 1
+    assert cap.out == ""  # fail-stop: no partial results
+
+
 def test_auto_backend_resolves_off_tpu():
     # On the CPU test mesh 'auto' must pick the XLA formulation (pallas
     # would run interpret mode); on a real TPU it resolves to 'pallas'
