@@ -70,10 +70,11 @@ def test_stream_journal_resume(tmp_path):
     assert len(open(j).read().splitlines()) == 1 + 10
 
     # Truncate to header + 4 records: the rerun rescores only the rest,
-    # with byte-identical output.
+    # with byte-identical output — under a DIFFERENT chunk size (records
+    # are per-sequence with global indices, chunk-size independent).
     with open(j, "w") as f:
         f.write("\n".join(full[:5]) + "\n")
-    proc = run_cli("--stream", "3", "--journal", j, stdin_path=path)
+    proc = run_cli("--stream", "4", "--journal", j, stdin_path=path)
     assert proc.stdout == golden("input1.out")
     assert len(open(j).read().splitlines()) == 1 + 10
 
