@@ -115,10 +115,13 @@ def _superblock(nbn: int) -> int:
     the one-hot matmul's MACs (band width (SB+1)*128 instead of SB*2*128)
     and amortises per-iteration overhead; the strided rotate's shift stays
     the row index <= 127, within Mosaic's per-vreg cap, at any width.
-    Bounded at 8 — wider still trades away the dead-offset skip's
-    granularity faster than it saves MACs (the band-sharing saving is
-    (SB+1)/SB, already within 12% of its limit at SB=8)."""
-    for cand in (8, 6, 4, 2):
+    Bounded at 12: measured on the real chip, widening 6->12 (input3) and
+    8->12 (max-size synthetic) won 5%/15% — the band sharing and loop
+    amortisation beat the coarser dead-offset skip on realistic length
+    mixes — but a batch dominated by near-Seq1-length sequences pays for
+    every extra always-run block in super-block 0, so unbounded widths
+    trade the skip away entirely."""
+    for cand in (12, 8, 6, 4, 2):
         if nbn % cand == 0:
             return cand
     return 1

@@ -64,8 +64,8 @@ def _problems(rng):
     out.append(
         (seq1c, [rng.integers(1, 27, size=n).astype(np.int8) for n in (40, 200, 330, 449)])
     )
-    # Bucket D: len1 ~ 1000 -> l1p = 1024 (nbn=8: the widest sb=8
-    # super-block); short candidates keep the interpret-mode cost low.
+    # Bucket D: len1 ~ 1000 -> l1p = 1024 (nbn=8: the sb=8 super-block);
+    # short candidates keep the interpret-mode cost low.
     seq1d = rng.integers(1, 27, size=1000).astype(np.int8)
     out.append(
         (seq1d, [rng.integers(1, 27, size=n).astype(np.int8) for n in (25, 100, 400)])

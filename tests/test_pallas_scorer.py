@@ -146,6 +146,22 @@ def test_pallas_superblock_six():
     assert [tuple(int(x) for x in row) for row in got] == want
 
 
+def test_pallas_superblock_twelve():
+    # len1 ~ 1500 -> l1p = 1536, nbn = 12: the widest sb=12 super-block
+    # (a 1664-lane band, 13 vregs).  Candidate lengths straddle the
+    # dead-offset boundary (n >= len1 - len2) inside super-block 0, which
+    # sb=12 can no longer skip — exactness must come from the epilogue
+    # mask alone.
+    rng = np.random.default_rng(29)
+    seq1 = rng.integers(1, 27, size=1500).astype(np.int8)
+    seqs = [
+        rng.integers(1, 27, size=n).astype(np.int8) for n in (40, 700, 1499)
+    ]
+    got = _score(seq1, seqs, W)
+    want = [prefix_best(seq1, s, W) for s in seqs]
+    assert [tuple(int(x) for x in row) for row in got] == want
+
+
 def test_pallas_bucket_l2p_exceeds_l1p():
     # A long unsearchable candidate (len2 > len1) forces a bucket with
     # L2P (1152) much larger than L1P (256): nbn=2 offset blocks, nbi=9
