@@ -25,7 +25,36 @@ from .utils import constants
 
 __version__ = "0.1.0"
 
+# The accelerated front door and the sharding strategies import jax (and
+# initialise a backend); expose them lazily so that the pure-host surface
+# above stays importable without touching a device.
+_LAZY = {
+    "AlignmentScorer": ("mpi_openmp_cuda_tpu.ops.dispatch", "AlignmentScorer"),
+    "BatchSharding": ("mpi_openmp_cuda_tpu.parallel.sharding", "BatchSharding"),
+    "RingSharding": ("mpi_openmp_cuda_tpu.parallel.ring", "RingSharding"),
+}
+
+
+def __getattr__(name):
+    try:
+        module, attr = _LAZY[name]
+    except KeyError:
+        raise AttributeError(
+            f"module {__name__!r} has no attribute {name!r}"
+        ) from None
+    import importlib
+
+    return getattr(importlib.import_module(module), attr)
+
+
+def __dir__():
+    return sorted(set(globals()) | set(_LAZY))
+
+
 __all__ = [
+    "AlignmentScorer",
+    "BatchSharding",
+    "RingSharding",
     "build_class_matrix",
     "classify_pair",
     "encode",

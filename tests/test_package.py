@@ -1,0 +1,22 @@
+"""Public package surface: the lazily-exported front door and the README
+library example (which once shipped a wrong expected value)."""
+
+import mpi_openmp_cuda_tpu as pkg
+import pytest
+
+
+def test_readme_library_example():
+    scorer = pkg.AlignmentScorer(
+        "auto", sharding=pkg.BatchSharding.over_devices(8)
+    )
+    rows = scorer.score("HELLOWORLD", ["OWRL"], [10, 2, 3, 4])
+    # Spec PDF p.5 worked pair: OW-RL at offset 4 scores 4 identities.
+    assert [tuple(int(x) for x in rows[0])] == [(40, 4, 2)]
+
+
+def test_lazy_exports_resolve():
+    assert pkg.RingSharding.over_devices(seq=2) is not None
+    with pytest.raises(AttributeError):
+        pkg.not_an_export
+    # PEP 562 companion __dir__: lazy names visible to introspection.
+    assert {"AlignmentScorer", "BatchSharding", "RingSharding"} <= set(dir(pkg))
