@@ -13,10 +13,10 @@ delta-formulation pipeline so V never leaves VMEM:
                                                lane-REVERSED)
     shear row r left by r         ONE tpu.dynamic_rotate with stride=1 over
                                                the row axis.  Mosaic's
-                                               strided rotate caps the
-                                               per-vreg shift at the 128
-                                               lane width and only rotates
-                                               one direction, so the kernel
+                                               strided rotate only turns one
+                                               direction (and shifts by the
+                                               full row index — measured, no
+                                               mod-128 wrap), so the kernel
                                                runs in reversed lane
                                                orientation end to end (A
                                                pre-reversed host-side; the
@@ -113,8 +113,7 @@ def _superblock(nbn: int) -> int:
     """Offset blocks processed per inner iteration.  Adjacent offset blocks
     share all but 128 of their A-band columns, so a wider super-block cuts
     the one-hot matmul's MACs (band width (SB+1)*128 instead of SB*2*128)
-    and amortises per-iteration overhead; the strided rotate's shift stays
-    the row index <= 127, within Mosaic's per-vreg cap, at any width.
+    and amortises per-iteration overhead.
     Bounded at 12: measured on the real chip, widening 6->12 (input3) and
     8->12 (max-size synthetic) won 5%/15% — the band sharing and loop
     amortisation beat the coarser dead-offset skip on realistic length
