@@ -223,6 +223,11 @@ def mxu_probe_tflops() -> float:
 
 
 def main() -> None:
+    # Respect an explicit JAX_PLATFORMS choice (TPU site hooks clobber it):
+    # a CPU-forced bench (the pytest contract test) must actually run CPU.
+    from mpi_openmp_cuda_tpu.utils.platform import apply_platform_override
+
+    apply_platform_override()
     import jax
 
     from mpi_openmp_cuda_tpu.ops.dispatch import AlignmentScorer
@@ -266,21 +271,64 @@ def main() -> None:
         problem.seq1_codes.size, [c.size for c in problem.seq2_codes]
     )
     value = elements / wall / n_chips
-    print(
-        json.dumps(
-            {
-                "metric": f"equivalent brute-force char comparisons/s/chip, {workload}",
-                "value": round(value, 1),
-                "unit": "elements/s/chip",
-                "vs_baseline": round(value / REF_BASELINE_ELEMS_PER_SEC, 2),
-            }
+    # The JSON record is printed AFTER the probe below so the MFU line can
+    # join it; stdout stays exactly one line either way.
+    record = {
+        "metric": f"equivalent brute-force char comparisons/s/chip, {workload}",
+        "value": round(value, 1),
+        "unit": "elements/s/chip",
+        "vs_baseline": round(value / REF_BASELINE_ELEMS_PER_SEC, 2),
+    }
+
+    # True-MFU accounting (VERDICT r1): FLOPs the kernel actually issues
+    # (live tiles only), not eq-comparisons — makes efficiency headroom
+    # visible instead of hiding it behind the reference's cost model.
+    real_tflops = None
+    # Sub-50µs steady walls are dispatch-floor / clamp territory (see
+    # STEADY_CLAMP_FLOOR): an MFU computed there measures the link, not
+    # the kernel, and reads as nonsense (>>1).
+    if backend == "pallas" and wall > 50e-6:
+        from mpi_openmp_cuda_tpu.ops.dispatch import (
+            choose_pallas_formulation,
+            pad_problem,
         )
-    )
+        from mpi_openmp_cuda_tpu.ops.pallas_scorer import kernel_mxu_flops
+        from mpi_openmp_cuda_tpu.ops.values import value_table
+
+        padded = pad_problem(problem.seq1_codes, problem.seq2_codes)
+        val_flat = value_table(problem.weights).reshape(-1)
+        # Same routing the dispatch layer applies: wide weights or
+        # unaligned buckets fall back to non-kernel bodies, where this
+        # FLOP model would describe work that never ran.
+        fm = choose_pallas_formulation(val_flat, (padded.l1p, padded.l2p))
+        if fm[0] == "pallas":
+            flops = kernel_mxu_flops(
+                padded.len1,
+                [c.size for c in problem.seq2_codes],
+                padded.l1p,
+                padded.l2p,
+                fm[1],
+            )
+            real_tflops = flops / wall / 1e12
+            record["real_tflops"] = round(real_tflops, 1)
+
     probe = ""
     if jax.devices()[0].platform == "tpu":
-        tflops = mxu_probe_tflops()
-        probe = f" mxu_probe={tflops:.0f}TFLOP/s"
-        if tflops < 50:
+        # The measurement above is complete; a probe failure (preempted /
+        # co-tenant-OOMed shared chip) must not discard the contract line.
+        try:
+            tflops = mxu_probe_tflops()
+        except Exception as e:
+            tflops = None
+            print(f"[bench] WARNING: MXU probe failed ({e})", file=sys.stderr)
+        if tflops is not None:
+            probe = f" mxu_probe={tflops:.0f}TFLOP/s"
+            if real_tflops is not None and 50 <= tflops <= 600:
+                record["mfu_vs_probe"] = round(real_tflops / tflops, 3)
+                probe += f" real={real_tflops:.0f}TFLOP/s mfu={real_tflops / tflops:.2f}"
+        if tflops is None:
+            pass
+        elif tflops < 50:
             print(
                 f"[bench] WARNING: MXU probe at {tflops:.0f} TFLOP/s — far "
                 "below any TPU's roofline: sustained external load on the "
@@ -298,6 +346,7 @@ def main() -> None:
                 "swamped the probe increment); ignore the probe value",
                 file=sys.stderr,
             )
+    print(json.dumps(record))
     print(
         f"[bench] backend={backend} device={jax.devices()[0].device_kind} "
         f"workload={workload} elements={elements} steady_wall={wall:.4f}s "

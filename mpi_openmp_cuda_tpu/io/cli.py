@@ -120,23 +120,30 @@ class FeatureUnavailableError(RuntimeError):
     pass
 
 
-def _retrying(fn, retries: int, describe: str):
+def _retrying(fn, retries: int, describe: str, used: list[int] | None = None):
     """Run ``fn()`` with driver-level retries on transient failure.
 
     The single source of the transient-vs-programming classification:
     (ValueError, TypeError) are shape/programming errors and always
     propagate; anything else is retried up to ``retries`` times.
+
+    ``used`` (a mutable one-element list) shares one attempt budget across
+    several _retrying calls: streaming mode passes the same counter to a
+    chunk's dispatch and materialise stages so the chunk gets N retries
+    total, matching the batch path's N+1-attempt contract.
     """
-    for attempt in range(retries + 1):
+    used = [0] if used is None else used
+    while True:
         try:
             return fn()
         except (ValueError, TypeError):
             raise
         except Exception as e:
-            if attempt >= retries:
+            used[0] += 1
+            if used[0] > retries:
                 raise
             print(
-                f"mpi_openmp_cuda_tpu: {describe} attempt {attempt + 1} "
+                f"mpi_openmp_cuda_tpu: {describe} attempt {used[0]} "
                 f"failed ({e}); retrying",
                 file=sys.stderr,
             )
@@ -265,9 +272,12 @@ def _run_streaming(args, timer: PhaseTimer) -> int:
 
         def _submit(start, codes):
             """Dispatch a chunk; returns (promise, start, codes, pend, rows,
-            hashes).  pend is None without a journal (whole chunk scored);
-            with one, only hash-missing sequences are dispatched and rows
-            pre-holds the journalled results."""
+            hashes, budget).  pend is None without a journal (whole chunk
+            scored); with one, only hash-missing sequences are dispatched
+            and rows pre-holds the journalled results.  budget is the
+            chunk's shared retry counter: dispatch and materialise together
+            get args.retries retries, like the batch path."""
+            budget = [0]
             if journal is None:
                 promise = _retrying(
                     lambda: scorer.score_codes_async(
@@ -275,8 +285,9 @@ def _run_streaming(args, timer: PhaseTimer) -> int:
                     ),
                     args.retries,
                     "chunk dispatch",
+                    used=budget,
                 )
-                return (promise, start, codes, None, None, None)
+                return (promise, start, codes, None, None, None, budget)
             hashes = [seq_hash(c) for c in codes]
             pend = []
             rows = np.zeros((len(codes), 3), dtype=np.int32)
@@ -302,10 +313,11 @@ def _run_streaming(args, timer: PhaseTimer) -> int:
                     ),
                     args.retries,
                     "chunk dispatch",
+                    used=budget,
                 )
-            return (promise, start, codes, pend, rows, hashes)
+            return (promise, start, codes, pend, rows, hashes, budget)
 
-        def _finish(promise, start, codes, pend, rows, hashes):
+        def _finish(promise, start, codes, pend, rows, hashes, budget):
             res = None
             if promise is not None:
                 first = [promise]
@@ -320,7 +332,7 @@ def _run_streaming(args, timer: PhaseTimer) -> int:
                         header.seq1_codes, sub, header.weights
                     )
 
-                res = _retrying(attempt, args.retries, "chunk scoring")
+                res = _retrying(attempt, args.retries, "chunk scoring", used=budget)
             if pend is None:
                 out = res
             else:

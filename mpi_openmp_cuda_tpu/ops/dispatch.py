@@ -116,18 +116,30 @@ def resolve_auto_backend() -> str:
         import jax
 
         on_tpu = jax.default_backend() == "tpu"
+        multi_host = jax.process_count() > 1
     except Exception:
         on_tpu = False
+        multi_host = False
     if on_tpu:
         try:
             from . import pallas_scorer  # noqa: F401
 
             return "pallas"
         except Exception as e:
+            if multi_host:
+                # In a multi-host job the backend choice IS the SPMD
+                # program: a host silently downgrading to 'xla' while its
+                # peers resolve 'pallas' would desynchronise collectives
+                # (a hang, not an error).  Fail fast instead; the operator
+                # picks one explicit --backend for every host.
+                raise RuntimeError(
+                    "backend 'auto' cannot resolve 'pallas' on this host "
+                    f"(import failed: {e}) while the job is multi-host; "
+                    "pass the same explicit --backend on every host"
+                ) from e
             # Never silent: a broken pallas build on TPU downgrades the
-            # default path 26x, and in a multi-host job a host resolving
-            # differently from its peers would desynchronise collectives —
-            # the operator must see why this host chose 'xla'.
+            # default path 26x — the operator must see why this host
+            # chose 'xla'.
             import sys
 
             print(

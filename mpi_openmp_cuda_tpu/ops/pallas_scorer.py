@@ -126,6 +126,33 @@ def _superblock(nbn: int) -> int:
     return 1
 
 
+def kernel_mxu_flops(len1: int, lens2, l1p: int, l2p: int, feed: str) -> int:
+    """Real MXU FLOPs (2 x MACs) the fused kernel issues for one batch —
+    the live-tile accounting for bench.py's true-MFU line (VERDICT r1 §1).
+
+    Mirrors `_kernel`'s control flow exactly: per pair, super-block 0
+    always runs, later super-blocks only while n0 < len1 - len2, and each
+    executed super-block runs ``nbi_live`` char-block iterations of one
+    one-hot matmul ([128, 128] @ [128, sbw + 128]) plus the prefix
+    matmuls (two on the narrow feeds, one fused on f32).  Update in
+    lockstep with any kernel reformulation, or the MFU line silently lies.
+    """
+    nbn, nbi = l1p // _BLK, l2p // _BLK
+    sb = _superblock(nbn)
+    sbw = sb * _BLK
+    prefix_matmuls = 1 if feed == "f32" else 2
+    per_iter = _BLK * _BLK * (sbw + _BLK) + prefix_matmuls * _BLK * _BLK * sbw
+    total = 0
+    for l2 in lens2:
+        l2 = int(l2)
+        nbi_live = min(-(-max(l2, 1) // _BLK), nbi)
+        nsb = sum(
+            1 for nb in range(0, nbn, sb) if nb == 0 or nb * _BLK < len1 - l2
+        )
+        total += nsb * nbi_live * per_iter
+    return 2 * total
+
+
 def _kernel(meta_ref, codes_ref, a_ref, score_ref, k_ref, k0_ref, *, nbn, nbi, feed):
     """One grid cell scores one pair across all offset super-blocks."""
     len1 = meta_ref[0]  # scalar-prefetch SMEM array: [len1, lens...]

@@ -141,6 +141,7 @@ class StreamJournal:
         self.fingerprint = stream_fingerprint(weights, seq1_codes, num_seq2)
         self._f = None
         self._fresh = True
+        self._loaded = False
 
     def load(self) -> dict[int, tuple[str, tuple[int, int, int]]]:
         """index -> (seq_hash, (score, n, k)); rejects foreign journals."""
@@ -159,9 +160,16 @@ class StreamJournal:
             mismatch_hint=" (weights/Seq1/N changed)",
         )
         self._fresh = not done
+        self._loaded = True
         return done
 
     def __enter__(self):
+        if not self._loaded:
+            # A caller that skips load() must not bypass header validation
+            # and silently truncate a resumable journal ('w' below): run
+            # the load here (the done-map is discarded, but _fresh and the
+            # fingerprint check now reflect the on-disk state).
+            self.load()
         fresh = self._fresh or not os.path.exists(self.path)
         if not fresh:
             _repair_torn_tail(self.path)

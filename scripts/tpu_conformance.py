@@ -42,6 +42,28 @@ WEIGHT_REGIMES = [
 BACKENDS = ["pallas", "xla", "xla-gather"]
 
 
+def _sharded_scorers():
+    """Sharded paths on the real chip (1-device meshes: the tunnel exposes
+    one TPU).  These route through _sharded_fn / _ring_fn and
+    pallas_pair_scorer — the plumbing a CPU interpret-mode run cannot
+    validate against real Mosaic lowering (ADVICE r1: the sharded non-i8
+    feed plumbing had no on-device coverage)."""
+    import jax
+
+    from mpi_openmp_cuda_tpu.parallel.ring import RingSharding
+    from mpi_openmp_cuda_tpu.parallel.sharding import BatchSharding
+
+    n = len(jax.devices())
+    return {
+        f"pallas-dp{n}": AlignmentScorer(
+            "pallas", sharding=BatchSharding.over_devices(n)
+        ),
+        f"pallas-ring{n}": AlignmentScorer(
+            "pallas", sharding=RingSharding.over_devices(seq=n)
+        ),
+    }
+
+
 def problems():
     rng = np.random.default_rng(11)
     seq1 = rng.integers(1, 27, size=700).astype(np.int8)
@@ -80,8 +102,9 @@ def main() -> int:
         )
         return 1
     failures = 0
-    for backend in BACKENDS:
-        scorer = AlignmentScorer(backend)
+    scorers = {b: AlignmentScorer(b) for b in BACKENDS}
+    scorers.update(_sharded_scorers())
+    for backend, scorer in scorers.items():
         for weights in WEIGHT_REGIMES:
             for pi, (seq1, seqs) in enumerate(problems()):
                 got = [

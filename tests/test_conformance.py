@@ -101,8 +101,11 @@ def test_all_paths_agree_with_oracle(weights, rng):
     # production programs) and for the gather fallback (no kernel at all);
     # the wider-weight regimes keep every XLA path but exercise the pallas
     # kernel end-to-end only on the local path over buckets A and C (the
-    # corner-case bucket and the sb=4 super-block bucket).  Feed *routing*
-    # at the 127/128/129 boundaries is unit-tested in test_pallas_scorer.
+    # corner-case bucket and the sb=4 super-block bucket), plus ONE sharded
+    # kernel case per non-i8 feed (dp8-pallas on bucket A) so the sharded
+    # feed plumbing (_sharded_fn's pallas mode + pallas_pair_scorer) never
+    # loses end-to-end coverage.  Feed *routing* at the 127/128/129
+    # boundaries is unit-tested in test_pallas_scorer.
     val_flat = value_table(weights).reshape(-1)
     full_pallas = mxu_feed(val_flat) == "i8" or not mm_formulation_exact(val_flat)
     for bucket, (seq1, seqs) in enumerate(_problems(rng)):
@@ -112,6 +115,7 @@ def test_all_paths_agree_with_oracle(weights, rng):
                 "pallas" in name
                 and not full_pallas
                 and not (name == "pallas" and bucket in (0, 2))
+                and not (name == "dp8-pallas" and bucket == 0)
             ):
                 continue
             got = scorer.score_codes(seq1, seqs, weights)
