@@ -323,29 +323,30 @@ def main() -> None:
             print(f"[bench] WARNING: MXU probe failed ({e})", file=sys.stderr)
         if tflops is not None:
             probe = f" mxu_probe={tflops:.0f}TFLOP/s"
-            if real_tflops is not None and 50 <= tflops <= 600:
+            if tflops < 50:
+                print(
+                    f"[bench] WARNING: MXU probe at {tflops:.0f} TFLOP/s — "
+                    "far below any TPU's roofline: sustained external load "
+                    "on the chip; this invocation's number is not a "
+                    "framework measurement, re-run",
+                    file=sys.stderr,
+                )
+            elif tflops > 600:
+                # Above any current TPU's bf16 roofline: the probe's own
+                # slope was swamped by link jitter (or clamped) — the
+                # calibration is invalid, not the device fast.
+                print(
+                    f"[bench] WARNING: MXU probe at {tflops:.0f} TFLOP/s is "
+                    "implausibly high — calibration invalid (link jitter "
+                    "swamped the probe increment); ignore the probe value",
+                    file=sys.stderr,
+                )
+            elif real_tflops is not None:
                 record["mfu_vs_probe"] = round(real_tflops / tflops, 3)
-                probe += f" real={real_tflops:.0f}TFLOP/s mfu={real_tflops / tflops:.2f}"
-        if tflops is None:
-            pass
-        elif tflops < 50:
-            print(
-                f"[bench] WARNING: MXU probe at {tflops:.0f} TFLOP/s — far "
-                "below any TPU's roofline: sustained external load on the "
-                "chip; this invocation's number is not a framework "
-                "measurement, re-run",
-                file=sys.stderr,
-            )
-        elif tflops > 600:
-            # Above any current TPU's bf16 roofline: the probe's own slope
-            # was swamped by link jitter (or clamped) — the calibration is
-            # invalid, not the device fast.
-            print(
-                f"[bench] WARNING: MXU probe at {tflops:.0f} TFLOP/s is "
-                "implausibly high — calibration invalid (link jitter "
-                "swamped the probe increment); ignore the probe value",
-                file=sys.stderr,
-            )
+                probe += (
+                    f" real={real_tflops:.0f}TFLOP/s"
+                    f" mfu={real_tflops / tflops:.2f}"
+                )
     print(json.dumps(record))
     print(
         f"[bench] backend={backend} device={jax.devices()[0].device_kind} "

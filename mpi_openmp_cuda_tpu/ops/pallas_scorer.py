@@ -34,8 +34,11 @@ delta-formulation pipeline so V never leaves VMEM:
                                   G[len2] capture, t1 totals — all lane
                                   vectors in registers
 
-  outputs per pair: per-offset best score, best k, and the k=0 score
-  (t1 + G[len2]); the tiny [B, NOFF] argmax/masking epilogue runs in XLA.
+  outputs per pair: ONE best candidate [score, n, k, eq] — the offset
+  masking and argmax run in-kernel on cheap [1, sbw] lane vectors
+  (round 1 wrote three [B, W] reversed surfaces instead; the XLA
+  un-reverse + argmax epilogue cost ~33 us/call on input3, ~17%); only
+  the O(B)-scalar equal-length / unsearchable selection stays in XLA.
 
 Tie-break parity with the reference's offset-major, k-ascending-with-0-first
 order (cudaFunctions.cu:161) is preserved: strictly-greater running updates
@@ -127,47 +130,73 @@ def _superblock(nbn: int) -> int:
 
 
 def kernel_mxu_flops(len1: int, lens2, l1p: int, l2p: int, feed: str) -> int:
-    """Real MXU FLOPs (2 x MACs) the fused kernel issues for one batch —
-    the live-tile accounting for bench.py's true-MFU line (VERDICT r1 §1).
+    """MXU FLOPs (2 x MACs) the fused kernel ISSUES for one batch — the
+    accounting for bench.py's true-MFU line (VERDICT r1 §1).
 
     Mirrors `_kernel`'s control flow exactly: per pair, super-block 0
     always runs, later super-blocks only while n0 < len1 - len2, and each
-    executed super-block runs ``nbi_live`` char-block iterations of one
-    one-hot matmul ([128, 128] @ [128, sbw + 128]) plus the prefix
-    matmuls (two on the narrow feeds, one fused on f32).  Update in
-    lockstep with any kernel reformulation, or the MFU line silently lies.
+    executed super-block runs ``nbi_live`` char-block tiles — rounded up
+    to the `wide`-tile interleave granularity, because the zeroed overhang
+    tiles are real issued matmuls — of one one-hot matmul
+    ([128, 128] @ [128, sbw + 128]) plus the prefix matmuls (two on the
+    narrow feeds, one fused on f32).  Update in lockstep with any kernel
+    reformulation, or the MFU line silently lies.
     """
     nbn, nbi = l1p // _BLK, l2p // _BLK
     sb = _superblock(nbn)
     sbw = sb * _BLK
     prefix_matmuls = 1 if feed == "f32" else 2
+    wide = 1 if feed == "f32" else 2
     per_iter = _BLK * _BLK * (sbw + _BLK) + prefix_matmuls * _BLK * _BLK * sbw
     total = 0
     for l2 in lens2:
         l2 = int(l2)
-        nbi_live = min(-(-max(l2, 1) // _BLK), nbi)
+        nbi_live = min(-(-l2 // _BLK), nbi)  # 0 tiles for an empty pair
+        tiles = wide * (-(-nbi_live // wide))
         nsb = sum(
             1 for nb in range(0, nbn, sb) if nb == 0 or nb * _BLK < len1 - l2
         )
-        total += nsb * nbi_live * per_iter
+        total += nsb * tiles * per_iter
     return 2 * total
 
 
-def _kernel(meta_ref, codes_ref, a_ref, score_ref, k_ref, k0_ref, *, nbn, nbi, feed):
-    """One grid cell scores one pair across all offset super-blocks."""
+def _kernel(meta_ref, codes_ref, a_ref, out_ref, *, nbn, nbi, feed, pretiled):
+    """One grid cell scores one pair across all offset super-blocks and
+    reduces it to one best candidate: out lanes [score, n, k, eq] (f32;
+    eq = the positional k=0 score at offset 0, for the equal-length path
+    and the ring combine)."""
     len1 = meta_ref[0]  # scalar-prefetch SMEM array: [len1, lens...]
     l2 = meta_ref[1 + pl.program_id(0)]
     # First (one-hot) matmul operand type; a_ref arrives pre-cast.
     oh_t = _FEED_DTYPES[feed]
-    # Delta matmul runs bf16 whenever exact (|dd| <= 256, integers): both
-    # the i8 and bf16 feeds qualify.
-    dd_t = jnp.float32 if feed == "f32" else jnp.bfloat16
+    # Prefix-matmul operand type: int8 on the i8 feed (|v| <= 127 slices of
+    # an int32 V, ltri is 0/1 — int8 x int8 with int32 accumulation is
+    # exact and runs at twice the bf16 MXU rate), bf16 on the bf16 feed
+    # (integers |v| <= 128 are bf16-exact), f32 otherwise.
+    dd_t = {"i8": jnp.int8, "bf16": jnp.bfloat16, "f32": jnp.float32}[feed]
+    # Scoring pipeline dtype: the i8 feed stays integer end to end (prefix
+    # sums, carries and the running max are int32 — exact by construction);
+    # the wider feeds keep the float32 pipeline.
+    sc_t = jnp.int32 if feed == "i8" else jnp.float32
+    neg = -(1 << 30) if feed == "i8" else _NEG
+    # Packed running argmax (i8 feed): one int32 carries (score, kappa) as
+    # g * 4096 + (4095 - kappa), so the per-tile argmax is a single max
+    # reduction instead of max + broadcast-compare + masked min-index
+    # (ablation: the reduction stack is ~17% of kernel wall).  Larger g
+    # wins; equal g -> smaller kappa wins (kappa grows monotonically over
+    # tiles, so this is exactly the first-hit tie-break).  Exact while
+    # |g| <= l2p * 254 and kappa <= l2p fit: |pack| <= 520192 * 4096 +
+    # 4095 < 2^31 for l2p <= 2048 — the BUF_SIZE_SEQ2 bucket ceiling;
+    # wider (ring long-context) buckets keep the unpacked path.
+    packed = feed == "i8" and nbi * _BLK <= 2048
+    _KB = 4096
     sb = _superblock(nbn)
     sbw = sb * _BLK  # offset lanes per super-block
 
     ri1 = lax.broadcasted_iota(jnp.int32, (_BLK, _BLK), 0)
     ci1 = lax.broadcasted_iota(jnp.int32, (_BLK, _BLK), 1)
     riw = lax.broadcasted_iota(jnp.int32, (_BLK, sbw), 0)
+    liw = lax.broadcasted_iota(jnp.int32, (1, sbw), 1)
     ltri = (ri1 >= ci1).astype(dd_t)
 
     # Char-blocks wholly past len2 contribute nothing (the self-masking
@@ -175,103 +204,174 @@ def _kernel(meta_ref, codes_ref, a_ref, score_ref, k_ref, k0_ref, *, nbn, nbi, f
     # them entirely.
     nbi_live = jnp.minimum((l2 + _BLK - 1) // _BLK, nbi)
 
+    # Tiles per loop iteration.  Stage-major interleaving of two
+    # independent tiles (all one-hot matmuls issued, then all rotates,
+    # then all prefix matmuls, then the reductions) lets the hardware
+    # overlap MXU matmuls with VPU rotates/reductions — the stages are
+    # cost-ADDITIVE in the 1-wide loop (measured by scripts/kernel_ablate:
+    # pair2 ~10% faster; 4-wide regresses on VMEM pressure).  The f32
+    # feed keeps the 1-wide loop (double-width f32 tiles spill).
+    wide = 1 if feed == "f32" else 2
+
     for nb in range(0, nbn, sb):
         n0 = nb * _BLK
+        slot0 = (nb // sb) * nbi  # static base into the pre-tiled A bands
 
-        def ibody(ib, car):
+        def ibody(ibw, car, slot0=slot0, n0=n0):
             carry, runmax, runkap, t1 = car
-            i0 = ib * _BLK
-            codes = codes_ref[0, ib, :, :]  # [128, 1] int32, sublane-oriented
-            oh = (codes == ci1).astype(oh_t)  # [128, 128]
-            wneed = a_ref.shape[1]
-            # A is stored lane-reversed: this band covers original columns
-            # [n0+i0, n0+i0+sbw+128) in descending order.
-            astart = pl.multiple_of(wneed - (n0 + i0) - (sbw + _BLK), _BLK)
-            aband = a_ref[:, pl.ds(astart, sbw + _BLK)]
-            # No explicit pad mask: row/col 0 of the value table are zeroed
-            # host-side (code 0 appears only as padding), so padded seq2
-            # chars and seq1 positions past len1 contribute exactly 0
-            # through the matmul itself.
             acc_t = jnp.int32 if feed == "i8" else jnp.float32
             # TPU MXUs multiply f32 at bf16 precision by default; the f32
             # feed (128 < |v| <= 4095) needs multi-pass HIGHEST to stay
             # exact (one operand is 0/1, values fit 16 mantissa bits).
             # The i8/bf16 feeds are exact natively.
             prec = lax.Precision.HIGHEST if feed == "f32" else None
-            vp = jnp.dot(
-                oh, aband, preferred_element_type=acc_t, precision=prec
-            )
-            vp = vp.astype(jnp.float32)  # int32 entries <= 127: exact
+
+            # -- stage 1: one-hot matmuls (MXU) --------------------------
+            i0s, vps = [], []
+            for half in range(wide):
+                raw = ibw * wide + half if wide > 1 else ibw
+                if wide > 1:
+                    # The trip count rounds nbi_live up to a multiple of
+                    # `wide`; overhang tiles clamp into range with a
+                    # zeroed one-hot.  A zero tile's deltas are exactly
+                    # zero, so it only duplicates the running carry at a
+                    # LARGER kappa — which the smaller-kappa tie-break
+                    # already rejects (same argument as the rows-past-len2
+                    # duplication below).
+                    ib = jnp.minimum(raw, nbi - 1)
+                    ohb = (codes_ref[0, ib, :, :] == ci1) & (raw < nbi)
+                else:
+                    ib = raw
+                    ohb = codes_ref[0, ib, :, :] == ci1
+                i0 = ib * _BLK
+                i0s.append(i0)
+                if pretiled:
+                    # A arrives pre-tiled per (super-block, char-block): a
+                    # dynamic LEADING-axis index is address arithmetic on
+                    # sublane tiles, where a dynamic-start LANE slice of a
+                    # flat [128, Wneed] A costs a cross-lane shift copy of
+                    # the whole band per tile (~0.5 us — the dominant
+                    # per-iteration overhead in the sb sweep).  Bands are
+                    # stored lane-reversed: slot (nb//sb)*nbi + ib covers
+                    # original columns [n0+i0, n0+i0+sbw+128) descending.
+                    aband = a_ref[slot0 + ib, :, :]
+                else:
+                    # Flat [128, Wneed] band: the overlapping pre-tiled
+                    # layout would exceed the VMEM budget (f32 feed at the
+                    # size caps, ring long-context shards) — pay the
+                    # dynamic lane-slice copy instead.
+                    astart = pl.multiple_of(
+                        a_ref.shape[1] - (n0 + i0) - (sbw + _BLK), _BLK
+                    )
+                    aband = a_ref[:, pl.ds(astart, sbw + _BLK)]
+                # No explicit pad mask: row/col 0 of the value table are
+                # zeroed host-side (code 0 appears only as padding), so
+                # padded seq2 chars and seq1 positions past len1
+                # contribute exactly 0 through the matmul itself.
+                vps.append(
+                    jnp.dot(
+                        ohb.astype(oh_t),
+                        aband,
+                        preferred_element_type=acc_t,
+                        precision=prec,
+                    )
+                )
+
+            # -- stage 2: shear (VPU) ------------------------------------
             # Shear row r left by r = strided rotate right by r on the
             # reversed lanes; one hardware op replaces the 7-step
             # roll+select ladder.  Rows use only lanes j >= r, so the
             # rotate's wraparound never contaminates a consumed lane.
-            vp = pltpu.roll(vp, shift=0, axis=1, stride=1, stride_axis=0)
-            # Reversed-lane diagonals: lane m holds offset n0 + sbw-1-m.
-            if feed == "f32":
-                # f32 MXU runs at ~1/8 the bf16 rate: one fused matmul on
-                # the delta, t1 via a VPU sublane reduction.
-                d0 = vp[:, _BLK:]
-                d1 = vp[:, _BLK - 1 : sbw + _BLK - 1]
-                dd = (d0 - d1).astype(dd_t)
-                lp = jnp.dot(
-                    ltri,
-                    dd,
-                    preferred_element_type=jnp.float32,
-                    precision=lax.Precision.HIGHEST,  # |dd| <= 8190 > 2^8
-                )
-                t1 = t1 + jnp.sum(d1, axis=0)
-            else:
-                # Split prefix matmuls: lp = ltri@d0 - ltri@d1, and row 127
-                # of ltri@d1 (the all-ones row) IS sum(d1) — this tile's t1
-                # increment.  The second cheap bf16 matmul replaces two
-                # full-tile VPU passes (the dd subtract and the t1 sublane
-                # reduction), worth ~1.35x on the i8 feed (BASELINE.md).
-                # One full-width bf16 cast feeds both operand slices
-                # (entries are integers |v| <= 128: bf16-exact).
-                vb = vp.astype(dd_t)
-                pa = jnp.dot(
-                    ltri, vb[:, _BLK:], preferred_element_type=jnp.float32
-                )
-                pb = jnp.dot(
-                    ltri,
-                    vb[:, _BLK - 1 : sbw + _BLK - 1],
-                    preferred_element_type=jnp.float32,
-                )
-                lp = pa - pb
-                t1 = t1 + pb[_BLK - 1, :]
-            g = lp + carry[None, :]
-            # No kappa-validity mask: rows past len2 have zero deltas (the
-            # self-masking table), so their g DUPLICATES the last valid
-            # row's value — the max is unchanged, and the min-index
-            # tie-break below always picks the real (lower) row.
-            bmax = jnp.max(g, axis=0)  # [sbw]
-            brow = jnp.min(
-                jnp.where(g == bmax[None, :], riw, _BIGROW), axis=0
-            )
-            upd = bmax > runmax
-            runmax = jnp.where(upd, bmax, runmax)
-            runkap = jnp.where(upd, i0 + brow + 1, runkap)
-            carry = carry + lp[_BLK - 1, :]
+            # (Mosaic only rotates 32-bit data, so the shear runs on the
+            # accumulator and any narrowing cast follows it.)
+            vps = [
+                pltpu.roll(vp, shift=0, axis=1, stride=1, stride_axis=0)
+                for vp in vps
+            ]
+            # Reversed-lane diagonals: lane m holds offset n0+sbw-1-m.
+
+            # -- stage 3: prefix matmuls (MXU) ---------------------------
+            lps, t1incs = [], []
+            for vp in vps:
+                if feed == "f32":
+                    # f32 MXU runs at ~1/8 the bf16 rate: one fused matmul
+                    # on the delta, t1 via a VPU sublane reduction.
+                    d0 = vp[:, _BLK:]
+                    d1 = vp[:, _BLK - 1 : sbw + _BLK - 1]
+                    dd = (d0 - d1).astype(dd_t)
+                    lps.append(
+                        jnp.dot(
+                            ltri,
+                            dd,
+                            preferred_element_type=jnp.float32,
+                            # |dd| <= 8190 > 2^8
+                            precision=lax.Precision.HIGHEST,
+                        )
+                    )
+                    t1incs.append(jnp.sum(d1, axis=0))
+                else:
+                    # Split prefix matmuls: lp = ltri@d0 - ltri@d1, and
+                    # row 127 of ltri@d1 (the all-ones row) IS sum(d1) —
+                    # this tile's t1 increment.  The second cheap narrow
+                    # matmul replaces two full-tile VPU passes (the dd
+                    # subtract and the t1 sublane reduction), worth ~1.35x
+                    # on the i8 feed (BASELINE.md).  On the i8 feed the
+                    # matmuls run int8 x int8 -> int32 (exact, twice the
+                    # bf16 rate); bf16 likewise (integers |v| <= 128 are
+                    # bf16-exact).
+                    vb = vp.astype(dd_t)
+                    pa = jnp.dot(
+                        ltri, vb[:, _BLK:], preferred_element_type=sc_t
+                    )
+                    pb = jnp.dot(
+                        ltri,
+                        vb[:, _BLK - 1 : sbw + _BLK - 1],
+                        preferred_element_type=sc_t,
+                    )
+                    lps.append(pa - pb)
+                    t1incs.append(pb[_BLK - 1, :])
+
+            # -- stage 4: streaming reductions (VPU) ---------------------
+            for i0, lp, t1i in zip(i0s, lps, t1incs):
+                t1 = t1 + t1i
+                g = lp + carry[None, :]
+                # No kappa-validity mask: rows past len2 have zero deltas
+                # (the self-masking table), so their g DUPLICATES the last
+                # valid row's value — the max is unchanged, and the
+                # smaller-kappa tie-break (min-index / packed low bits)
+                # picks the real row.
+                if packed:
+                    # kappa = i0 + riw + 1: 4095 - kappa = (4094-i0) - riw.
+                    gpack = g * _KB + ((_KB - 2 - i0) - riw)
+                    runmax = jnp.maximum(runmax, jnp.max(gpack, axis=0))
+                else:
+                    bmax = jnp.max(g, axis=0)  # [sbw]
+                    brow = jnp.min(
+                        jnp.where(g == bmax[None, :], riw, _BIGROW), axis=0
+                    )
+                    upd = bmax > runmax
+                    runmax = jnp.where(upd, bmax, runmax)
+                    runkap = jnp.where(upd, i0 + brow + 1, runkap)
+                carry = carry + lp[_BLK - 1, :]
             return carry, runmax, runkap, t1
 
-        zeros = jnp.zeros((sbw,), jnp.float32)
+        zeros = jnp.zeros((sbw,), sc_t)
         init = (
             zeros,
-            jnp.full((sbw,), _NEG),
+            jnp.full((sbw,), -(2**31 - 1) if packed else neg, sc_t),
             jnp.zeros((sbw,), jnp.int32),
             zeros,
         )
 
         def nbody():
-            return lax.fori_loop(0, nbi_live, ibody, init)
+            return lax.fori_loop(0, (nbi_live + wide - 1) // wide, ibody, init)
 
         if nb == 0:
             # Always runs: carries the equal-length k=0 capture at n=0.
             carry, runmax, runkap, t1 = nbody()
         else:
             # Super-blocks wholly past the pair's valid range
-            # (n >= len1 - len2) are dead lanes in the epilogue: skip.
+            # (n >= len1 - len2) are dead lanes (masked below): skip.
             carry, runmax, runkap, t1 = lax.cond(
                 n0 < len1 - l2, nbody, lambda: init
             )
@@ -279,16 +379,89 @@ def _kernel(meta_ref, codes_ref, a_ref, score_ref, k_ref, k0_ref, *, nbn, nbi, f
         # Zero deltas past len2 also mean the final prefix carry IS
         # G[len2] — the k=0 candidate — with no separate capture pass.
         endg = carry
-        sl = (0, 0, pl.ds(n0, sbw))
-        score_ref[sl] = t1 + runmax
-        k_ref[sl] = jnp.where(endg == runmax, 0, runkap)  # k=0 wins ties
-        k0_ref[sl] = t1 + endg
+        if packed:
+            # Decode (score, kappa) from the packed running max; // and &
+            # have floor / two's-complement semantics, so negative scores
+            # decode exactly.
+            runkap = (_KB - 1) - (runmax & (_KB - 1))
+            runmax = runmax // _KB
+
+        # -- in-kernel per-super-block argmax over offsets ----------------
+        # The round-1 design wrote three [B, W] reversed surfaces and left
+        # masking, un-reversing and the offset argmax to an XLA epilogue;
+        # measured on-device that epilogue cost ~33 us/call (~17%) — more
+        # than either matmul stage — almost all of it the un-reverse.
+        # Reducing to one best candidate per pair here makes the kernel
+        # output O(1) and the epilogue trivial.
+        svec = (t1 + runmax).astype(jnp.float32)
+        kvec = jnp.where(endg == runmax, 0, runkap)  # k=0 wins ties
+        # Reversed lanes: lane m holds global offset n = n0 + sbw-1-m.
+        nvec = (n0 + sbw - 1) - liw
+        sm = jnp.where(nvec < len1 - l2, svec[None, :], _NEG)  # [1, sbw]
+        sbbest = jnp.max(sm)
+        # First-hit tie-break = smallest n = LARGEST reversed lane index.
+        mstar = jnp.max(jnp.where(sm == sbbest, liw, -1))
+        nstar = (n0 + sbw - 1) - mstar
+        kstar = jnp.sum(jnp.where(liw == mstar, kvec[None, :], 0))
+        if nb == 0:
+            bscore, bn, bk = sbbest, nstar, kstar
+            # Equal-length capture: global n=0 is reversed lane sbw-1.
+            eqv = (t1 + endg).astype(jnp.float32)[sbw - 1]
+        else:
+            # Strictly-greater keeps the earlier (smaller-n) super-block.
+            upd = sbbest > bscore
+            bscore = jnp.where(upd, sbbest, bscore)
+            bn = jnp.where(upd, nstar, bn)
+            bk = jnp.where(upd, kstar, bk)
+
+    lo = lax.broadcasted_iota(jnp.int32, (1, _BLK), 1)
+    vec = jnp.where(
+        lo == 0,
+        bscore,
+        jnp.where(
+            lo == 1,
+            bn.astype(jnp.float32),
+            jnp.where(
+                lo == 2,
+                bk.astype(jnp.float32),
+                jnp.where(lo == 3, eqv, 0.0),
+            ),
+        ),
+    )
+    out_ref[0, :, :] = vec
+
+
+# Pre-tiled A bands beyond this budget (f32 feed at the size caps, ring
+# long-context shards) fall back to the flat layout + dynamic lane slice:
+# the overlapping tiles multiply the footprint by ~bandw/128, and the whole
+# array must stay VMEM-resident across the grid.
+_PRETILE_BUDGET_BYTES = 8 << 20
+
+
+def _pretile_ok(nbn: int, nbi: int, feed: str) -> bool:
+    sb = _superblock(nbn)
+    slots = (nbn // sb) * nbi
+    bandw = sb * _BLK + _BLK
+    itemsize = 1 if feed == "i8" else 2 if feed == "bf16" else 4
+    return slots * _BLK * bandw * itemsize <= _PRETILE_BUDGET_BYTES
 
 
 @functools.lru_cache(maxsize=32)
-def _pallas_call(nbn: int, nbi: int, wneed: int, b: int, interpret: bool, feed: str):
-    kernel = functools.partial(_kernel, nbn=nbn, nbi=nbi, feed=feed)
-    w = nbn * _BLK
+def _pallas_call(
+    nbn: int, nbi: int, wneed: int, b: int, interpret: bool, feed: str
+):
+    pretiled = _pretile_ok(nbn, nbi, feed)
+    kernel = functools.partial(
+        _kernel, nbn=nbn, nbi=nbi, feed=feed, pretiled=pretiled
+    )
+    sb = _superblock(nbn)
+    slots = (nbn // sb) * nbi
+    bandw = sb * _BLK + _BLK
+    a_spec = (
+        pl.BlockSpec((slots, _BLK, bandw), lambda p, lens: (0, 0, 0))
+        if pretiled
+        else pl.BlockSpec((_BLK, wneed), lambda p, lens: (0, 0))
+    )
     return pl.pallas_call(
         kernel,
         interpret=interpret,
@@ -297,30 +470,29 @@ def _pallas_call(nbn: int, nbi: int, wneed: int, b: int, interpret: bool, feed: 
             grid=(b,),
             in_specs=[
                 pl.BlockSpec((1, nbi, _BLK, 1), lambda p, lens: (p, 0, 0, 0)),
-                pl.BlockSpec((_BLK, wneed), lambda p, lens: (0, 0)),
+                a_spec,
             ],
             out_specs=[
-                pl.BlockSpec((1, 1, w), lambda p, lens: (p, 0, 0)),
-                pl.BlockSpec((1, 1, w), lambda p, lens: (p, 0, 0)),
-                pl.BlockSpec((1, 1, w), lambda p, lens: (p, 0, 0)),
+                pl.BlockSpec((1, 1, _BLK), lambda p, lens: (p, 0, 0)),
             ],
         ),
         out_shape=[
-            jax.ShapeDtypeStruct((b, 1, w), jnp.float32),
-            jax.ShapeDtypeStruct((b, 1, w), jnp.int32),
-            jax.ShapeDtypeStruct((b, 1, w), jnp.float32),
+            jax.ShapeDtypeStruct((b, 1, _BLK), jnp.float32),
         ],
     )
 
 
-def _pallas_offset_surfaces(seq1ext, len1, rows, lens, val_flat, feed="f32"):
-    """Run the fused kernel; returns the raw per-offset surfaces
-    ``(score_n, k_n, k0_n)``, each ``[B, W]`` (W = offset-axis extent), in
-    standard lane orientation.  ``score_n[b, n]`` is the best score over all
-    mutants k at offset n (k=0 included), ``k_n`` the first-hit best k with
-    the k=0-wins-ties rule, ``k0_n`` the k=0 score.  No offset-validity
-    masking is applied here — callers mask with their own ``len1`` view
-    (the ring path passes a block-local effective len1)."""
+def _pallas_best(seq1ext, len1, rows, lens, val_flat, feed="f32"):
+    """Run the fused kernel; returns per-pair best candidates
+    ``(score, n, k, eq)``, each ``[B]`` (score/eq float32, n/k int32).
+
+    ``score`` is the masked best over valid offsets n < len1 - len2 with
+    the reference's first-hit tie-break (offset-major, k-ascending with
+    k=0 first); all-invalid pairs carry the ``_NEG`` sentinel.  ``eq`` is
+    the positional k=0 score at offset 0 (the equal-length fast path and
+    the ring combine's device-0 capture).  Offset validity is the caller's
+    ``len1`` view — the ring path passes a block-local effective len1, so
+    ``n`` is block-local there."""
     b, l2p = rows.shape
     w = seq1ext.shape[0] - l2p - 1  # == L1P (offset-axis extent)
     nbn, nbi = w // _BLK, l2p // _BLK
@@ -350,6 +522,27 @@ def _pallas_offset_surfaces(seq1ext, len1, rows, lens, val_flat, feed="f32"):
         .at[:ALPHABET_SIZE]
         .set(a_small[:, ::-1])
     ).astype(a_t)
+    # Pre-tile the band per (super-block, char-block) slot when it fits
+    # the VMEM budget: the kernel indexes bands by their LEADING axis
+    # (cheap sublane addressing); a dynamic-start lane slice of the flat
+    # array costs a cross-lane shift copy of the whole band per tile.
+    # Slices overlap, so A3 is ~bandw/128 times the flat array.
+    if _pretile_ok(nbn, nbi, feed):
+        sb = _superblock(nbn)
+        sbw = sb * _BLK
+        bandw = sbw + _BLK
+        a_in = jnp.stack(
+            [
+                lax.slice_in_dim(
+                    a_ext, wneed - (n0 + ib * _BLK) - bandw,
+                    wneed - (n0 + ib * _BLK), axis=1
+                )
+                for n0 in range(0, nbn * _BLK, sbw)
+                for ib in range(nbi)
+            ]
+        )
+    else:
+        a_in = a_ext
 
     codes = rows.astype(jnp.int32).reshape(b, nbi, _BLK, 1)
     meta = jnp.concatenate(
@@ -359,36 +552,25 @@ def _pallas_offset_surfaces(seq1ext, len1, rows, lens, val_flat, feed="f32"):
     # Off-TPU (the 8-virtual-device CPU test mesh) the Mosaic kernel cannot
     # lower; interpret mode runs the same kernel semantics for parity tests.
     interpret = jax.default_backend() != "tpu"
-    score_n, k_n, k0_n = _pallas_call(nbn, nbi, wneed, b, interpret, feed)(
-        meta, codes, a_ext
+    out = _pallas_call(nbn, nbi, wneed, b, interpret, feed)(
+        meta, codes, a_in
+    )[0][:, 0, :]
+    return (
+        out[:, 0],
+        out[:, 1].astype(jnp.int32),
+        out[:, 2].astype(jnp.int32),
+        out[:, 3],
     )
-
-    sbw = _superblock(nbn) * _BLK
-
-    def unrev(x):
-        # Kernel lanes are reversed within each offset super-block.
-        return x[:, 0, :].reshape(b, w // sbw, sbw)[:, :, ::-1].reshape(b, w)
-
-    return unrev(score_n), unrev(k_n), unrev(k0_n)
 
 
 def _pallas_rows(seq1ext, len1, rows, lens, val_flat, feed="f32"):
     """Score a [B, L2P] padded batch with the fused kernel; returns [B, 3]."""
-    b, l2p = rows.shape
-    w = seq1ext.shape[0] - l2p - 1
-    score_n, k_n, k0_n = _pallas_offset_surfaces(
+    best, bn, bk, eq = _pallas_best(
         seq1ext, len1, rows, lens, val_flat, feed=feed
     )
 
-    # Tiny [B, NOFF] epilogue in XLA: offset validity, first-max argmax,
-    # equal-length / unsearchable selection.
-    n = jnp.arange(w, dtype=jnp.int32)[None, :]
-    score_n = jnp.where(n < jnp.maximum(len1 - lens, 0)[:, None], score_n, _NEG)
-    bn = jnp.argmax(score_n, axis=1).astype(jnp.int32)
-    best = jnp.take_along_axis(score_n, bn[:, None], axis=1)[:, 0]
-    bk = jnp.take_along_axis(k_n, bn[:, None], axis=1)[:, 0]
-    eq = k0_n[:, 0]  # t1 + G[len2] at n=0 == positional score
-
+    # O(B)-scalar epilogue: equal-length / unsearchable selection (the
+    # offset masking and argmax happen inside the kernel).
     searchable = (lens < len1) & (lens > 0)
     score_f = jnp.where(lens == len1, eq, best)
     score = jnp.where(

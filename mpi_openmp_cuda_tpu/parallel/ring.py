@@ -161,29 +161,24 @@ def _ring_fn(mesh, bs, l2p, cb, mode: tuple = ("gather",)):
         if mode[0] == "pallas":
             # Fused-kernel formulation: the shard's window is a
             # self-contained Seq1 for the kernel; a block-local effective
-            # len1 makes its offset-block skip and the validity mask agree
-            # with the global bound gn < len1 - len2.
-            from ..ops.pallas_scorer import _NEG, _pallas_offset_surfaces
+            # len1 makes its offset-block skip and the in-kernel validity
+            # mask agree with the global bound gn < len1 - len2.  The
+            # kernel reduces each pair to its best in-shard candidate, so
+            # the combine below works on scalars.
+            from ..ops.pallas_scorer import _pallas_best
 
             win_k = win[: bs + l2p + 1]
             len1_eff = len1 - d * bs
-            score_n, k_n, k0_n = _pallas_offset_surfaces(
+            bv, bi, bk, eq = _pallas_best(
                 win_k, len1_eff, rows, lens, val_flat, feed=mode[1]
             )
-            nn = jnp.arange(bs, dtype=jnp.int32)[None, :]
-            valid = nn < jnp.maximum(len1_eff - lens, 0)[:, None]
-            negf = jnp.float32(_NEG)
-            score_m = jnp.where(valid, score_n, negf)
-            bi = jnp.argmax(score_m, axis=1).astype(jnp.int32)
-            bv = jnp.take_along_axis(score_m, bi[:, None], axis=1)[:, 0]
-            bk = jnp.take_along_axis(k_n, bi[:, None], axis=1)[:, 0]
-            # Masked lanes carry the f32 sentinel, far below int32 range:
-            # map an all-invalid shard to INT32_MIN before the int cast.
+            # All-invalid shards carry the kernel's f32 sentinel, far
+            # below int32 range: map to INT32_MIN before the int cast.
             sc = jnp.where(
                 bv <= jnp.float32(INT32_MIN), neg, bv.astype(jnp.int32)
             )
             cand = jnp.stack(
-                [sc, d * bs + bi, bk, k0_n[:, 0].astype(jnp.int32)], axis=1
+                [sc, d * bs + bi, bk, eq.astype(jnp.int32)], axis=1
             )
         else:
             n_local = jnp.arange(bs, dtype=jnp.int32)[:, None]

@@ -1,0 +1,325 @@
+"""On-device ablation of the fused kernel's per-tile cost structure.
+
+A switchable COPY of ops/pallas_scorer._kernel (deliberately standalone:
+ablations break semantics, so they must never be importable from the
+production module) that can disable individual pipeline stages.  Timing a
+stage-disabled variant against the full kernel attributes wall-clock to
+that stage — the measurement VERDICT r1 asked for before attacking the
+efficiency gap.
+
+    python scripts/kernel_ablate.py                # the full matrix
+    python scripts/kernel_ablate.py --only base,noprefix
+
+Variants (cumulative ablations are NOT composed; each drops one stage):
+  base       the production pipeline (cross-check against kernel_bench)
+  nooh       one-hot matmul replaced by a VMEM slice of the A band
+  norot      strided-rotate shear skipped
+  nocast     the int32->int8 full-width cast skipped (prefix reads aband)
+  noprefix   both prefix matmuls skipped (lp = vb slice)
+  nomax      running max / argmax / tie-break reductions skipped
+  nocarry    g = lp + carry add skipped (g = lp)
+  bf16pfx    prefix matmuls in bf16 instead of int8 (the r1 formulation)
+  pair2      two char-blocks per loop iteration, stages interleaved so
+             independent MXU matmuls can overlap VPU rotates/reductions
+"""
+
+from __future__ import annotations
+
+import argparse
+import functools
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+from bench import min_wall_slope
+
+_BLK = 128
+_BIGROW = 1 << 30
+
+
+def _kernel_var(meta_ref, codes_ref, a_ref, score_ref, k_ref, k0_ref, *, nbn, nbi, var):
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    from mpi_openmp_cuda_tpu.ops.pallas_scorer import _superblock
+
+    len1 = meta_ref[0]
+    l2 = meta_ref[1 + pl.program_id(0)]
+    dd_t = jnp.bfloat16 if var == "bf16pfx" else jnp.int8
+    sc_t = jnp.float32 if var == "bf16pfx" else jnp.int32
+    neg = -(2.0**40) if var == "bf16pfx" else -(1 << 30)
+    sb = _superblock(nbn)
+    sbw = sb * _BLK
+
+    ri1 = lax.broadcasted_iota(jnp.int32, (_BLK, _BLK), 0)
+    ci1 = lax.broadcasted_iota(jnp.int32, (_BLK, _BLK), 1)
+    riw = lax.broadcasted_iota(jnp.int32, (_BLK, sbw), 0)
+    ltri = (ri1 >= ci1).astype(dd_t)
+    nbi_live = jnp.minimum((l2 + _BLK - 1) // _BLK, nbi)
+
+    for nb in range(0, nbn, sb):
+        n0 = nb * _BLK
+
+        def ibody2(ib2, car, wide=2):
+            # `wide` tiles per iteration, stage-interleaved: all one-hot
+            # matmuls issue before any rotate, all rotates before the
+            # prefix matmuls, etc.  An extra dead tile past len2 (odd
+            # nbi_live) is harmless: its deltas are exactly zero.
+            carry, runmax, runkap, t1 = car
+            wneed = a_ref.shape[1]
+            vps = []
+            i0s = []
+            for half in range(wide):
+                # Clamp keeps the last odd tile in range (timing-only
+                # duplicate; production would mask it).
+                ib = jnp.minimum(ib2 * wide + half, nbi - 1)
+                i0 = ib * _BLK
+                i0s.append(i0)
+                codes = codes_ref[0, ib, :, :]
+                oh = (codes == ci1).astype(jnp.int8)
+                astart = pl.multiple_of(wneed - (n0 + i0) - (sbw + _BLK), _BLK)
+                aband = a_ref[:, pl.ds(astart, sbw + _BLK)]
+                vps.append(jnp.dot(oh, aband, preferred_element_type=jnp.int32))
+            vps = [
+                pltpu.roll(vp, shift=0, axis=1, stride=1, stride_axis=0)
+                for vp in vps
+            ]
+            vbs = [vp.astype(jnp.int8) for vp in vps]
+            pas = [
+                jnp.dot(ltri, vb[:, _BLK:], preferred_element_type=jnp.int32)
+                for vb in vbs
+            ]
+            pbs = [
+                jnp.dot(
+                    ltri,
+                    vb[:, _BLK - 1 : sbw + _BLK - 1],
+                    preferred_element_type=jnp.int32,
+                )
+                for vb in vbs
+            ]
+            for i0, pa, pb in zip(i0s, pas, pbs):
+                lp = pa - pb
+                t1 = t1 + pb[_BLK - 1, :]
+                g = lp + carry[None, :]
+                gpack = g * 4096 + ((4094 - i0) - riw)
+                runmax = jnp.maximum(runmax, jnp.max(gpack, axis=0))
+                carry = carry + lp[_BLK - 1, :]
+            return carry, runmax, runkap, t1
+
+        def ibody(ib, car):
+            carry, runmax, runkap, t1 = car
+            i0 = ib * _BLK
+            codes = codes_ref[0, ib, :, :]
+            oh = (codes == ci1).astype(jnp.int8)
+            wneed = a_ref.shape[1]
+            astart = pl.multiple_of(wneed - (n0 + i0) - (sbw + _BLK), _BLK)
+            aband = a_ref[:, pl.ds(astart, sbw + _BLK)]
+            if var == "nooh":
+                vp = aband.astype(jnp.int32) * 2  # placeholder for the matmul
+            else:
+                vp = jnp.dot(oh, aband, preferred_element_type=jnp.int32)
+            if var != "norot":
+                vp = pltpu.roll(vp, shift=0, axis=1, stride=1, stride_axis=0)
+            if var == "nocast":
+                vb = aband.astype(dd_t)  # pre-cast operand: no int32 pass
+            else:
+                vb = vp.astype(dd_t)
+            if var == "noprefix":
+                lp = vp[:, _BLK:].astype(sc_t)
+                t1 = t1 + lp[_BLK - 1, :]
+            else:
+                pa = jnp.dot(ltri, vb[:, _BLK:], preferred_element_type=sc_t)
+                pb = jnp.dot(
+                    ltri,
+                    vb[:, _BLK - 1 : sbw + _BLK - 1],
+                    preferred_element_type=sc_t,
+                )
+                lp = pa - pb
+                t1 = t1 + pb[_BLK - 1, :]
+            g = lp if var == "nocarry" else lp + carry[None, :]
+            if var == "nomax":
+                runmax = runmax + g[0, :]
+            elif var == "oldmax":
+                bmax = jnp.max(g, axis=0)
+                brow = jnp.min(
+                    jnp.where(g == bmax[None, :], riw, _BIGROW), axis=0
+                )
+                upd = bmax > runmax
+                runmax = jnp.where(upd, bmax, runmax)
+                runkap = jnp.where(upd, i0 + brow + 1, runkap)
+            else:
+                gpack = g * 4096 + ((4094 - i0) - riw) if var != "bf16pfx" else g
+                runmax = jnp.maximum(runmax, jnp.max(gpack, axis=0))
+            carry = carry + lp[_BLK - 1, :]
+            return carry, runmax, runkap, t1
+
+        zeros = jnp.zeros((sbw,), sc_t)
+        init = (zeros, jnp.full((sbw,), neg, sc_t), jnp.zeros((sbw,), jnp.int32), zeros)
+
+        def nbody():
+            if var == "pair2":
+                return lax.fori_loop(0, (nbi_live + 1) // 2, ibody2, init)
+            if var == "pair4":
+                return lax.fori_loop(
+                    0,
+                    (nbi_live + 3) // 4,
+                    functools.partial(ibody2, wide=4),
+                    init,
+                )
+            if var == "pair3":
+                return lax.fori_loop(
+                    0,
+                    (nbi_live + 2) // 3,
+                    functools.partial(ibody2, wide=3),
+                    init,
+                )
+            return lax.fori_loop(0, nbi_live, ibody, init)
+
+        if nb == 0:
+            carry, runmax, runkap, t1 = nbody()
+        else:
+            carry, runmax, runkap, t1 = lax.cond(n0 < len1 - l2, nbody, lambda: init)
+
+        sl = (0, 0, pl.ds(n0, sbw))
+        score_ref[sl] = (t1 + runmax).astype(jnp.float32)
+        k_ref[sl] = jnp.where(carry == runmax, 0, runkap)
+        k0_ref[sl] = (t1 + carry).astype(jnp.float32)
+
+
+@functools.lru_cache(maxsize=64)
+def _call(nbn, nbi, wneed, b, var):
+    import jax
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+    import jax.numpy as jnp
+
+    kernel = functools.partial(_kernel_var, nbn=nbn, nbi=nbi, var=var)
+    w = nbn * _BLK
+    return pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(b,),
+            in_specs=[
+                pl.BlockSpec((1, nbi, _BLK, 1), lambda p, lens: (p, 0, 0, 0)),
+                pl.BlockSpec((_BLK, wneed), lambda p, lens: (0, 0)),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, 1, w), lambda p, lens: (p, 0, 0)),
+                pl.BlockSpec((1, 1, w), lambda p, lens: (p, 0, 0)),
+                pl.BlockSpec((1, 1, w), lambda p, lens: (p, 0, 0)),
+            ],
+        ),
+        out_shape=[
+            jax.ShapeDtypeStruct((b, 1, w), jnp.float32),
+            jax.ShapeDtypeStruct((b, 1, w), jnp.int32),
+            jax.ShapeDtypeStruct((b, 1, w), jnp.float32),
+        ],
+    )
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--input", default="/root/reference/input3.txt")
+    ap.add_argument("--reps", type=int, default=512)
+    ap.add_argument("--only", default=None)
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from mpi_openmp_cuda_tpu.io.parse import load_problem
+    from mpi_openmp_cuda_tpu.ops.dispatch import pad_problem
+    from mpi_openmp_cuda_tpu.ops.pallas_scorer import _FEED_DTYPES
+    from mpi_openmp_cuda_tpu.ops.values import value_table
+
+    problem = load_problem(args.input)
+    batch = pad_problem(problem.seq1_codes, problem.seq2_codes)
+    val = value_table(problem.weights).astype(np.int32).reshape(-1)
+
+    b, l2p = batch.seq2.shape
+    l1p = batch.l1p
+    nbn, nbi = l1p // _BLK, l2p // _BLK
+    w = nbn * _BLK
+    wneed = w + l2p
+
+    # Host-side operand prep (mirrors _pallas_offset_surfaces).
+    from mpi_openmp_cuda_tpu.utils.constants import ALPHABET_SIZE
+
+    val27 = val.reshape(ALPHABET_SIZE, ALPHABET_SIZE).astype(np.float32)
+    val27[0, :] = 0.0
+    val27[:, 0] = 0.0
+    seq1ext = np.asarray(batch.seq1ext)
+    oh1 = (seq1ext[:wneed, None] == np.arange(ALPHABET_SIZE)[None, :]).astype(
+        np.float32
+    )
+    a_small = val27 @ oh1.T
+    a_ext = np.zeros((_BLK, wneed), np.float32)
+    a_ext[:ALPHABET_SIZE] = a_small[:, ::-1]
+    a_i8 = jnp.asarray(a_ext.astype(np.int8))
+
+    codes = jnp.asarray(batch.seq2.astype(np.int32).reshape(b, nbi, _BLK, 1))
+    meta = jnp.concatenate(
+        [
+            jnp.asarray([batch.len1], jnp.int32),
+            jnp.asarray(batch.len2, jnp.int32),
+        ]
+    )
+
+    variants = [
+        "base", "oldmax", "pair2", "nooh", "norot", "nocast", "noprefix",
+        "nomax", "nocarry", "bf16pfx",
+    ]
+    if args.only:
+        variants = args.only.split(",")
+
+    results = {}
+    for var in variants:
+        a_in = a_i8 if var != "bf16pfx" else a_i8  # oh matmul always i8 here
+        call = _call(nbn, nbi, wneed, b, var)
+
+        def make(k, call=call, a_in=a_in):
+            def f(meta, codes, a_in):
+                def step(c, i):
+                    out = call(meta, jnp.roll(codes, i, axis=0), a_in)
+                    return c + out[0].sum(), None
+
+                tot, _ = lax.scan(step, jnp.float32(0), jnp.arange(k))
+                return tot
+
+            return jax.jit(f)
+
+        t0 = time.perf_counter()
+        fns = {}
+        for k in (1, 1 + args.reps):
+            fns[k] = make(k)
+            float(fns[k](meta, codes, a_in))
+        compile_s = time.perf_counter() - t0
+        progs = {
+            k: (lambda f=f: float(f(meta, codes, a_in))) for k, f in fns.items()
+        }
+        slopes = sorted(min_wall_slope(progs) for _ in range(3))
+        results[var] = slopes[1]
+        print(
+            f"{var:9s} {slopes[1] * 1e6:7.1f} us/call "
+            f"(slopes {'/'.join(f'{s * 1e6:.1f}' for s in slopes)}; "
+            f"compile {compile_s:.0f}s)",
+            flush=True,
+        )
+    if "base" in results:
+        base = results["base"]
+        for var, wall in results.items():
+            if var != "base":
+                print(f"{var:9s} saves {base - wall:7.1f} us ({(base - wall) / base * 100:5.1f}%)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

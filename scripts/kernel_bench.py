@@ -1,0 +1,138 @@
+"""Raw fused-kernel timing harness for iterating on ops/pallas_scorer.py.
+
+Times ONLY the jitted chunked kernel program (no CLI, no parse, no
+dispatch policy) with the same amortised min-wall slope protocol as
+bench.py, on input3 (default) or a chosen workload.  Prints per-call
+microseconds, eq-comparisons/s, and the live-tile TFLOP/s so a kernel
+change's effect is visible in ~30 s instead of a full bench run.
+
+    python scripts/kernel_bench.py [--input PATH] [--reps N] [--feed F]
+
+Compare variants within one invocation window where possible: the chip is
+shared behind a tunnel and co-tenant load shifts absolute numbers.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+from bench import brute_force_elements, min_wall_slope
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--input", default="/root/reference/input3.txt")
+    ap.add_argument("--reps", type=int, default=512)
+    ap.add_argument(
+        "--feed", default=None, help="force an MXU feed (default: mxu_feed policy)"
+    )
+    ap.add_argument(
+        "--synthetic",
+        default=None,
+        metavar="L1xNxLO-HI",
+        help="synthetic workload, e.g. 3000x64x1200-1999 (overrides --input)",
+    )
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from mpi_openmp_cuda_tpu.io.parse import load_problem
+    from mpi_openmp_cuda_tpu.ops.dispatch import (
+        DEFAULT_CHUNK_BUDGET,
+        choose_chunk,
+        pad_batch_rows,
+        pad_problem,
+        round_up,
+    )
+    from mpi_openmp_cuda_tpu.ops.pallas_scorer import (
+        kernel_mxu_flops,
+        mxu_feed,
+        score_chunks_pallas_body,
+    )
+    from mpi_openmp_cuda_tpu.ops.values import value_table
+
+    if args.synthetic:
+        l1s, ns, lohi = args.synthetic.split("x")
+        lo, hi = (int(t) for t in lohi.split("-"))
+        rng = np.random.default_rng(7)
+        seq1_codes = rng.integers(1, 27, size=int(l1s)).astype(np.int8)
+        lens2 = [int(x) for x in rng.integers(lo, hi + 1, size=int(ns))]
+        seq2_codes = [rng.integers(1, 27, size=l).astype(np.int8) for l in lens2]
+        weights = [2, 2, 1, 10]
+        name = f"synthetic-{args.synthetic}"
+    else:
+        problem = load_problem(args.input)
+        seq1_codes, seq2_codes = problem.seq1_codes, problem.seq2_codes
+        weights = problem.weights
+        name = os.path.basename(args.input)
+
+    batch = pad_problem(seq1_codes, seq2_codes, enforce_caps=False)
+    val = value_table(weights).astype(np.int32).reshape(-1)
+    feed = args.feed or mxu_feed(val)
+    b = batch.batch_size
+    cb = choose_chunk(batch, DEFAULT_CHUNK_BUDGET)
+    bp = round_up(b, cb)
+    rows, lens = pad_batch_rows(batch, bp)
+    fargs = (
+        jnp.asarray(batch.seq1ext),
+        jnp.int32(batch.len1),
+        jnp.asarray(rows.reshape(bp // cb, cb, batch.l2p)),
+        jnp.asarray(lens.reshape(bp // cb, cb)),
+        jnp.asarray(val),
+    )
+
+    def make(k):
+        def f(seq1ext, len1, rows, lens, val_flat):
+            def step(carry, i):
+                r = jnp.roll(rows, i, axis=1)
+                l = jnp.roll(lens, i, axis=1)
+                out = score_chunks_pallas_body(
+                    seq1ext, len1, r, l, val_flat, feed=feed
+                )
+                return carry + out.sum(), None
+
+            tot, _ = lax.scan(step, jnp.int32(0), jnp.arange(k))
+            return tot
+
+        return jax.jit(f)
+
+    t0 = time.perf_counter()
+    fns = {}
+    for k in (1, 1 + args.reps):
+        fns[k] = make(k)
+        int(fns[k](*fargs))
+    compile_s = time.perf_counter() - t0
+    progs = {k: (lambda f=f: int(f(*fargs))) for k, f in fns.items()}
+    slopes = sorted(min_wall_slope(progs) for _ in range(3))
+
+    wall = slopes[1]  # median
+    lens2 = [c.size for c in seq2_codes]
+    elems = brute_force_elements(int(seq1_codes.size), lens2)
+    flops = kernel_mxu_flops(batch.len1, lens2, batch.l1p, batch.l2p, feed)
+    print(
+        f"{name} feed={feed} l1p={batch.l1p} l2p={batch.l2p} b={b} "
+        f"device={jax.devices()[0].device_kind}"
+    )
+    print(
+        f"steady {wall * 1e6:.1f} us/call (slopes "
+        + "/".join(f"{s * 1e6:.1f}" for s in slopes)
+        + f"; compile+warm {compile_s:.0f}s)"
+    )
+    print(
+        f"eq-comparisons {elems / wall:.3e}/s | live-tile {flops / wall / 1e12:.1f} "
+        f"TFLOP/s ({flops / 1e9:.2f} GFLOP/call)"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -80,6 +80,19 @@ def problems():
     yield seq1b, [rng.integers(1, 3, size=n).astype(np.int8) for n in (7, 150, 299)]
 
 
+def pretile_boundary_cases():
+    """Caps-size bucket (l1p=3072, l2p=2048) through the fused kernel for
+    one feed on each side of the A-band pre-tiling VMEM budget: i8 keeps
+    the pre-tiled layout, f32 must take the flat-band fallback (pre-tiled
+    it would be ~27 MiB of VMEM).  Pallas-only: the regimes themselves are
+    covered across backends by the main sweep."""
+    rng = np.random.default_rng(5)
+    seq1 = rng.integers(1, 27, size=3000).astype(np.int8)
+    seqs = [rng.integers(1, 27, size=n).astype(np.int8) for n in (1999, 900, 40)]
+    for weights in ([10, 2, 3, 4], [300, 7, 1, 2]):
+        yield seq1, seqs, weights
+
+
 def main() -> int:
     # Respect an explicit JAX_PLATFORMS choice (TPU site hooks can clobber
     # it): a CPU-forced run must hit the platform gate below, not silently
@@ -123,6 +136,18 @@ def main() -> int:
                         f"got={[got[i] for i in bad]} want={[want[i] for i in bad]}",
                         file=sys.stderr,
                     )
+    for seq1, seqs, weights in pretile_boundary_cases():
+        got = [
+            tuple(int(x) for x in r)
+            for r in scorers["pallas"].score_codes(seq1, seqs, weights)
+        ]
+        want = score_batch_oracle(seq1, seqs, weights)
+        tag = f"pallas caps-size w={weights[0]} (pretile boundary)"
+        if got == want:
+            print(f"OK   {tag}", file=sys.stderr)
+        else:
+            failures += 1
+            print(f"FAIL {tag}: got={got} want={want}", file=sys.stderr)
     if failures:
         print(f"tpu_conformance: {failures} FAILURES", file=sys.stderr)
         return 1

@@ -180,13 +180,13 @@ def test_ring_pallas_mode_engages(rng, monkeypatch):
     import mpi_openmp_cuda_tpu.ops.pallas_scorer as ps
 
     calls = []
-    orig = ps._pallas_offset_surfaces
+    orig = ps._pallas_best
 
     def spy(*a, **k):
         calls.append(1)
         return orig(*a, **k)
 
-    monkeypatch.setattr(ps, "_pallas_offset_surfaces", spy)
+    monkeypatch.setattr(ps, "_pallas_best", spy)
     # Distinctive sizes: the jitted ring fn is cached by shape, so reusing
     # another test's bucket would skip tracing (and the spy) entirely.
     seq1 = rng.integers(1, 27, size=333).astype(np.int8)
