@@ -32,8 +32,13 @@ final: native/main.cpp native/tpu_backend.cpp native/tpu_proto.h
 run: final
 	./final < $(INPUT)
 
+# TPU_SEQALIGN_MESH takes the full --mesh grammar: N / batch:N (data
+# parallel), seq:N (Seq1 ring-sharded), DxS (2-D dp x sp).
 run2: final
 	TPU_SEQALIGN_MESH=2 ./final < $(INPUT)
+
+runRing: final
+	TPU_SEQALIGN_MESH=seq:2 ./final < $(INPUT)
 
 # Two-machine deployment (reference runOn2, makefile:15): every host runs
 # the same command; host 0 reads stdin.  Requires JAX_COORDINATOR_ADDRESS,

@@ -74,7 +74,9 @@ def build_arg_parser() -> argparse.ArgumentParser:
         "--journal",
         default=None,
         metavar="PATH",
-        help="per-sequence result journal enabling resume after preemption",
+        help="per-sequence result journal enabling resume after preemption; "
+        "composes with --distributed (the coordinator owns the file and "
+        "broadcasts the resume schedule to every host)",
     )
     p.add_argument(
         "--profile",
@@ -100,7 +102,13 @@ def build_arg_parser() -> argparse.ArgumentParser:
         default=0,
         metavar="N",
         help="retry the scoring phase up to N times on transient device "
-        "failure (combine with --journal to resume mid-batch)",
+        "failure (combine with --journal to resume mid-batch); under "
+        "--distributed every host runs the same retry loop, so a "
+        "job-wide transient failure (the SPMD norm) re-enters the "
+        "collectives in lockstep; a failure confined to a single host "
+        "desynchronises the schedules and is torn down by the "
+        "jax.distributed coordination timeout — rerun with --journal to "
+        "resume",
     )
     p.add_argument(
         "--stream",
@@ -111,7 +119,9 @@ def build_arg_parser() -> argparse.ArgumentParser:
         "overlapping host parsing with asynchronous device compute; live "
         "host memory is bounded by CHUNK plus one buffered output line "
         "per result; byte-identical output, flushed after the whole "
-        "stream succeeds (fail-stop: no partial results)",
+        "stream succeeds (fail-stop: no partial results); under "
+        "--distributed the coordinator broadcasts each chunk so every "
+        "host's memory stays bounded",
     )
     return p
 
@@ -216,7 +226,51 @@ def _build_sharding(mesh_arg: str | None):
     )
 
 
-def _run_streaming(args, timer: PhaseTimer) -> int:
+def _make_scorer(args, distributed_active: bool) -> AlignmentScorer:
+    """Build the scorer with the shared sharding-default policy: a
+    distributed run without an explicit --mesh gets the global batch mesh
+    (otherwise every host would redo the full batch — MPI_Scatter
+    semantics, main.c:174)."""
+    sharding = _build_sharding(args.mesh)
+    if sharding is None and distributed_active:
+
+        def _imp_default():
+            from ..parallel.sharding import BatchSharding
+
+            return BatchSharding
+
+        sharding = _feature_import(
+            "--distributed batch sharding", _imp_default
+        ).over_devices(None)
+    return AlignmentScorer(backend=args.backend, sharding=sharding)
+
+
+def _run_streaming_worker(args, timer: PhaseTimer, dist) -> int:
+    """Worker-side --stream --distributed loop: receive the broadcast
+    stream header, then score every broadcast chunk inside the same
+    collective schedule as the coordinator, until the end sentinel.
+    Workers parse nothing, journal nothing, and print nothing."""
+    with timer.phase("setup"):
+        scorer = _make_scorer(args, True)
+    weights, seq1_codes, _ = dist.broadcast_stream_meta(None)
+    with timer.phase("stream"):
+        while True:
+            codes = dist.broadcast_chunk(None)
+            if codes is None:
+                break
+            if codes:
+                _retrying(
+                    lambda: scorer.score_codes(seq1_codes, codes, weights),
+                    args.retries,
+                    "chunk scoring",
+                )
+    timer.report()
+    return 0
+
+
+def _run_streaming(
+    args, timer: PhaseTimer, dist=None, coordinator=True, out_stream=None
+) -> int:
     """The --stream pipeline: parse and score CHUNK sequences at a time
     with one chunk in flight on the device.
 
@@ -232,6 +286,14 @@ def _run_streaming(args, timer: PhaseTimer) -> int:
     memory: the header fingerprints (weights, Seq1, N) and every record
     carries a per-sequence content hash, so a preempted run rescores only
     the sequences the journal has no (hash-matching) entry for.
+
+    With --distributed, only the coordinator reads stdin: it broadcasts
+    the stream header once and then each (journal-reduced) chunk before
+    dispatching it, so every host scores the identical chunk inside the
+    same collectives while keeping host memory bounded on all of them;
+    workers run :func:`_run_streaming_worker`.  Any coordinator-side
+    failure mid-stream broadcasts an abort so workers exit instead of
+    blocking on the next chunk.
     """
     import contextlib
     import io
@@ -240,35 +302,66 @@ def _run_streaming(args, timer: PhaseTimer) -> int:
 
     from .parse import open_input, parse_stream_header
 
+    multi = dist is not None and dist.process_count() > 1
+    if multi and not coordinator:
+        return _run_streaming_worker(args, timer, dist)
+
     with timer.phase("setup"):
-        sharding = _build_sharding(args.mesh)
-        scorer = AlignmentScorer(backend=args.backend, sharding=sharding)
+        scorer = _make_scorer(args, dist is not None)
 
     all_results = [] if args.json else None
     lines = io.StringIO()
 
-    with open_input(args.input) as stream:
+    # Every coordinator-side failure window must broadcast an abort at the
+    # collective the workers are currently blocked on, or they hang until
+    # the coordination-service timeout instead of failing promptly:
+    # before/at the header parse -> workers wait in broadcast_stream_meta;
+    # after it (journal load, chunk loop) -> they wait in broadcast_chunk.
+    try:
+        stream_cm = open_input(args.input)
+    except Exception:
+        if multi:
+            dist.broadcast_stream_meta(None, failed=True)
+        raise
+    with stream_cm as stream:
         with timer.phase("parse_header"):
-            header = parse_stream_header(stream)
+            try:
+                header = parse_stream_header(stream)
+            except Exception:
+                if multi:
+                    dist.broadcast_stream_meta(None, failed=True)
+                raise
+        if multi:
+            dist.broadcast_stream_meta(
+                (header.weights, header.seq1_codes, header.num_seq2)
+            )
         journal, seq_hash, mismatch_error, done = None, None, None, {}
         if args.journal:
+            try:
 
-            def _imp():
-                from ..utils.journal import (
-                    JournalMismatchError,
-                    StreamJournal,
-                    seq_hash,
+                def _imp():
+                    from ..utils.journal import (
+                        JournalMismatchError,
+                        StreamJournal,
+                        seq_hash,
+                    )
+
+                    return StreamJournal, seq_hash, JournalMismatchError
+
+                StreamJournal, seq_hash, mismatch_error = _feature_import(
+                    "--journal resume", _imp
                 )
-
-                return StreamJournal, seq_hash, JournalMismatchError
-
-            StreamJournal, seq_hash, mismatch_error = _feature_import(
-                "--journal resume", _imp
-            )
-            journal = StreamJournal(
-                args.journal, header.weights, header.seq1_codes, header.num_seq2
-            )
-            done = journal.load()
+                journal = StreamJournal(
+                    args.journal,
+                    header.weights,
+                    header.seq1_codes,
+                    header.num_seq2,
+                )
+                done = journal.load()
+            except BaseException:
+                if multi:
+                    dist.broadcast_chunk(None, failed=True)
+                raise
 
         def _submit(start, codes):
             """Dispatch a chunk; returns (promise, start, codes, pend, rows,
@@ -279,6 +372,10 @@ def _run_streaming(args, timer: PhaseTimer) -> int:
             get args.retries retries, like the batch path."""
             budget = [0]
             if journal is None:
+                if multi:
+                    # Workers must see the identical chunk before the
+                    # sharded dispatch's collectives.
+                    dist.broadcast_chunk(codes)
                 promise = _retrying(
                     lambda: scorer.score_codes_async(
                         header.seq1_codes, codes, header.weights
@@ -304,6 +401,11 @@ def _run_streaming(args, timer: PhaseTimer) -> int:
                 else:
                     pend.append(j)
             promise = None
+            if multi:
+                # The journal-REDUCED chunk is the schedule: broadcast it
+                # even when empty so the workers' chunk loop stays in
+                # lockstep (they skip scoring an empty chunk, as here).
+                dist.broadcast_chunk([codes[j] for j in pend])
             if pend:
                 promise = _retrying(
                     lambda: scorer.score_codes_async(
@@ -352,15 +454,25 @@ def _run_streaming(args, timer: PhaseTimer) -> int:
         with timer.phase("stream"), device_trace(args.trace), (
             journal if journal is not None else contextlib.nullcontext()
         ):
-            pending = None
-            for start, codes in header.iter_chunks(args.stream):
-                cur = _submit(start, codes)
+            try:
+                pending = None
+                for start, codes in header.iter_chunks(args.stream):
+                    cur = _submit(start, codes)
+                    if pending is not None:
+                        _finish(*pending)
+                    pending = cur
                 if pending is not None:
                     _finish(*pending)
-                pending = cur
-            if pending is not None:
-                _finish(*pending)
-    sys.stdout.write(lines.getvalue())
+            except BaseException:
+                if multi:
+                    # Any coordinator-side failure (parse, journal
+                    # mismatch, scoring) must release workers blocked on
+                    # the next chunk broadcast — whole-job fail-stop.
+                    dist.broadcast_chunk(None, failed=True)
+                raise
+            if multi:
+                dist.broadcast_chunk(None, end=True)
+    (out_stream or sys.stdout).write(lines.getvalue())
     if args.json:
         write_json_sidecar(
             all_results, args.json, meta={"backend": scorer.backend}
@@ -389,15 +501,6 @@ def run(argv: list[str] | None = None) -> int:
                 return True
         return False
 
-    if args.distributed and _reject_combos("--distributed", (
-        ("--journal", args.journal, "resume would desynchronise the "
-         "hosts' collective schedules"),
-        ("--retries", args.retries, "a retry loop on one host would "
-         "rerun collectives the other hosts never re-enter"),
-        ("--stream", args.stream, "only the coordinator reads stdin; "
-         "the problem broadcast is whole-batch"),
-    )):
-        return 1
     if args.stream and _reject_combos("--stream", (
         ("--selfcheck", args.selfcheck, "selfcheck re-verifies against "
          "the fully-materialised problem"),
@@ -419,9 +522,8 @@ def run(argv: list[str] | None = None) -> int:
                 raise
 
     try:
-        if args.stream:
-            return _run_streaming(args, timer)
         coordinator = True
+        dist = None
         if args.distributed:
             # Collective backends may write banners straight to fd 1 from
             # C++ (Gloo does on CPU); guard the byte-exact result stream
@@ -440,6 +542,16 @@ def run(argv: list[str] | None = None) -> int:
                 dist = _feature_import("--distributed multi-host init", _imp)
                 dist.initialize_distributed()
                 coordinator = dist.is_coordinator()
+        if args.stream:
+            code = _run_streaming(
+                args,
+                timer,
+                dist=dist,
+                coordinator=coordinator,
+                out_stream=out_stream,
+            )
+            _close_guard(suppress=False)
+            return code
         with timer.phase("parse"):
             # Only the coordinator touches stdin (reference ROOT semantics);
             # workers receive the parsed problem via broadcast.
@@ -456,21 +568,8 @@ def run(argv: list[str] | None = None) -> int:
             if args.distributed:
                 problem = dist.broadcast_problem(problem)
         with timer.phase("setup"):
-            sharding = _build_sharding(args.mesh)
-            if sharding is None and args.distributed:
-                # Distributed without an explicit mesh would make every host
-                # redo the full batch; default to the global mesh so the
-                # work actually splits (the MPI_Scatter semantics).
-                def _imp_default():
-                    from ..parallel.sharding import BatchSharding
-
-                    return BatchSharding
-
-                sharding = _feature_import(
-                    "--distributed batch sharding", _imp_default
-                ).over_devices(None)
-            scorer = AlignmentScorer(backend=args.backend, sharding=sharding)
-        journal = None
+            scorer = _make_scorer(args, args.distributed)
+        journal, done = None, None
         if args.journal:
 
             def _imp():
@@ -479,17 +578,41 @@ def run(argv: list[str] | None = None) -> int:
                 return ResultJournal
 
             journal = _feature_import("--journal resume", _imp)(args.journal)
+            if args.distributed and dist.process_count() > 1:
+                # Resume composes with multi-host by making the reduced
+                # schedule a broadcast fact: the coordinator loads its
+                # journal's done-set and every host derives the identical
+                # pending list + chunking, so the collective schedules
+                # cannot diverge.  Only the coordinator touches the file.
+                if coordinator:
+                    try:
+                        done = journal.load_done(problem)
+                    except Exception:
+                        dist.broadcast_index_set(None, failed=True)
+                        raise
+                    dist.broadcast_index_set(sorted(done))
+                else:
+                    done = {
+                        int(i): None for i in dist.broadcast_index_set(None)
+                    }
 
         def _score_once():
             if journal is not None:
-                return journal.score_with_resume(scorer, problem)
+                # Workers run the identical reduced schedule without
+                # touching any journal file (record=False).
+                return journal.score_with_resume(
+                    scorer, problem, done=done, record=coordinator
+                )
             return scorer.score_codes(
                 problem.seq1_codes, problem.seq2_codes, problem.weights
             )
 
         with timer.phase("score"), device_trace(args.trace):
             results = _retrying(_score_once, args.retries, "scoring")
-        if args.selfcheck:
+        # Coordinator-only: one host's oracle re-verification suffices,
+        # and under --journal workers hold schedule placeholders (zeros)
+        # for resumed rows, not results.
+        if args.selfcheck and coordinator:
             with timer.phase("selfcheck"):
 
                 def _imp_check():

@@ -68,12 +68,15 @@ def score_strided(
     mat2: bytes,
     weights: tuple,
     backend: str,
-    mesh: int,
+    mesh: str | int,
 ) -> bytes:
     """Score a staged fixed-stride batch; returns rows*3 int32 as bytes.
 
-    ``mesh > 0`` shards the batch over that many devices (the MPI_Scatter
-    tier, dissolved into jax.sharding); ``mesh == 0`` runs single-device.
+    ``mesh`` is the CLI's full --mesh grammar ('N'/'batch:N' data
+    parallel, 'seq:N' Seq1 ring-sharded, 'DxS' 2-D dp x sp), parsed by
+    the same parser so the 4-function native ABI reaches every
+    parallelism tier the framework has; '' or '0' (or 0 — the r1 integer
+    form) runs single-device.
     """
     apply_platform_override()
     if rows <= 0:
@@ -90,11 +93,13 @@ def score_strided(
     val = value_table_from_levels(
         np.frombuffer(mat1, dtype=np.int8), np.frombuffer(mat2, dtype=np.int8), weights
     )
-    sharding = None
-    if mesh > 0:
-        from .parallel.sharding import BatchSharding
+    mesh = str(mesh)
+    if mesh in ("", "0"):
+        sharding = None
+    else:
+        from .io.cli import _build_sharding
 
-        sharding = BatchSharding.over_devices(mesh)
+        sharding = _build_sharding(mesh)
     scorer = AlignmentScorer(backend=backend, sharding=sharding)
     out = scorer.score_codes(seq1_codes, seq2_codes, list(weights), val_table=val)
     return np.ascontiguousarray(out, dtype="<i4").tobytes()

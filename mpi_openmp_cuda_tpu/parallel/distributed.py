@@ -147,3 +147,126 @@ def broadcast_from_coordinator(tree):
     from jax.experimental import multihost_utils
 
     return multihost_utils.broadcast_one_to_all(tree)
+
+
+def _bcast(arr):
+    import numpy as np
+    from jax.experimental import multihost_utils
+
+    return np.asarray(multihost_utils.broadcast_one_to_all(arr))
+
+
+def broadcast_index_set(indices=None, *, failed: bool = False):
+    """Two-phase broadcast of an int32 index array from the coordinator
+    (workers pass ``None``); returns the array on every process.
+
+    The --journal x --distributed composition: the coordinator loads the
+    journal's done-set and broadcasts the indices, so every host derives
+    the IDENTICAL reduced scoring schedule — resume must never
+    desynchronise the collective schedules (the r1 static rejection this
+    replaces).  ``failed=True`` (coordinator only) broadcasts an abort
+    header so workers raise instead of hanging in the payload collective
+    when the coordinator's journal load failed.
+    """
+    import jax
+    import numpy as np
+
+    if jax.process_count() == 1:
+        return np.asarray(
+            [] if indices is None else indices, dtype=np.int32
+        )
+    if failed:
+        header = np.array([0, 1], dtype=np.int32)
+    elif indices is not None:
+        header = np.array([len(indices), 0], dtype=np.int32)
+    else:
+        header = np.zeros(2, dtype=np.int32)
+    header = _bcast(header)
+    if int(header[1]):
+        if jax.process_index() == 0:
+            return None  # the real exception is already in flight
+        raise RuntimeError(
+            "coordinator failed while loading the resume journal; aborting"
+        )
+    n = int(header[0])
+    if indices is not None:
+        payload = np.asarray(indices, dtype=np.int32).reshape(n)
+    else:
+        payload = np.zeros(n, dtype=np.int32)
+    return _bcast(payload) if n else payload
+
+
+def broadcast_stream_meta(meta=None, *, failed: bool = False):
+    """Broadcast a --stream run's fixed state (weights, seq1_codes,
+    num_seq2) from the coordinator; workers pass ``None`` and receive the
+    tuple.  ``failed=True`` aborts workers (header parse failed)."""
+    import jax
+    import numpy as np
+
+    if jax.process_count() == 1:
+        return meta
+    if failed:
+        header = np.array([0, 0, 1], dtype=np.int32)
+    elif meta is not None:
+        weights, seq1_codes, num_seq2 = meta
+        header = np.array([len(seq1_codes), num_seq2, 0], dtype=np.int32)
+    else:
+        header = np.zeros(3, dtype=np.int32)
+    header = _bcast(header)
+    if int(header[2]):
+        if jax.process_index() == 0:
+            return None
+        raise RuntimeError(
+            "coordinator failed before broadcasting the stream header; aborting"
+        )
+    l1, n = int(header[0]), int(header[1])
+    if meta is not None:
+        weights = np.asarray(meta[0], dtype=np.int32)
+        seq1 = np.asarray(meta[1], dtype=np.int8)
+    else:
+        weights = np.zeros(4, dtype=np.int32)
+        seq1 = np.zeros(l1, dtype=np.int8)
+    weights, seq1 = (_bcast(a) for a in (weights, seq1))
+    return [int(x) for x in weights], seq1, n
+
+
+def broadcast_chunk(codes=None, *, end: bool = False, failed: bool = False):
+    """Broadcast one streaming chunk's (possibly journal-reduced) code
+    arrays from the coordinator; workers pass ``None``.
+
+    Returns the list of code arrays, or ``None`` when the coordinator
+    signalled ``end=True`` (stream complete).  ``failed=True`` aborts
+    workers mid-stream (parse error / journal mismatch after some chunks
+    already streamed) instead of leaving them blocked on the next chunk.
+    """
+    import jax
+    import numpy as np
+
+    if jax.process_count() == 1:
+        return None if (end or failed) else codes
+    if failed:
+        header = np.array([0, 0, 1, 0], dtype=np.int32)
+    elif end:
+        header = np.array([0, 0, 0, 1], dtype=np.int32)
+    elif codes is not None:
+        maxl = max((c.size for c in codes), default=0)
+        header = np.array([len(codes), maxl, 0, 0], dtype=np.int32)
+    else:
+        header = np.zeros(4, dtype=np.int32)
+    header = _bcast(header)
+    if int(header[2]):
+        if jax.process_index() == 0:
+            return None
+        raise RuntimeError(
+            "coordinator failed mid-stream; aborting"
+        )
+    if int(header[3]):
+        return None  # end of stream
+    n, maxl = int(header[0]), int(header[1])
+    rows = np.zeros((n, maxl), dtype=np.int8)
+    lens = np.zeros(n, dtype=np.int32)
+    for i, c in enumerate(codes or ()):
+        rows[i, : c.size] = c
+        lens[i] = c.size
+    rows, lens = (_bcast(a) for a in (rows, lens))
+    return [rows[i, : int(lens[i])] for i in range(n)]

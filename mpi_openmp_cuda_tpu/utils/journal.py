@@ -250,17 +250,56 @@ class ResultJournal:
         )
 
     # -- the resumable scoring loop ---------------------------------------
-    def score_with_resume(self, scorer, problem) -> np.ndarray:
-        """Score ``problem``, journalling per chunk; returns [B, 3] int32."""
-        fingerprint = problem_fingerprint(problem)
-        done = self._read(fingerprint)
+    def load_done(self, problem) -> dict[int, tuple[int, int, int]]:
+        """Read + validate the on-disk done-map for ``problem`` (empty if
+        no journal exists).  The multi-host coordinator calls this before
+        broadcasting the done indices (parallel.distributed
+        broadcast_index_set) so every host derives the identical reduced
+        schedule."""
+        return self._read(problem_fingerprint(problem))
+
+    def score_with_resume(
+        self, scorer, problem, done=None, record: bool = True
+    ) -> np.ndarray:
+        """Score ``problem``, journalling per chunk; returns [B, 3] int32.
+
+        ``done`` overrides the on-disk done-map (multi-host: every host
+        receives the coordinator's map — or just its key set — so the
+        chunked scoring schedule below is bitwise-identical across hosts;
+        values may be None for hosts that only need the schedule).
+        ``record=False`` runs that identical schedule WITHOUT touching the
+        journal file — worker processes own no journal, they only have to
+        stay inside the same collectives as the coordinator.
+        """
+        if done is None:
+            done = self._read(problem_fingerprint(problem))
         total = len(problem.seq2_codes)
         pending = [i for i in range(total) if i not in done]
 
         results = np.zeros((total, 3), dtype=np.int32)
         for i, row in done.items():
-            if i < total:
+            if i < total and row is not None:
                 results[i] = row
+
+        # ONE chunked loop for both modes: the chunking below IS the
+        # cross-host collective schedule, so coordinator (append) and
+        # workers (append=None) must run literally the same code.
+        def _run(append):
+            for start in range(0, len(pending), self.chunk):
+                idx = pending[start : start + self.chunk]
+                rows = scorer.score_codes(
+                    problem.seq1_codes,
+                    [problem.seq2_codes[i] for i in idx],
+                    problem.weights,
+                )
+                for i, row in zip(idx, rows):
+                    results[i] = row
+                if append is not None:
+                    append(idx, rows)
+
+        if not record:
+            _run(None)
+            return results
 
         fresh = not os.path.exists(self.path) or not done
         mode = "w" if fresh else "a"
@@ -272,7 +311,7 @@ class ResultJournal:
                     json.dumps(
                         {
                             "format": _FORMAT,
-                            "fingerprint": fingerprint,
+                            "fingerprint": problem_fingerprint(problem),
                             "num_seq2": total,
                         }
                     )
@@ -280,14 +319,5 @@ class ResultJournal:
                 )
                 f.flush()
                 os.fsync(f.fileno())
-            for start in range(0, len(pending), self.chunk):
-                idx = pending[start : start + self.chunk]
-                rows = scorer.score_codes(
-                    problem.seq1_codes,
-                    [problem.seq2_codes[i] for i in idx],
-                    problem.weights,
-                )
-                for i, row in zip(idx, rows):
-                    results[i] = row
-                self._append(f, idx, rows)
+            _run(lambda idx, rows: self._append(f, idx, rows))
         return results

