@@ -139,7 +139,11 @@ def steady_state_wall(problem, backend: str, reps: int, medians: int = 1) -> flo
     cb = choose_chunk(batch, DEFAULT_CHUNK_BUDGET)
     bp = round_up(b, cb)
     rows, lens = pad_batch_rows(batch, bp)
-    body = resolve_chunks_body(backend, val)
+    body = resolve_chunks_body(
+        backend,
+        val,
+        problem_dims=(batch.l1p, batch.l2p, batch.len1, batch.len2),
+    )
     args = (
         jnp.asarray(batch.seq1ext),
         jnp.int32(batch.len1),
@@ -292,7 +296,10 @@ def main() -> None:
             choose_pallas_formulation,
             pad_problem,
         )
-        from mpi_openmp_cuda_tpu.ops.pallas_scorer import kernel_mxu_flops
+        from mpi_openmp_cuda_tpu.ops.pallas_scorer import (
+            choose_superblock,
+            kernel_mxu_flops,
+        )
         from mpi_openmp_cuda_tpu.ops.values import value_table
 
         padded = pad_problem(problem.seq1_codes, problem.seq2_codes)
@@ -308,6 +315,13 @@ def main() -> None:
                 padded.l1p,
                 padded.l2p,
                 fm[1],
+                sb=choose_superblock(
+                    padded.l1p // 128,
+                    padded.l2p // 128,
+                    padded.len1,
+                    padded.len2,
+                    fm[1],
+                ),
             )
             real_tflops = flops / wall / 1e12
             record["real_tflops"] = round(real_tflops, 1)

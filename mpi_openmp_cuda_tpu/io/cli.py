@@ -170,60 +170,12 @@ def _feature_import(what: str, importer):
 
 
 def _build_sharding(mesh_arg: str | None):
-    if mesh_arg is None:
-        return None
+    # The grammar lives in parallel.specs (shared with the native ABI's
+    # TPU_SEQALIGN_MESH); this wrapper only exists so the CLI's lazy-import
+    # policy stays local.
+    from ..parallel.specs import build_sharding
 
-    def _imp_batch():
-        from ..parallel.sharding import BatchSharding
-
-        return BatchSharding
-
-    def _imp_ring():
-        from ..parallel.ring import RingSharding
-
-        return RingSharding
-
-    def _bad(detail: str = "") -> ValueError:
-        return ValueError(
-            f"bad --mesh spec {mesh_arg!r}: expected 'N', 'batch:N', "
-            f"'seq:N', or 'DxS'{detail}"
-        )
-
-    def _count(token: str) -> int:
-        try:
-            value = int(token)
-        except ValueError:
-            raise _bad() from None
-        if value < 1:
-            raise _bad(f" (device count must be >= 1, got {value})")
-        return value
-
-    spec = mesh_arg.split(":")
-    if len(spec) == 2:
-        # Explicit axis prefix: anything but 'seq'/'batch' is a spec error,
-        # never a silent fallback to some other parallelism strategy.
-        if spec[0] == "seq":
-            return _feature_import(
-                "--mesh sequence sharding", _imp_ring
-            ).over_devices(seq=_count(spec[1]))
-        if spec[0] == "batch":
-            return _feature_import(
-                "--mesh batch sharding", _imp_batch
-            ).over_devices(_count(spec[1]))
-        raise _bad(f" (unknown axis {spec[0]!r})")
-    if len(spec) != 1:
-        raise _bad()
-    if "x" in spec[0]:
-        tokens = spec[0].split("x")
-        if len(tokens) != 2:
-            raise _bad()
-        dp, sp = (_count(t) for t in tokens)
-        return _feature_import("--mesh 2-D sharding", _imp_ring).over_devices(
-            seq=sp, batch=dp
-        )
-    return _feature_import("--mesh batch sharding", _imp_batch).over_devices(
-        _count(spec[0])
-    )
+    return build_sharding(mesh_arg)
 
 
 def _make_scorer(args, distributed_active: bool) -> AlignmentScorer:
@@ -451,10 +403,16 @@ def _run_streaming(
             if all_results is not None:
                 all_results.extend(out)
 
-        with timer.phase("stream"), device_trace(args.trace), (
-            journal if journal is not None else contextlib.nullcontext()
-        ):
+        with contextlib.ExitStack() as stack:
             try:
+                # Context ENTRY failures (journal file unwritable, bad
+                # --trace dir) are coordinator-side failure windows too:
+                # they must abort workers, so they enter via the stack
+                # inside this guarded block rather than a `with` header.
+                stack.enter_context(timer.phase("stream"))
+                stack.enter_context(device_trace(args.trace))
+                if journal is not None:
+                    stack.enter_context(journal)
                 pending = None
                 for start, codes in header.iter_chunks(args.stream):
                     cur = _submit(start, codes)

@@ -97,9 +97,9 @@ def score_strided(
     if mesh in ("", "0"):
         sharding = None
     else:
-        from .io.cli import _build_sharding
+        from .parallel.specs import build_sharding
 
-        sharding = _build_sharding(mesh)
+        sharding = build_sharding(mesh)
     scorer = AlignmentScorer(backend=backend, sharding=sharding)
     out = scorer.score_codes(seq1_codes, seq2_codes, list(weights), val_table=val)
     return np.ascontiguousarray(out, dtype="<i4").tobytes()

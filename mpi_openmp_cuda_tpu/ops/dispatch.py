@@ -198,17 +198,30 @@ def resolve_xla_formulation(backend: str, val_flat: np.ndarray):
     return score_chunks
 
 
-def resolve_chunks_body(backend: str, val_flat: np.ndarray):
+def resolve_chunks_body(backend: str, val_flat: np.ndarray, problem_dims=None):
     """Unjitted chunked-scorer body for a backend string (bench/shard_map
     composition), including the float32-exactness fallback: a 'pallas'
     request with overflow-risk weights gets the exact int32 gather body —
-    the same routing the production score paths apply."""
+    the same routing the production score paths apply.
+
+    ``problem_dims`` = (l1p, l2p, len1, lens) with CONCRETE lens selects
+    the adaptive super-block width exactly like the production dispatch,
+    so bench measurements time the same program the scorer would run.
+    """
     if backend == "pallas":
         fm = choose_pallas_formulation(val_flat, ())
         if fm[0] == "pallas":
-            from .pallas_scorer import score_chunks_pallas_body
+            from .pallas_scorer import choose_superblock, score_chunks_pallas_body
 
-            return functools.partial(score_chunks_pallas_body, feed=fm[1])
+            sb = None
+            if problem_dims is not None:
+                l1p, l2p, len1, lens = problem_dims
+                sb = choose_superblock(
+                    l1p // 128, l2p // 128, int(len1), lens, fm[1]
+                )
+            return functools.partial(
+                score_chunks_pallas_body, feed=fm[1], sb=sb
+            )
         backend = "xla-gather"
     if xla_formulation_mode(backend, val_flat) == "mm":
         from .matmul_scorer import mm_precision, score_chunks_mm_body
@@ -371,9 +384,16 @@ class AlignmentScorer:
             # batch sizes within one bucket share a single compilation.
             fm = choose_pallas_formulation(val_flat, ())
             if fm[0] == "pallas":
-                from .pallas_scorer import score_chunks_pallas
+                from .pallas_scorer import choose_superblock, score_chunks_pallas
 
-                out = score_chunks_pallas(*args, feed=fm[1])
+                sb = choose_superblock(
+                    batch.l1p // 128,
+                    batch.l2p // 128,
+                    batch.len1,
+                    batch.len2,
+                    fm[1],
+                )
+                out = score_chunks_pallas(*args, feed=fm[1], sb=sb)
             else:
                 from .xla_scorer import score_chunks
 

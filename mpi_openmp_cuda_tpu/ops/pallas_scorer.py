@@ -113,23 +113,78 @@ def mxu_feed(val_flat) -> str:
 
 
 def _superblock(nbn: int) -> int:
-    """Offset blocks processed per inner iteration.  Adjacent offset blocks
-    share all but 128 of their A-band columns, so a wider super-block cuts
-    the one-hot matmul's MACs (band width (SB+1)*128 instead of SB*2*128)
-    and amortises per-iteration overhead.
+    """Static-fallback offset-super-block width (used when the batch's
+    concrete lengths are unavailable — bench tooling, abstract traces).
+    Adjacent offset blocks share all but 128 of their A-band columns, so a
+    wider super-block cuts the one-hot matmul's MACs (band width
+    (SB+1)*128 instead of SB*2*128) and amortises per-iteration overhead.
     Bounded at 12: measured on the real chip, widening 6->12 (input3) and
-    8->12 (max-size synthetic) won 5%/15% — the band sharing and loop
-    amortisation beat the coarser dead-offset skip on realistic length
-    mixes — but a batch dominated by near-Seq1-length sequences pays for
-    every extra always-run block in super-block 0, so unbounded widths
-    trade the skip away entirely."""
+    8->12 (max-size synthetic) won 5%/15%."""
     for cand in (12, 8, 6, 4, 2):
         if nbn % cand == 0:
             return cand
     return 1
 
 
-def kernel_mxu_flops(len1: int, lens2, l1p: int, l2p: int, feed: str) -> int:
+# Adaptive-width cost model, calibrated on the real chip (r2 sb sweeps on
+# input3 / max-size / length-skew synthetics): one loop iteration costs
+# the larger of an affine floor (loop + rotate latency + VPU reductions,
+# growing mildly with the band width: measured 0.72 us at sb=2 ..
+# 0.95 us at sb=12 on the skew sweep) and its MAC issue time at the
+# effective mixed i8/i32 rate.  The model reproduces the measured winner
+# on all three calibration workloads (sb=12, sb=12, sb=2 respectively).
+_ITER_FLOOR_BASE_S = 0.66e-6
+_ITER_FLOOR_PER_SB_S = 0.024e-6
+_MAC_RATE = 160e12  # MACs/s, mixed one-hot i8 + int8 prefix stages
+
+
+def choose_superblock(nbn: int, nbi: int, len1: int, lens, feed: str) -> int:
+    """Adaptive offset-super-block width from the batch's length mix
+    (VERDICT r1 item 4).
+
+    Wide super-blocks amortise per-iteration overhead but compute every
+    offset lane in the block even when the pair's valid range
+    n < len1 - len2 covers almost none of them (a near-Seq1-length batch
+    wastes ~96% of lane work at sb=12; measured 1.3x slower than sb=2).
+    Narrow super-blocks skip dead blocks per pair but pay the iteration
+    floor more often.  Minimise the measured cost model over nbn's
+    divisors; concrete ``lens`` required (dispatch-time decision)."""
+    if feed == "f32":
+        return _superblock(nbn)  # wide=1 path: model not calibrated
+    best_sb, best_cost = None, None
+    for sb in (12, 8, 6, 4, 3, 2):
+        if nbn % sb:
+            continue
+        sbw = sb * _BLK
+        # wide=2: one iteration issues two tiles.
+        per_iter_macs = 2 * (
+            _BLK * _BLK * (sbw + _BLK) + 2 * _BLK * _BLK * sbw
+        )
+        t_iter = max(
+            _ITER_FLOOR_BASE_S + sb * _ITER_FLOOR_PER_SB_S,
+            per_iter_macs / _MAC_RATE,
+        )
+        cost = 0.0
+        for l2 in lens:
+            l2 = int(l2)
+            if l2 <= 0:
+                continue
+            nbi_live = min(-(-l2 // _BLK), nbi)
+            iters = -(-nbi_live // 2)
+            nsb = sum(
+                1
+                for nb in range(0, nbn, sb)
+                if nb == 0 or nb * _BLK < len1 - l2
+            )
+            cost += nsb * iters * t_iter
+        if best_cost is None or cost < best_cost:
+            best_sb, best_cost = sb, cost
+    return best_sb if best_sb is not None else _superblock(nbn)
+
+
+def kernel_mxu_flops(
+    len1: int, lens2, l1p: int, l2p: int, feed: str, sb: int | None = None
+) -> int:
     """MXU FLOPs (2 x MACs) the fused kernel ISSUES for one batch — the
     accounting for bench.py's true-MFU line (VERDICT r1 §1).
 
@@ -143,7 +198,7 @@ def kernel_mxu_flops(len1: int, lens2, l1p: int, l2p: int, feed: str) -> int:
     reformulation, or the MFU line silently lies.
     """
     nbn, nbi = l1p // _BLK, l2p // _BLK
-    sb = _superblock(nbn)
+    sb = _superblock(nbn) if sb is None else sb
     sbw = sb * _BLK
     prefix_matmuls = 1 if feed == "f32" else 2
     wide = 1 if feed == "f32" else 2
@@ -160,7 +215,7 @@ def kernel_mxu_flops(len1: int, lens2, l1p: int, l2p: int, feed: str) -> int:
     return 2 * total
 
 
-def _kernel(meta_ref, codes_ref, a_ref, out_ref, *, nbn, nbi, feed, pretiled):
+def _kernel(meta_ref, codes_ref, a_ref, out_ref, *, nbn, nbi, feed, pretiled, sb):
     """One grid cell scores one pair across all offset super-blocks and
     reduces it to one best candidate: out lanes [score, n, k, eq] (f32;
     eq = the positional k=0 score at offset 0, for the equal-length path
@@ -190,7 +245,6 @@ def _kernel(meta_ref, codes_ref, a_ref, out_ref, *, nbn, nbi, feed, pretiled):
     # wider (ring long-context) buckets keep the unpacked path.
     packed = feed == "i8" and nbi * _BLK <= 2048
     _KB = 4096
-    sb = _superblock(nbn)
     sbw = sb * _BLK  # offset lanes per super-block
 
     ri1 = lax.broadcasted_iota(jnp.int32, (_BLK, _BLK), 0)
@@ -438,8 +492,7 @@ def _kernel(meta_ref, codes_ref, a_ref, out_ref, *, nbn, nbi, feed, pretiled):
 _PRETILE_BUDGET_BYTES = 8 << 20
 
 
-def _pretile_ok(nbn: int, nbi: int, feed: str) -> bool:
-    sb = _superblock(nbn)
+def _pretile_ok(nbn: int, nbi: int, feed: str, sb: int) -> bool:
     slots = (nbn // sb) * nbi
     bandw = sb * _BLK + _BLK
     itemsize = 1 if feed == "i8" else 2 if feed == "bf16" else 4
@@ -448,13 +501,12 @@ def _pretile_ok(nbn: int, nbi: int, feed: str) -> bool:
 
 @functools.lru_cache(maxsize=32)
 def _pallas_call(
-    nbn: int, nbi: int, wneed: int, b: int, interpret: bool, feed: str
+    nbn: int, nbi: int, wneed: int, b: int, interpret: bool, feed: str, sb: int
 ):
-    pretiled = _pretile_ok(nbn, nbi, feed)
+    pretiled = _pretile_ok(nbn, nbi, feed, sb)
     kernel = functools.partial(
-        _kernel, nbn=nbn, nbi=nbi, feed=feed, pretiled=pretiled
+        _kernel, nbn=nbn, nbi=nbi, feed=feed, pretiled=pretiled, sb=sb
     )
-    sb = _superblock(nbn)
     slots = (nbn // sb) * nbi
     bandw = sb * _BLK + _BLK
     a_spec = (
@@ -482,7 +534,7 @@ def _pallas_call(
     )
 
 
-def _pallas_best(seq1ext, len1, rows, lens, val_flat, feed="f32"):
+def _pallas_best(seq1ext, len1, rows, lens, val_flat, feed="f32", sb=None):
     """Run the fused kernel; returns per-pair best candidates
     ``(score, n, k, eq)``, each ``[B]`` (score/eq float32, n/k int32).
 
@@ -492,11 +544,13 @@ def _pallas_best(seq1ext, len1, rows, lens, val_flat, feed="f32"):
     the positional k=0 score at offset 0 (the equal-length fast path and
     the ring combine's device-0 capture).  Offset validity is the caller's
     ``len1`` view — the ring path passes a block-local effective len1, so
-    ``n`` is block-local there."""
+    ``n`` is block-local there.  ``sb`` is the offset-super-block width
+    (choose_superblock at dispatch; None = the static policy)."""
     b, l2p = rows.shape
     w = seq1ext.shape[0] - l2p - 1  # == L1P (offset-axis extent)
     nbn, nbi = w // _BLK, l2p // _BLK
     wneed = w + l2p  # A columns reachable by n0 + i0 + sbw + 127
+    sb = _superblock(nbn) if sb is None else sb
 
     a_t = _FEED_DTYPES[feed]
     val27 = val_flat.reshape(ALPHABET_SIZE, ALPHABET_SIZE).astype(jnp.float32)
@@ -527,8 +581,7 @@ def _pallas_best(seq1ext, len1, rows, lens, val_flat, feed="f32"):
     # (cheap sublane addressing); a dynamic-start lane slice of the flat
     # array costs a cross-lane shift copy of the whole band per tile.
     # Slices overlap, so A3 is ~bandw/128 times the flat array.
-    if _pretile_ok(nbn, nbi, feed):
-        sb = _superblock(nbn)
+    if _pretile_ok(nbn, nbi, feed, sb):
         sbw = sb * _BLK
         bandw = sbw + _BLK
         a_in = jnp.stack(
@@ -552,7 +605,7 @@ def _pallas_best(seq1ext, len1, rows, lens, val_flat, feed="f32"):
     # Off-TPU (the 8-virtual-device CPU test mesh) the Mosaic kernel cannot
     # lower; interpret mode runs the same kernel semantics for parity tests.
     interpret = jax.default_backend() != "tpu"
-    out = _pallas_call(nbn, nbi, wneed, b, interpret, feed)(
+    out = _pallas_call(nbn, nbi, wneed, b, interpret, feed, sb)(
         meta, codes, a_in
     )[0][:, 0, :]
     return (
@@ -563,10 +616,10 @@ def _pallas_best(seq1ext, len1, rows, lens, val_flat, feed="f32"):
     )
 
 
-def _pallas_rows(seq1ext, len1, rows, lens, val_flat, feed="f32"):
+def _pallas_rows(seq1ext, len1, rows, lens, val_flat, feed="f32", sb=None):
     """Score a [B, L2P] padded batch with the fused kernel; returns [B, 3]."""
     best, bn, bk, eq = _pallas_best(
-        seq1ext, len1, rows, lens, val_flat, feed=feed
+        seq1ext, len1, rows, lens, val_flat, feed=feed, sb=sb
     )
 
     # O(B)-scalar epilogue: equal-length / unsearchable selection (the
@@ -588,7 +641,7 @@ def _shapes_supported(l1p: int, l2p: int) -> bool:
 
 
 def score_chunks_pallas_body(
-    seq1ext, len1, seq2_chunks, len2_chunks, val_flat, *, feed="f32"
+    seq1ext, len1, seq2_chunks, len2_chunks, val_flat, *, feed="f32", sb=None
 ):
     """Chunked-batch entry, same contract as the XLA bodies:
     [NC, CB, L2P] -> [NC, CB, 3].  Falls back to the XLA matmul body for
@@ -617,15 +670,18 @@ def score_chunks_pallas_body(
         len2_chunks.reshape(nc * cb),
         val_flat,
         feed=feed,
+        sb=sb,
     )
     return out.reshape(nc, cb, 3)
 
 
-score_chunks_pallas = jax.jit(score_chunks_pallas_body, static_argnames=("feed",))
+score_chunks_pallas = jax.jit(
+    score_chunks_pallas_body, static_argnames=("feed", "sb")
+)
 
 
 @functools.lru_cache(maxsize=32)
-def pallas_pair_scorer(l1p: int, l2p: int, feed: str = "f32"):
+def pallas_pair_scorer(l1p: int, l2p: int, feed: str = "f32", sb: int | None = None):
     """Per-shard callable for the shard_map path: (seq1ext, len1,
     rows [BL, L2P], lens [BL], val_flat) -> [BL, 3].  Cached by shape
     bucket so the shard_map jit cache stays hot."""
@@ -643,6 +699,8 @@ def pallas_pair_scorer(l1p: int, l2p: int, feed: str = "f32"):
                 val_flat,
                 mm_precision=lax.Precision.HIGHEST if feed == "f32" else None,
             ).reshape(bl, 3)
-        return _pallas_rows(seq1ext, len1, rows, lens, val_flat, feed=feed)
+        return _pallas_rows(
+            seq1ext, len1, rows, lens, val_flat, feed=feed, sb=sb
+        )
 
     return fn

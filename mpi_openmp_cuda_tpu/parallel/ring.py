@@ -104,6 +104,16 @@ class RingSharding:
         # Per-device offset-block size: sublane-aligned so the grid tiles
         # (full 128-lane alignment for the Pallas kernel).
         bs = round_up(math.ceil(batch.l1p / sp), 128 if mode[0] == "pallas" else 8)
+        if mode[0] == "pallas":
+            from ..ops.pallas_scorer import choose_superblock
+
+            # One sb for every shard (same compiled SPMD program); model
+            # it with a fully-valid shard window (len1 = bs) — the ring
+            # exists for wide valid ranges, and every host derives the
+            # same value from the same broadcast lens.
+            mode = (*mode, choose_superblock(
+                bs // 128, batch.l2p // 128, bs, batch.len2, mode[1]
+            ))
 
         seq1pad = np.zeros(sp * bs, dtype=np.int32)
         take = min(seq1pad.size, batch.seq1ext.size)
@@ -134,7 +144,7 @@ class RingSharding:
 @functools.lru_cache(maxsize=32)
 def _ring_fn(mesh, bs, l2p, cb, mode: tuple = ("gather",)):
     """Jitted shard_map ring scorer for one (mesh, Bs, L2P, chunk,
-    formulation) config.  ``mode`` is ('gather',) or ('pallas', feed)."""
+    formulation) config.  ``mode`` is ('gather',) or ('pallas', feed, sb)."""
     import jax
     import jax.numpy as jnp
     from jax import lax
@@ -170,7 +180,8 @@ def _ring_fn(mesh, bs, l2p, cb, mode: tuple = ("gather",)):
             win_k = win[: bs + l2p + 1]
             len1_eff = len1 - d * bs
             bv, bi, bk, eq = _pallas_best(
-                win_k, len1_eff, rows, lens, val_flat, feed=mode[1]
+                win_k, len1_eff, rows, lens, val_flat, feed=mode[1],
+                sb=mode[2],
             )
             # All-invalid shards carry the kernel's f32 sentinel, far
             # below int32 range: map to INT32_MIN before the int cast.

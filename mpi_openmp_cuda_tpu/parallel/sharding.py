@@ -87,7 +87,18 @@ class BatchSharding:
             # fallback, so no dims are pinned here.
             fm = choose_pallas_formulation(val_flat, ())
             if fm[0] == "pallas":
-                mode = ("pallas", batch.l1p, batch.l2p, fm[1])
+                from ..ops.pallas_scorer import choose_superblock
+
+                # Every host derives sb from the same broadcast problem,
+                # so the compiled SPMD programs agree.
+                sb = choose_superblock(
+                    batch.l1p // 128,
+                    batch.l2p // 128,
+                    batch.len1,
+                    batch.len2,
+                    fm[1],
+                )
+                mode = ("pallas", batch.l1p, batch.l2p, fm[1], sb)
             else:
                 # Same float32 bound as the matmul path: route to int32.
                 mode = ("gather",)
@@ -136,7 +147,7 @@ def _sharded_fn(mesh, cb, mode: tuple):
     if mode[0] == "pallas":
         from ..ops.pallas_scorer import pallas_pair_scorer
 
-        pair_like = pallas_pair_scorer(mode[1], mode[2], mode[3])
+        pair_like = pallas_pair_scorer(mode[1], mode[2], mode[3], mode[4])
         chunks_body = None
     elif mode[0] == "mm":
         from ..ops.matmul_scorer import score_chunks_mm_body

@@ -54,6 +54,7 @@ def main() -> int:
         round_up,
     )
     from mpi_openmp_cuda_tpu.ops.pallas_scorer import (
+        choose_superblock,
         kernel_mxu_flops,
         mxu_feed,
         score_chunks_pallas_body,
@@ -78,6 +79,9 @@ def main() -> int:
     batch = pad_problem(seq1_codes, seq2_codes, enforce_caps=False)
     val = value_table(weights).astype(np.int32).reshape(-1)
     feed = args.feed or mxu_feed(val)
+    sb = choose_superblock(
+        batch.l1p // 128, batch.l2p // 128, batch.len1, batch.len2, feed
+    )
     b = batch.batch_size
     cb = choose_chunk(batch, DEFAULT_CHUNK_BUDGET)
     bp = round_up(b, cb)
@@ -96,7 +100,7 @@ def main() -> int:
                 r = jnp.roll(rows, i, axis=1)
                 l = jnp.roll(lens, i, axis=1)
                 out = score_chunks_pallas_body(
-                    seq1ext, len1, r, l, val_flat, feed=feed
+                    seq1ext, len1, r, l, val_flat, feed=feed, sb=sb
                 )
                 return carry + out.sum(), None
 
@@ -117,9 +121,11 @@ def main() -> int:
     wall = slopes[1]  # median
     lens2 = [c.size for c in seq2_codes]
     elems = brute_force_elements(int(seq1_codes.size), lens2)
-    flops = kernel_mxu_flops(batch.len1, lens2, batch.l1p, batch.l2p, feed)
+    flops = kernel_mxu_flops(
+        batch.len1, lens2, batch.l1p, batch.l2p, feed, sb=sb
+    )
     print(
-        f"{name} feed={feed} l1p={batch.l1p} l2p={batch.l2p} b={b} "
+        f"{name} feed={feed} sb={sb} l1p={batch.l1p} l2p={batch.l2p} b={b} "
         f"device={jax.devices()[0].device_kind}"
     )
     print(

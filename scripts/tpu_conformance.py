@@ -151,7 +151,58 @@ def main() -> int:
     if failures:
         print(f"tpu_conformance: {failures} FAILURES", file=sys.stderr)
         return 1
+    if os.environ.get("TPU_CONFORMANCE_SKIP_PERF") != "1":
+        rc = perf_floor()
+        if rc:
+            return rc
     print("tpu_conformance: all regimes bit-exact on device", file=sys.stderr)
+    return 0
+
+
+# A kernel regression must fail a command the round already runs, not
+# surface as a quiet BENCH delta (VERDICT r1 item 5).  Floor chosen well
+# under the measured 2.5-3.5e13 band so co-tenant load on the shared chip
+# doesn't false-alarm; raise it as the kernel improves.
+INPUT3_FLOOR_ELEMS_PER_SEC = 2.0e13
+
+
+def perf_floor() -> int:
+    """Steady-state input3 throughput floor (skipped off-reference-tree or
+    when the MXU probe says the chip is under external load)."""
+    import bench
+
+    path = "/root/reference/input3.txt"
+    if not os.path.exists(path):
+        print("perf floor: input3.txt not mounted; skipping", file=sys.stderr)
+        return 0
+    probe = bench.mxu_probe_tflops()
+    if probe < 100:
+        # The probe's own roofline is ~200 TFLOP/s on a quiet v5e; far
+        # below that the chip is shared with a heavy co-tenant and any
+        # framework number would blame the kernel for foreign load.
+        print(
+            f"perf floor: MXU probe {probe:.0f} TFLOP/s < 100 — chip under "
+            "external load; skipping the floor check (re-run later)",
+            file=sys.stderr,
+        )
+        return 0
+    from mpi_openmp_cuda_tpu.io.parse import load_problem
+
+    problem = load_problem(path)
+    wall = bench.steady_state_wall(problem, "pallas", reps=512, medians=1)
+    elems = bench.brute_force_elements(
+        problem.seq1_codes.size, [c.size for c in problem.seq2_codes]
+    )
+    rate = elems / wall
+    status = "OK  " if rate >= INPUT3_FLOOR_ELEMS_PER_SEC else "FAIL"
+    print(
+        f"{status} perf floor: input3 {rate:.2e} elem/s "
+        f"(floor {INPUT3_FLOOR_ELEMS_PER_SEC:.1e}; probe {probe:.0f} TFLOP/s)",
+        file=sys.stderr,
+    )
+    if rate < INPUT3_FLOOR_ELEMS_PER_SEC:
+        print("tpu_conformance: perf floor FAILED", file=sys.stderr)
+        return 1
     return 0
 
 

@@ -196,3 +196,44 @@ def test_pallas_sharded_matches_local():
         "pallas", sharding=BatchSharding.over_devices(8)
     ).score_codes(seq1, seqs, W)
     assert (local == shard).all()
+
+
+def test_choose_superblock_regimes():
+    """The adaptive width picks the measured winner per regime (r2 sb
+    sweeps): wide blocks for wide valid-offset ranges, narrow blocks for
+    near-Seq1-length batches, static policy on the f32 (wide=1) feed."""
+    from mpi_openmp_cuda_tpu.ops.pallas_scorer import (
+        _superblock,
+        choose_superblock,
+    )
+
+    rng = np.random.default_rng(0)
+    wide_mix = [int(x) for x in rng.integers(56, 1153, size=32)]
+    assert choose_superblock(12, 9, 1489, wide_mix, "i8") == 12
+    skew = [1480] * 64
+    assert choose_superblock(12, 12, 1489, skew, "i8") == 2
+    assert choose_superblock(4, 4, 450, [445] * 8, "i8") == 2
+    # f32 keeps the static policy (wide=1 loop, model not calibrated).
+    assert choose_superblock(12, 12, 1489, skew, "f32") == _superblock(12)
+    # Degenerate: no candidate divides a prime nbn -> static fallback.
+    assert choose_superblock(7, 2, 800, [100], "i8") == _superblock(7)
+
+
+def test_adaptive_superblock_skew_parity():
+    """A near-Seq1-length batch routes through a non-default super-block
+    (sb=2 at nbn=4) via the production dispatch and stays oracle-exact —
+    the adaptive width must never trade correctness."""
+    from mpi_openmp_cuda_tpu.ops.pallas_scorer import choose_superblock
+
+    rng = np.random.default_rng(33)
+    seq1 = rng.integers(1, 27, size=450).astype(np.int8)
+    seqs = [
+        rng.integers(1, 27, size=int(n)).astype(np.int8)
+        for n in (445, 448, 430, 449)
+    ]
+    assert (
+        choose_superblock(4, 4, 450, [s.size for s in seqs], "i8") == 2
+    ), "fixture no longer exercises a non-default width; adjust sizes"
+    got = _score(seq1, seqs, W)
+    for row, s in zip(got, seqs):
+        assert tuple(int(x) for x in row) == prefix_best(seq1, s, W)
