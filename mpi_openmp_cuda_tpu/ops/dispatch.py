@@ -30,6 +30,10 @@ _LANE = 128
 # (~64 MiB of int32 at the default). Tunable via AlignmentScorer.
 DEFAULT_CHUNK_BUDGET = 16 * 1024 * 1024
 
+# Length buckets smaller than this merge into the next wider bucket:
+# below it, a separate compilation + dispatch costs more than padding.
+MIN_BUCKET_ROWS = 8
+
 
 def round_up(x: int, mult: int) -> int:
     return max(mult, mult * math.ceil(x / mult))
@@ -264,6 +268,27 @@ class PendingResult:
         return np.asarray(self.raw).reshape(-1, 3)[: self.count]
 
 
+@dataclass(frozen=True)
+class BucketedPending:
+    """Pending results of a length-bucketed dispatch (input order restored
+    on materialisation).  All buckets are dispatched before any is forced,
+    so they queue on the device back to back; one batched device_get
+    fetches every part in a single host round trip (per-part .result()
+    would pay the tunnel latency once per bucket)."""
+
+    parts: list  # [(row_indices, PendingResult)]
+    count: int
+
+    def result(self) -> np.ndarray:
+        import jax
+
+        raws = jax.device_get([pend.raw for _, pend in self.parts])
+        out = np.zeros((self.count, 3), dtype=np.int32)
+        for (idx, pend), raw in zip(self.parts, raws):
+            out[idx] = np.asarray(raw).reshape(-1, 3)[: pend.count]
+        return out
+
+
 class AlignmentScorer:
     """Front door to the accelerated scoring paths (the C2 offload ABI's
     Python-side equivalent).
@@ -317,13 +342,15 @@ class AlignmentScorer:
         weights,
         *,
         val_table: np.ndarray | None = None,
-    ) -> PendingResult:
+    ) -> "PendingResult | BucketedPending":
         """``score_codes`` without forcing the device->host copy.
 
         The local jitted paths dispatch asynchronously, so the caller can
         overlap host work (e.g. parsing the next input chunk) with device
         compute and call ``.result()`` later; the oracle and sharded paths
         materialise internally and return an already-complete result.
+        Multi-length-bucket batches return a :class:`BucketedPending`
+        (same ``.result()`` contract, input order restored).
         """
         if not seq2_codes:
             return PendingResult(np.zeros((0, 3), dtype=np.int32), 0)
@@ -339,13 +366,6 @@ class AlignmentScorer:
                 score_batch_oracle(seq1_codes, seq2_codes, weights), dtype=np.int32
             )
             return PendingResult(out, out.shape[0])
-        # Sequence-parallel shardings advertise `unbounded`: Seq1 is split
-        # across devices, so the reference's fixed buffer caps don't apply.
-        batch = pad_problem(
-            seq1_codes,
-            seq2_codes,
-            enforce_caps=not getattr(self.sharding, "unbounded", False),
-        )
         if val_table is None:
             val_flat = value_table(weights).astype(np.int32).reshape(-1)
         else:
@@ -354,15 +374,66 @@ class AlignmentScorer:
                 raise ValueError(
                     f"val_table must be [27, 27]; got {val_flat.size} elements"
                 )
-        if self.sharding is not None:
-            out = self.sharding.score(
-                batch,
-                val_flat,
-                backend=self.backend,
-                chunk_budget=self.chunk_budget,
+        if self.sharding is None:
+            # Caps validated on the WHOLE batch first so the error names
+            # the caller's input index (a per-bucket pad_problem would
+            # report a bucket-local one, after earlier buckets already
+            # dispatched).
+            if seq1_codes.size > BUF_SIZE_SEQ1:
+                raise ValueError(
+                    f"Seq1 length {seq1_codes.size} exceeds "
+                    f"BUF_SIZE_SEQ1={BUF_SIZE_SEQ1}"
+                )
+            for i, c in enumerate(seq2_codes):
+                if c.size > BUF_SIZE_SEQ2:
+                    raise ValueError(
+                        f"Seq2[{i}] length {c.size} exceeds "
+                        f"BUF_SIZE_SEQ2={BUF_SIZE_SEQ2}"
+                    )
+            # Length-sorted bucketing (VERDICT r1 item 6, measured to pay
+            # ~10% on a bimodal batch): rows grouped by their L2P shape
+            # bucket dispatch as separate smaller programs — short rows
+            # stop riding max-len-wide buffers (and max-len chunking) —
+            # then scatter back to input order.  Local path only: the
+            # sharded paths own their chunk schedule and a per-bucket
+            # collective schedule would have to be agreed across hosts.
+            groups: dict[int, list[int]] = {}
+            for i, c in enumerate(seq2_codes):
+                groups.setdefault(round_up(max(c.size, 1), _LANE), []).append(i)
+            # Each bucket costs a compilation + dispatch: straggler
+            # buckets merge upward into the next wider one (padding a few
+            # rows is cheaper than another program), so a length-spread
+            # batch cannot fan out into one program per 128-multiple.
+            keys = sorted(groups)
+            for j, k in enumerate(keys[:-1]):
+                if len(groups[k]) < MIN_BUCKET_ROWS:
+                    groups[keys[j + 1]].extend(groups.pop(k))
+            if len(groups) > 1:
+                parts = []
+                for l2p in sorted(groups):
+                    idx = np.asarray(sorted(groups[l2p]), dtype=np.int64)
+                    sub = pad_problem(
+                        seq1_codes, [seq2_codes[i] for i in idx]
+                    )
+                    parts.append((idx, self._score_local(sub, val_flat)))
+                return BucketedPending(parts, len(seq2_codes))
+            return self._score_local(
+                pad_problem(seq1_codes, seq2_codes), val_flat
             )
-            return PendingResult(out, out.shape[0])
-        return self._score_local(batch, val_flat)
+        # Sequence-parallel shardings advertise `unbounded`: Seq1 is split
+        # across devices, so the reference's fixed buffer caps don't apply.
+        batch = pad_problem(
+            seq1_codes,
+            seq2_codes,
+            enforce_caps=not getattr(self.sharding, "unbounded", False),
+        )
+        out = self.sharding.score(
+            batch,
+            val_flat,
+            backend=self.backend,
+            chunk_budget=self.chunk_budget,
+        )
+        return PendingResult(out, out.shape[0])
 
     def _score_local(self, batch: PaddedBatch, val_flat: np.ndarray) -> PendingResult:
         import jax.numpy as jnp

@@ -237,3 +237,57 @@ def test_adaptive_superblock_skew_parity():
     got = _score(seq1, seqs, W)
     for row, s in zip(got, seqs):
         assert tuple(int(x) for x in row) == prefix_best(seq1, s, W)
+
+
+def test_length_bucketed_dispatch_restores_input_order():
+    """A bimodal batch routes through BucketedPending (two shape buckets)
+    and must come back oracle-exact in input order, including interleaved
+    short/long rows, empties and an overlong row."""
+    from mpi_openmp_cuda_tpu.ops.dispatch import BucketedPending
+
+    rng = np.random.default_rng(5)
+    seq1 = rng.integers(1, 27, size=300).astype(np.int8)
+    seqs = []
+    for i in range(20):
+        n = 20 if i % 2 == 0 else 280
+        seqs.append(rng.integers(1, 27, size=n).astype(np.int8))
+    seqs[3] = np.zeros(0, dtype=np.int8)  # empty
+    seqs[7] = rng.integers(1, 27, size=301).astype(np.int8)  # overlong
+    scorer = AlignmentScorer("pallas")
+    pend = scorer.score_codes_async(seq1, seqs, W)
+    assert isinstance(pend, BucketedPending) and len(pend.parts) > 1
+    got = [tuple(int(x) for x in r) for r in pend.result()]
+    from mpi_openmp_cuda_tpu.ops.oracle import score_batch_oracle
+
+    assert got == score_batch_oracle(seq1, seqs, W)
+
+
+def test_straggler_buckets_merge_upward():
+    """Sub-threshold buckets merge into the next wider one (bounded
+    compilation count), and over-cap errors name the true input index
+    even when bucketing would have reordered it."""
+    import pytest
+
+    from mpi_openmp_cuda_tpu.ops.dispatch import (
+        BucketedPending,
+        MIN_BUCKET_ROWS,
+    )
+
+    rng = np.random.default_rng(6)
+    seq1 = rng.integers(1, 27, size=400).astype(np.int8)
+    # One straggler short row + a full bucket of long rows -> ONE program.
+    seqs = [rng.integers(1, 27, size=10).astype(np.int8)] + [
+        rng.integers(1, 27, size=300).astype(np.int8)
+        for _ in range(MIN_BUCKET_ROWS)
+    ]
+    scorer = AlignmentScorer("pallas")
+    pend = scorer.score_codes_async(seq1, seqs, W)
+    assert not isinstance(pend, BucketedPending)
+    from mpi_openmp_cuda_tpu.ops.oracle import score_batch_oracle
+
+    got = [tuple(int(x) for x in r) for r in pend.result()]
+    assert got == score_batch_oracle(seq1, seqs, W)
+
+    big = np.zeros(2001, dtype=np.int8) + 1
+    with pytest.raises(ValueError, match=r"Seq2\[1\] length 2001"):
+        scorer.score_codes(seq1, [seqs[0], big], W)
