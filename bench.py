@@ -136,7 +136,13 @@ def steady_state_wall(problem, backend: str, reps: int, medians: int = 1) -> flo
     batch = pad_problem(problem.seq1_codes, problem.seq2_codes)
     val = value_table(problem.weights).astype(np.int32).reshape(-1)
     b = batch.batch_size
-    cb = choose_chunk(batch, DEFAULT_CHUNK_BUDGET)
+    # Same chunk policy the dispatch layer applies: pallas-sized chunks
+    # only when the kernel actually runs (wide weights route to gather).
+    from mpi_openmp_cuda_tpu.ops.dispatch import effective_backend
+
+    cb = choose_chunk(
+        batch, DEFAULT_CHUNK_BUDGET, backend=effective_backend(backend, val)
+    )
     bp = round_up(b, cb)
     rows, lens = pad_batch_rows(batch, bp)
     body = resolve_chunks_body(

@@ -88,11 +88,31 @@ def pad_problem(
     return PaddedBatch(seq1ext, len1, rows, lens, l1p, l2p)
 
 
-def choose_chunk(batch: PaddedBatch, budget: int) -> int:
-    """Chunk size bounding per-chunk grid memory; power of two for bucketing."""
-    per_pair = batch.l1p * batch.l2p
+# Grid-cell ceiling for one fused-kernel call: far above any real batch
+# chunk, far below anything that could stress the runtime.
+PALLAS_MAX_CHUNK = 512
+
+
+def choose_chunk(batch: PaddedBatch, budget: int, backend: str = "xla") -> int:
+    """Chunk size bounding per-chunk live memory; power of two for
+    bucketing.
+
+    The XLA formulations materialise O(L1P x L2P) intermediates per pair,
+    so their chunk is budget / (l1p*l2p).  The fused Pallas kernel keeps V
+    in VMEM and streams one grid cell per pair — its per-pair HBM is just
+    the codes row + a 128-lane output — so it takes the whole batch in
+    one call (capped): splitting it pays per-call dispatch overhead AND
+    re-DMAs the A bands per call (measured on the max-size config: the
+    old l1p*l2p budget forced cb=2 -> 32 calls x 6.8 MiB of A3 traffic,
+    ~2x the kernel's own wall)."""
+    if backend == "pallas":
+        per_pair = batch.l2p  # codes row; outputs are O(128)
+    else:
+        per_pair = batch.l1p * batch.l2p
     cb = max(1, budget // max(per_pair, 1))
     cb = 1 << (cb.bit_length() - 1)  # floor to power of two
+    if backend == "pallas":
+        cb = min(cb, PALLAS_MAX_CHUNK)
     return min(cb, max(1, 1 << (batch.batch_size - 1).bit_length()))
 
 
@@ -202,6 +222,16 @@ def resolve_xla_formulation(backend: str, val_flat: np.ndarray):
     return score_chunks
 
 
+def effective_backend(backend: str, val_flat: np.ndarray) -> str:
+    """The formulation a backend string actually runs: 'pallas' only when
+    the fused kernel is eligible for these weights; its overflow-risk
+    fallback reports 'xla-gather'.  Single source for consumers that must
+    match the dispatch routing (bench's chunk policy)."""
+    if backend == "pallas" and choose_pallas_formulation(val_flat, ())[0] != "pallas":
+        return "xla-gather"
+    return backend
+
+
 def resolve_chunks_body(backend: str, val_flat: np.ndarray, problem_dims=None):
     """Unjitted chunked-scorer body for a backend string (bench/shard_map
     composition), including the float32-exactness fallback: a 'pallas'
@@ -212,21 +242,20 @@ def resolve_chunks_body(backend: str, val_flat: np.ndarray, problem_dims=None):
     the adaptive super-block width exactly like the production dispatch,
     so bench measurements time the same program the scorer would run.
     """
+    backend = effective_backend(backend, val_flat)
     if backend == "pallas":
         fm = choose_pallas_formulation(val_flat, ())
-        if fm[0] == "pallas":
-            from .pallas_scorer import choose_superblock, score_chunks_pallas_body
+        from .pallas_scorer import choose_superblock, score_chunks_pallas_body
 
-            sb = None
-            if problem_dims is not None:
-                l1p, l2p, len1, lens = problem_dims
-                sb = choose_superblock(
-                    l1p // 128, l2p // 128, int(len1), lens, fm[1]
-                )
-            return functools.partial(
-                score_chunks_pallas_body, feed=fm[1], sb=sb
+        sb = None
+        if problem_dims is not None:
+            l1p, l2p, len1, lens = problem_dims
+            sb = choose_superblock(
+                l1p // 128, l2p // 128, int(len1), lens, fm[1]
             )
-        backend = "xla-gather"
+        return functools.partial(
+            score_chunks_pallas_body, feed=fm[1], sb=sb
+        )
     if xla_formulation_mode(backend, val_flat) == "mm":
         from .matmul_scorer import mm_precision, score_chunks_mm_body
 
@@ -439,7 +468,20 @@ class AlignmentScorer:
         import jax.numpy as jnp
 
         b = batch.batch_size
-        cb = choose_chunk(batch, self.chunk_budget)
+        # The formulation decides the chunk policy: a 'pallas' request
+        # with overflow-risk weights runs the gather body, which needs
+        # the XLA paths' l1p*l2p-sized chunks, not the kernel's.
+        fm = ("gather",)
+        if self.backend == "pallas":
+            # Same eligibility policy as the sharded paths; the chunked
+            # [NC, CB] shape buckets match the bench/sharded programs, so
+            # batch sizes within one bucket share a single compilation.
+            fm = choose_pallas_formulation(val_flat, ())
+        cb = choose_chunk(
+            batch,
+            self.chunk_budget,
+            backend="pallas" if fm[0] == "pallas" else "xla",
+        )
         bp = round_up(b, cb)
         rows, lens = pad_batch_rows(batch, bp)
         args = (
@@ -450,10 +492,6 @@ class AlignmentScorer:
             jnp.asarray(val_flat),
         )
         if self.backend == "pallas":
-            # Same eligibility policy as the sharded paths; the chunked
-            # [NC, CB] shape buckets match the bench/sharded programs, so
-            # batch sizes within one bucket share a single compilation.
-            fm = choose_pallas_formulation(val_flat, ())
             if fm[0] == "pallas":
                 from .pallas_scorer import choose_superblock, score_chunks_pallas
 

@@ -67,6 +67,19 @@ Two workload-adaptive fast paths on top of the baseline kernel:
   integer up to 2^8 is exactly representable in bf16's 8 mantissa bits),
   and float32 partial sums stay below 2^24.  The delta (ltri) matmul
   runs bf16 on both narrow feeds; larger weights keep the f32 kernel.
+
+Explored and rejected (r2, measured/attempted on the real chip — do not
+re-litigate without new Mosaic capabilities):
+
+* an int8 DELTA formulation for |v| <= 63 (lp = ltri @ (d0 - d1) as one
+  int8 matmul + a thin ones-row t1 matmul, ~47% fewer prefix MACs) —
+  Mosaic cannot legalize int8 vector subtraction (`arith.subi` on i8),
+  and routing the subtract through i32/bf16 costs 2-3 extra full-width
+  VPU passes, erasing the saved matmul.  The pa - pb split with the
+  all-ones-row t1 capture is the local optimum under that constraint.
+* casting before the shear — the strided rotate only exists for 32-bit
+  element types ("Rotate with non-32-bit data: not implemented").
+* 4-wide tile interleave — VMEM pressure regresses it ~5% vs 2-wide.
 """
 
 from __future__ import annotations

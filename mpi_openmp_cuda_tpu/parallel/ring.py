@@ -122,7 +122,8 @@ class RingSharding:
         b = batch.batch_size
         # Chunk the per-device batch rows so the [cb, Bs, L2P] grid stays
         # inside the budget (the C14 memory-manager role).
-        cb = choose_chunk_rows(bs * batch.l2p, chunk_budget, -(-b // dp))
+        per_pair = batch.l2p if mode[0] == "pallas" else bs * batch.l2p
+        cb = choose_chunk_rows(per_pair, chunk_budget, -(-b // dp))
         bl = cb * (-(-b // (dp * cb)))
         bp = bl * dp
         rows, lens = pad_batch_rows(batch, bp)

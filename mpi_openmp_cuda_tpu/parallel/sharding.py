@@ -113,7 +113,10 @@ class BatchSharding:
 
         d = self.n_devices
         b = batch.batch_size
-        cb = choose_chunk_rows(batch.l1p * batch.l2p, chunk_budget, -(-b // d))
+        # Pallas mode streams V through VMEM: per-row footprint is the
+        # codes row, not the XLA paths' l1p*l2p intermediates.
+        per_pair = batch.l2p if mode[0] == "pallas" else batch.l1p * batch.l2p
+        cb = choose_chunk_rows(per_pair, chunk_budget, -(-b // d))
         bl = cb * (-(-b // (d * cb)))  # per-device rows, multiple of cb
         bp = bl * d
 

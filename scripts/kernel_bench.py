@@ -83,7 +83,7 @@ def main() -> int:
         batch.l1p // 128, batch.l2p // 128, batch.len1, batch.len2, feed
     )
     b = batch.batch_size
-    cb = choose_chunk(batch, DEFAULT_CHUNK_BUDGET)
+    cb = choose_chunk(batch, DEFAULT_CHUNK_BUDGET, backend="pallas")
     bp = round_up(b, cb)
     rows, lens = pad_batch_rows(batch, bp)
     fargs = (
