@@ -460,20 +460,35 @@ def _kernel(meta_ref, codes_ref, a_ref, out_ref, *, nbn, nbi, feed, pretiled, sb
         # than either matmul stage — almost all of it the un-reverse.
         # Reducing to one best candidate per pair here makes the kernel
         # output O(1) and the epilogue trivial.
+        # All quantities stay [1, 1] VECTORS (keepdims reductions): each
+        # vector->scalar extraction is a scalar-unit round trip that
+        # stalls the vector pipeline, and there are four per super-block.
         svec = (t1 + runmax).astype(jnp.float32)
         kvec = jnp.where(endg == runmax, 0, runkap)  # k=0 wins ties
         # Reversed lanes: lane m holds global offset n = n0 + sbw-1-m.
         nvec = (n0 + sbw - 1) - liw
         sm = jnp.where(nvec < len1 - l2, svec[None, :], _NEG)  # [1, sbw]
-        sbbest = jnp.max(sm)
+        sbbest = jnp.max(sm, axis=1, keepdims=True)  # [1, 1]
         # First-hit tie-break = smallest n = LARGEST reversed lane index.
-        mstar = jnp.max(jnp.where(sm == sbbest, liw, -1))
+        mstar = jnp.max(
+            jnp.where(sm == sbbest, liw, -1), axis=1, keepdims=True
+        )
         nstar = (n0 + sbw - 1) - mstar
-        kstar = jnp.sum(jnp.where(liw == mstar, kvec[None, :], 0))
+        kstar = jnp.sum(
+            jnp.where(liw == mstar, kvec[None, :], 0), axis=1, keepdims=True
+        )
         if nb == 0:
             bscore, bn, bk = sbbest, nstar, kstar
             # Equal-length capture: global n=0 is reversed lane sbw-1.
-            eqv = (t1 + endg).astype(jnp.float32)[sbw - 1]
+            eqv = jnp.sum(
+                jnp.where(
+                    liw == sbw - 1,
+                    (t1 + endg).astype(jnp.float32)[None, :],
+                    0.0,
+                ),
+                axis=1,
+                keepdims=True,
+            )
         else:
             # Strictly-greater keeps the earlier (smaller-n) super-block.
             upd = sbbest > bscore
