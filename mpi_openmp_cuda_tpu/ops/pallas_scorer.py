@@ -20,8 +20,8 @@ delta-formulation pipeline so V never leaves VMEM:
                                                runs in reversed lane
                                                orientation end to end (A
                                                pre-reversed host-side; the
-                                               XLA epilogue un-reverses each
-                                               offset super-block)
+                                               in-kernel argmax maps lanes
+                                               back to offsets)
     block prefix                  narrow feeds: ltri128 @ d0 - ltri128 @ d1
                                   (two bf16 MXU matmuls; the all-ones row
                                   127 of ltri@d1 doubles as the t1 sublane
