@@ -166,9 +166,15 @@ def choose_superblock(nbn: int, nbi: int, len1: int, lens, feed: str) -> int:
     if feed == "f32":
         return _superblock(nbn)  # wide=1 path: model not calibrated
     best_sb, best_cost = None, None
-    for sb in (12, 8, 6, 4, 3, 2):
-        if nbn % sb:
-            continue
+    # Every divisor of nbn in [2, 16], widest first; a prime nbn (13, 17,
+    # 19, 23 -- real Seq1 buckets) has none, so it considers itself (up
+    # to the cap-scale grid bound -- a huge prime ring shard must not
+    # allocate an nbn-wide band) rather than falling to sb=1, whose
+    # per-iteration floor is the slowest measured shape.
+    candidates = [sb for sb in range(min(nbn, 16), 1, -1) if nbn % sb == 0]
+    if not candidates and 1 < nbn <= 24:
+        candidates = [nbn]
+    for sb in candidates:
         sbw = sb * _BLK
         # wide=2: one iteration issues two tiles.
         per_iter_macs = 2 * (

@@ -215,8 +215,16 @@ def test_choose_superblock_regimes():
     assert choose_superblock(4, 4, 450, [445] * 8, "i8") == 2
     # f32 keeps the static policy (wide=1 loop, model not calibrated).
     assert choose_superblock(12, 12, 1489, skew, "f32") == _superblock(12)
-    # Degenerate: no candidate divides a prime nbn -> static fallback.
-    assert choose_superblock(7, 2, 800, [100], "i8") == _superblock(7)
+    # A prime nbn picks itself (no divisor in [2, 16]) rather than
+    # falling to sb=1, the slowest measured shape — including primes
+    # above 16 (real Seq1 buckets 17/19/23).
+    assert choose_superblock(13, 4, 1600, [400] * 16, "i8") == 13
+    assert choose_superblock(7, 2, 800, [100], "i8") == 7
+    assert choose_superblock(23, 4, 2900, [400] * 16, "i8") == 23
+    # ...but a huge prime ring shard must not allocate an nbn-wide band.
+    assert choose_superblock(29, 4, 3700, [400] * 16, "i8") == _superblock(29)
+    # Degenerate single-block grid: static fallback.
+    assert choose_superblock(1, 1, 100, [50], "i8") == _superblock(1)
 
 
 def test_adaptive_superblock_skew_parity():
