@@ -1,5 +1,11 @@
 """On-device ablation of the fused kernel's per-tile cost structure.
 
+NOTE: this reflects the EARLY-round-2 kernel (single pair per grid cell,
+XLA epilogue, flat A band with dynamic lane slices).  The production
+kernel has since moved on (in-kernel argmax, pre-tiled bands, pp=2); the
+recorded stage shares remain the round's ablation evidence, but re-sync
+the copy before drawing NEW per-stage conclusions from it.
+
 A switchable COPY of ops/pallas_scorer._kernel (deliberately standalone:
 ablations break semantics, so they must never be importable from the
 production module) that can disable individual pipeline stages.  Timing a
