@@ -55,6 +55,22 @@ def test_ring_long_context_beyond_reference_cap(rng):
     assert got == _oracle(seq1, seqs)
 
 
+def test_ring_long_context_8x_cap(rng):
+    """Seq1 at 8x the reference cap over 8 shards: per-shard memory stays
+    O(Bs + L2) for the window and O(Bs * L2) for the grid, independent of
+    the global length — the design point that makes the ring tier scale
+    (SURVEY §2.4 SP/CP row).  Candidates span several ring blocks and the
+    Seq2 cap is also exceeded."""
+    seq1 = rng.integers(1, 27, size=24576).astype(np.int8)
+    seqs = [
+        rng.integers(1, 27, size=300).astype(np.int8),
+        rng.integers(1, 27, size=3500).astype(np.int8),  # > BUF_SIZE_SEQ2
+        rng.integers(1, 27, size=24570).astype(np.int8),  # near-global-len
+    ]
+    got = _score_ring(seq1, seqs, sp=8, enforce_caps=False)
+    assert got == _oracle(seq1, seqs)
+
+
 def test_ring_seq2_longer_than_block(rng):
     """L2 spans several ring blocks: window needs multiple ppermute hops."""
     seq1 = rng.integers(1, 27, size=512).astype(np.int8)
