@@ -91,6 +91,17 @@ def pretile_boundary_cases():
     seqs = [rng.integers(1, 27, size=n).astype(np.int8) for n in (1999, 900, 40)]
     for weights in ([10, 2, 3, 4], [300, 7, 1, 2]):
         yield seq1, seqs, weights
+    # Prime-nbn buckets (23 and 13 offset blocks): the adaptive chooser
+    # picks sb = nbn itself — a super-block width no fixture exercises —
+    # so gate its Mosaic lowering on the real chip routinely.
+    seq1p = rng.integers(1, 27, size=2900).astype(np.int8)
+    yield seq1p, [
+        rng.integers(1, 27, size=n).astype(np.int8) for n in (80, 1500, 1999)
+    ], [10, 2, 3, 4]
+    seq1q = rng.integers(1, 27, size=1600).astype(np.int8)
+    yield seq1q, [
+        rng.integers(1, 27, size=n).astype(np.int8) for n in (400, 1590)
+    ], [10, 2, 3, 4]
 
 
 def main() -> int:
@@ -142,7 +153,10 @@ def main() -> int:
             for r in scorers["pallas"].score_codes(seq1, seqs, weights)
         ]
         want = score_batch_oracle(seq1, seqs, weights)
-        tag = f"pallas caps-size w={weights[0]} (pretile boundary)"
+        tag = (
+            f"pallas len1={seq1.size} w={weights[0]} "
+            "(pretile / super-block boundary)"
+        )
         if got == want:
             print(f"OK   {tag}", file=sys.stderr)
         else:

@@ -299,3 +299,17 @@ def test_straggler_buckets_merge_upward():
     big = np.zeros(2001, dtype=np.int8) + 1
     with pytest.raises(ValueError, match=r"Seq2\[1\] length 2001"):
         scorer.score_codes(seq1, [seqs[0], big], W)
+
+
+def test_effective_backend_routing():
+    """bench's chunk policy and dispatch routing share one source: a
+    'pallas' request with overflow-risk weights reports (and chunks as)
+    the gather fallback; eligible weights stay pallas."""
+    from mpi_openmp_cuda_tpu.ops.dispatch import effective_backend
+    from mpi_openmp_cuda_tpu.ops.values import value_table
+
+    ok = value_table([10, 2, 3, 4]).reshape(-1)
+    wide = value_table([100000, 2, 3, 4]).reshape(-1)
+    assert effective_backend("pallas", ok) == "pallas"
+    assert effective_backend("pallas", wide) == "xla-gather"
+    assert effective_backend("xla", wide) == "xla"
