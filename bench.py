@@ -28,6 +28,17 @@ import numpy as np
 
 REF_BASELINE_ELEMS_PER_SEC = 2.0e9  # analytic 2-rank MPI+CUDA estimate
 
+# Quiet-window bf16 probe reference per device kind (v5e: measured
+# 195-206 on this chip across rounds; nominal peak ~197).  Used only to
+# NORMALIZE a co-tenant-degraded measurement, never to inflate a clean
+# one; unknown TPU kinds record probes but skip gating/normalization
+# rather than apply another chip's reference.
+QUIET_BF16_BY_KIND = {"TPU v5 lite": 197.0}
+# An attempt whose bracketing probes BOTH read at least this fraction of
+# the quiet reference is a "quiet window": its measurement needs no
+# normalization (VERDICT r2 item 1).
+PROBE_GATE_FRACTION = 180.0 / 197.0
+
 
 def brute_force_elements(len1: int, lens2: list[int]) -> int:
     """Reference cost model: per pair, (L1-L2) offsets x L2 mutants x L2
@@ -197,8 +208,8 @@ def steady_state_wall(problem, backend: str, reps: int, medians: int = 1) -> flo
     return float(np.median(slopes))
 
 
-def mxu_probe_tflops() -> float:
-    """Achieved bf16 TFLOP/s on an amortised 4096^3 matmul chain.
+def mxu_probe_tflops(feed: str = "bf16") -> float:
+    """Achieved TFLOP/s on an amortised 4096^3 matmul chain.
 
     A device-health reference point independent of this framework: if the
     probe lands far below the chip's known MXU roofline, the steady-state
@@ -206,6 +217,15 @@ def mxu_probe_tflops() -> float:
     chip) and should be re-run — a uniform slowdown leaves the slope-spread
     check below silent, so this is the only signal for sustained
     interference.
+
+    ``feed='bf16'`` (default) measures the bf16 MXU rate (the historical
+    probe; quiet v5e reads 195-206).  ``feed='i8'`` measures the int8 x
+    int8 -> int32 rate — the roofline the kernel's fastest feed actually
+    runs against (VERDICT r2: dividing i8-feed FLOPs by the bf16 probe
+    understated the denominator ~2x).  The i8 chain keeps the data
+    dependence between steps through a scalar extracted from each product
+    (a cheap [4096, 4096] int8 broadcast-add per step, ~1% of the matmul
+    time) so XLA cannot hoist the matmul out of the loop.
     """
     import jax
     import jax.numpy as jnp
@@ -213,22 +233,48 @@ def mxu_probe_tflops() -> float:
 
     # 4096^3 x 128 reps: the timed increment (~95 ms on a v5e) comfortably
     # dominates host-link jitter; smaller chains read as >peak noise.
-    x = jnp.asarray(np.random.default_rng(0).random((4096, 4096)), jnp.bfloat16)
+    rng = np.random.default_rng(0)
+    if feed == "i8":
+        x = jnp.asarray(rng.integers(-4, 5, size=(4096, 4096)), jnp.int8)
 
-    def make(n):
-        def loop(a):
-            def step(c, _):
-                return c @ a, None
+        def make(n):
+            def loop(a):
+                def step(c, _):
+                    out = jnp.dot(
+                        a + c, a, preferred_element_type=jnp.int32
+                    )
+                    return (out[0, 0] & 1).astype(jnp.int8), out[0, 1]
 
-            out, _ = lax.scan(step, a, None, length=n)
-            return out.sum()
+                _, outs = lax.scan(step, jnp.int8(0), None, length=n)
+                return outs.sum()
 
-        return jax.jit(loop)
+            return jax.jit(loop)
+
+        def force(f, a):
+            return int(f(a))
+
+    else:
+        x = jnp.asarray(rng.random((4096, 4096)), jnp.bfloat16)
+
+        def make(n):
+            def loop(a):
+                def step(c, _):
+                    return c @ a, None
+
+                out, _ = lax.scan(step, a, None, length=n)
+                return out.sum()
+
+            return jax.jit(loop)
+
+        def force(f, a):
+            return float(f(a))
 
     fns = {n: make(n) for n in (4, 132)}
     for f in fns.values():
-        float(f(x))
-    slope = min_wall_slope({n: (lambda f=f: float(f(x))) for n, f in fns.items()})
+        force(f, x)
+    slope = min_wall_slope(
+        {n: (lambda f=f: force(f, x)) for n, f in fns.items()}
+    )
     return 2 * 4096**3 / slope / 1e12
 
 
@@ -265,35 +311,118 @@ def main() -> None:
 
     assert (np.asarray(out) == np.asarray(first)).all(), "nondeterministic bench run"
 
-    # 1024 amortised reps per measurement (the device-time increment must
-    # dominate the host link's ±25 ms one-sided jitter — at 256 reps
-    # consecutive invocations still spread ~3x), and a median of 3
-    # measurements: the driver records exactly one bench invocation per
-    # round, so that one number has to be reproducible.
-    wall = steady_state_wall(
-        problem,
-        backend,
-        reps=max(1, int(os.environ.get("BENCH_AMORT_REPS", "1024"))),
-        medians=int(os.environ.get("BENCH_MEDIAN", "3")),
-    )
+    # Measurement protocol (VERDICT r2 item 1 — the chip is shared, and a
+    # co-tenant can depress any single reading ~40%):  each ATTEMPT is one
+    # steady-state slope (1024 amortised reps so the device increment
+    # dominates the ±25 ms link jitter; median of BENCH_MEDIAN slopes,
+    # min-of-5 walls each) BRACKETED by MXU probes.  Attempts repeat until
+    # one lands in a quiet window (both bracketing probes >=
+    # PROBE_GATE_TFLOPS) or BENCH_ATTEMPTS are exhausted; the recorded
+    # value is the best gated attempt, or — when the chip never went quiet
+    # — the best ungated attempt plus an explicit probe-normalized field.
+    reps = max(1, int(os.environ.get("BENCH_AMORT_REPS", "1024")))
+    medians = int(os.environ.get("BENCH_MEDIAN", "3"))
+    max_attempts = max(1, int(os.environ.get("BENCH_ATTEMPTS", "5")))
+    on_tpu = jax.devices()[0].platform == "tpu"
+    quiet_ref = QUIET_BF16_BY_KIND.get(
+        jax.devices()[0].device_kind
+    ) if on_tpu else None
+    gate = quiet_ref * PROBE_GATE_FRACTION if quiet_ref else None
+
+    def _probe(feed="bf16"):
+        try:
+            t = mxu_probe_tflops(feed)
+        except Exception as e:  # preempted / co-tenant-OOMed shared chip
+            print(f"[bench] WARNING: MXU probe failed ({e})", file=sys.stderr)
+            return None
+        if t > (600 if feed == "bf16" else 1200):
+            # Above any current TPU's roofline: the probe's own slope was
+            # swamped by link jitter — calibration invalid, not the
+            # device fast.
+            print(
+                f"[bench] WARNING: {feed} probe at {t:.0f} TFLOP/s is "
+                "implausibly high — calibration invalid, discarding",
+                file=sys.stderr,
+            )
+            return None
+        return t
+
+    attempts = []  # (wall, probe_min_or_None); probes None off-TPU
+    for att in range(max_attempts if gate else 1):
+        p0 = _probe() if on_tpu else None
+        w = steady_state_wall(problem, backend, reps=reps, medians=medians)
+        p1 = _probe() if on_tpu else None
+        # A quiet window needs BOTH bracketing probes present and above
+        # the gate — a mid-measurement co-tenant burst or probe failure
+        # must not record as gated.
+        pmin = min(p0, p1) if p0 is not None and p1 is not None else None
+        attempts.append((w, pmin))
+        print(
+            f"[bench] attempt {att + 1}/{max_attempts}: steady {w:.2e}s"
+            + (f" probes {p0 if p0 is not None else float('nan'):.0f}/"
+               f"{p1 if p1 is not None else float('nan'):.0f} TFLOP/s"
+               if on_tpu else ""),
+            file=sys.stderr,
+        )
+        if gate is None or (pmin is not None and pmin >= gate):
+            break
+        if p0 is None and p1 is None:
+            break  # probes persistently failing: retrying cannot gate
+        time.sleep(5)  # give a transient co-tenant burst a chance to clear
+
+    gated = [
+        a for a in attempts if gate and a[1] is not None and a[1] >= gate
+    ]
+    pool = gated or attempts
+    wall, probe_min = min(pool, key=lambda a: a[0])
 
     elements = brute_force_elements(
         problem.seq1_codes.size, [c.size for c in problem.seq2_codes]
     )
     value = elements / wall / n_chips
-    # The JSON record is printed AFTER the probe below so the MFU line can
-    # join it; stdout stays exactly one line either way.
+    # The JSON record is printed AFTER the MFU accounting below so the MFU
+    # fields can join it; stdout stays exactly one line either way.
     record = {
         "metric": f"equivalent brute-force char comparisons/s/chip, {workload}",
         "value": round(value, 1),
         "unit": "elements/s/chip",
         "vs_baseline": round(value / REF_BASELINE_ELEMS_PER_SEC, 2),
     }
+    if probe_min is not None:
+        # The probe bracketing the recorded measurement, IN the record
+        # (VERDICT r2: a degraded-probe run must be recognisable from the
+        # JSON alone).
+        record["mxu_probe_bf16_tflops"] = round(probe_min, 1)
+        if quiet_ref:
+            record["probe_quiet_ref_tflops"] = quiet_ref
+        if gate and probe_min < gate:
+            # Chip never went quiet across every attempt: report the raw
+            # number as the contract value (lower bound) plus a linear
+            # probe-normalized estimate, clearly labelled as an estimate.
+            record["probe_gated"] = False
+            record["value_probe_normalized_est"] = round(
+                value * quiet_ref / probe_min, 1
+            )
+            print(
+                f"[bench] WARNING: no quiet window in {len(attempts)} "
+                f"attempts (best probe {probe_min:.0f} < "
+                f"{gate:.0f} TFLOP/s): recorded value is a "
+                "co-tenant-degraded lower bound",
+                file=sys.stderr,
+            )
+        elif gate:
+            record["probe_gated"] = True
+    elif on_tpu:
+        # Both bracketing probes failed or read implausibly on the
+        # recorded attempt: say so in the record rather than emitting a
+        # bare line indistinguishable from a clean run.
+        record["probe_failed"] = True
 
     # True-MFU accounting (VERDICT r1): FLOPs the kernel actually issues
     # (live tiles only), not eq-comparisons — makes efficiency headroom
     # visible instead of hiding it behind the reference's cost model.
     real_tflops = None
+    feed = None
     # Sub-50µs steady walls are dispatch-floor / clamp territory (see
     # STEADY_CLAMP_FLOOR): an MFU computed there measures the link, not
     # the kernel, and reads as nonsense (>>1).
@@ -315,58 +444,55 @@ def main() -> None:
         # FLOP model would describe work that never ran.
         fm = choose_pallas_formulation(val_flat, (padded.l1p, padded.l2p))
         if fm[0] == "pallas":
+            feed = fm[1]
             flops = kernel_mxu_flops(
                 padded.len1,
                 [c.size for c in problem.seq2_codes],
                 padded.l1p,
                 padded.l2p,
-                fm[1],
+                feed,
                 sb=choose_superblock(
                     padded.l1p // 128,
                     padded.l2p // 128,
                     padded.len1,
                     padded.len2,
-                    fm[1],
+                    feed,
                 ),
             )
             real_tflops = flops / wall / 1e12
             record["real_tflops"] = round(real_tflops, 1)
+            record["kernel_feed"] = feed
 
     probe = ""
-    if jax.devices()[0].platform == "tpu":
-        # The measurement above is complete; a probe failure (preempted /
-        # co-tenant-OOMed shared chip) must not discard the contract line.
-        try:
-            tflops = mxu_probe_tflops()
-        except Exception as e:
-            tflops = None
-            print(f"[bench] WARNING: MXU probe failed ({e})", file=sys.stderr)
-        if tflops is not None:
-            probe = f" mxu_probe={tflops:.0f}TFLOP/s"
-            if tflops < 50:
-                print(
-                    f"[bench] WARNING: MXU probe at {tflops:.0f} TFLOP/s — "
-                    "far below any TPU's roofline: sustained external load "
-                    "on the chip; this invocation's number is not a "
-                    "framework measurement, re-run",
-                    file=sys.stderr,
-                )
-            elif tflops > 600:
-                # Above any current TPU's bf16 roofline: the probe's own
-                # slope was swamped by link jitter (or clamped) — the
-                # calibration is invalid, not the device fast.
-                print(
-                    f"[bench] WARNING: MXU probe at {tflops:.0f} TFLOP/s is "
-                    "implausibly high — calibration invalid (link jitter "
-                    "swamped the probe increment); ignore the probe value",
-                    file=sys.stderr,
-                )
-            elif real_tflops is not None:
-                record["mfu_vs_probe"] = round(real_tflops / tflops, 3)
-                probe += (
-                    f" real={real_tflops:.0f}TFLOP/s"
-                    f" mfu={real_tflops / tflops:.2f}"
-                )
+    if real_tflops is not None and probe_min is not None:
+        # mfu_vs_probe keeps the historical meaning: vs the bf16 probe
+        # bracketing the measurement.
+        record["mfu_vs_probe"] = round(real_tflops / probe_min, 3)
+        # Feed-aware roofline (VERDICT r2 item 2): the i8 feed drives the
+        # MXU at ~2x the bf16 rate, so dividing i8-issued FLOPs by a bf16
+        # probe overstates utilisation ~2x.  Measure the int8 rate
+        # directly; if the probe fails or reads implausibly, fall back to
+        # the architectural 2x of the bf16 probe.
+        roof = probe_min
+        roof_kind = "bf16_probe"
+        if feed == "i8":
+            # Take the LARGER of the measured i8 probe and the
+            # architectural 2x of the bf16 probe: a co-tenant-depressed
+            # i8 reading must never shrink the denominator and overstate
+            # MFU (both depressed together roughly cancels — real_tflops
+            # is depressed the same way).
+            i8 = _probe("i8")
+            if i8 is not None and i8 > 2 * probe_min:
+                roof, roof_kind = i8, "i8_probe"
+            else:
+                roof, roof_kind = 2 * probe_min, "2x_bf16_probe"
+        record["feed_roofline_tflops"] = round(roof, 1)
+        record["feed_roofline_kind"] = roof_kind
+        record["mfu_vs_feed_roofline"] = round(real_tflops / roof, 3)
+        probe = (
+            f" probe={probe_min:.0f}TFLOP/s real={real_tflops:.0f}TFLOP/s"
+            f" mfu_feed={real_tflops / roof:.2f} ({roof_kind} {roof:.0f})"
+        )
     print(json.dumps(record))
     print(
         f"[bench] backend={backend} device={jax.devices()[0].device_kind} "

@@ -34,11 +34,16 @@ def test_bench_emits_contract_json_line():
     lines = [l for l in proc.stdout.splitlines() if l.strip()]
     assert len(lines) == 1, f"expected exactly one stdout line, got {lines!r}"
     rec = json.loads(lines[0])
-    # Required driver-contract keys; real_tflops / mfu_vs_probe join on
-    # the pallas backend (real TPU runs).
+    # Required driver-contract keys; the probe/MFU fields join on the
+    # pallas backend (real TPU runs).
     assert set(rec) >= {"metric", "value", "unit", "vs_baseline"}
     assert set(rec) <= {"metric", "value", "unit", "vs_baseline",
-                        "real_tflops", "mfu_vs_probe"}
+                        "real_tflops", "kernel_feed", "mfu_vs_probe",
+                        "mxu_probe_bf16_tflops", "probe_quiet_ref_tflops",
+                        "probe_gated", "probe_failed",
+                        "value_probe_normalized_est",
+                        "feed_roofline_tflops", "feed_roofline_kind",
+                        "mfu_vs_feed_roofline"}
     assert rec["unit"] == "elements/s/chip"
     assert rec["value"] > 0 and rec["vs_baseline"] > 0
     assert "stress_small.txt" in rec["metric"]
