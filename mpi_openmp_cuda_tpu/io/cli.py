@@ -108,7 +108,9 @@ def build_arg_parser() -> argparse.ArgumentParser:
         "collectives in lockstep; a failure confined to a single host "
         "desynchronises the schedules and is torn down by the "
         "jax.distributed coordination timeout — rerun with --journal to "
-        "resume",
+        "resume. Under --stream --distributed the same applies per "
+        "chunk: workers retry independently of the coordinator, so a "
+        "lone-host retry still ends in the coordination-timeout teardown",
     )
     p.add_argument(
         "--stream",
@@ -206,16 +208,42 @@ def _run_streaming_worker(args, timer: PhaseTimer, dist) -> int:
         scorer = _make_scorer(args, True)
     weights, seq1_codes, _ = dist.broadcast_stream_meta(None)
     with timer.phase("stream"):
+        # The worker PIPELINES one chunk in flight, mirroring the
+        # coordinator's submit(i+1)-then-finish(i) schedule exactly, so
+        # the cross-host collective order is identical on every host:
+        #   bcast(1) d(1) bcast(2) d(2) gather(1) ... bcast(end) gather(n)
+        # (the coordinator broadcasts the end sentinel BEFORE its final
+        # gather for the same reason).  A worker that materialised each
+        # chunk synchronously would run gather(i) before bcast(i+1) while
+        # the coordinator runs them the other way around — two
+        # communicating collectives in opposite orders across hosts is a
+        # deadlock until the coordination timeout.
+        pending = None
         while True:
             codes = dist.broadcast_chunk(None)
             if codes is None:
                 break
+            cur = None
             if codes:
-                _retrying(
-                    lambda: scorer.score_codes(seq1_codes, codes, weights),
+                # This retry only helps when the failure is JOB-WIDE
+                # (every host fails and re-enters the sharded collectives
+                # in lockstep with the coordinator's own chunk retry).  A
+                # failure seen by one host alone desynchronises the
+                # collective schedules either way — with or without this
+                # loop — and is torn down by the coordination timeout;
+                # see the --retries help (ADVICE r2).
+                cur = _retrying(
+                    lambda: scorer.score_codes_async(
+                        seq1_codes, codes, weights
+                    ),
                     args.retries,
-                    "chunk scoring",
+                    "chunk dispatch",
                 )
+            if pending is not None:
+                pending.result()
+            pending = cur
+        if pending is not None:
+            pending.result()
     timer.report()
     return 0
 
@@ -414,22 +442,33 @@ def _run_streaming(
                 if journal is not None:
                     stack.enter_context(journal)
                 pending = None
+                end_sent = False
                 for start, codes in header.iter_chunks(args.stream):
                     cur = _submit(start, codes)
                     if pending is not None:
                         _finish(*pending)
                     pending = cur
+                if multi:
+                    # End sentinel BEFORE the final materialise: the
+                    # pipelined worker mirrors this exactly (it learns
+                    # the stream ended, then gathers its last in-flight
+                    # chunk), keeping the cross-host collective order
+                    # identical on every host — see _run_streaming_worker.
+                    dist.broadcast_chunk(None, end=True)
+                    end_sent = True
                 if pending is not None:
                     _finish(*pending)
             except BaseException:
-                if multi:
+                if multi and not end_sent:
                     # Any coordinator-side failure (parse, journal
                     # mismatch, scoring) must release workers blocked on
                     # the next chunk broadcast — whole-job fail-stop.
+                    # (After the end sentinel the workers are already
+                    # released; a failure in the final materialise
+                    # surfaces on every host through the computation
+                    # itself.)
                     dist.broadcast_chunk(None, failed=True)
                 raise
-            if multi:
-                dist.broadcast_chunk(None, end=True)
     (out_stream or sys.stdout).write(lines.getvalue())
     if args.json:
         write_json_sidecar(

@@ -305,16 +305,39 @@ class BucketedPending:
     fetches every part in a single host round trip (per-part .result()
     would pay the tunnel latency once per bucket)."""
 
-    parts: list  # [(row_indices, PendingResult)]
+    parts: list  # [(row_indices, PendingResult | ShardedPending)]
     count: int
 
     def result(self) -> np.ndarray:
         import jax
 
-        raws = jax.device_get([pend.raw for _, pend in self.parts])
         out = np.zeros((self.count, 3), dtype=np.int32)
-        for (idx, pend), raw in zip(self.parts, raws):
+        # Batch the device_get across the local parts AND (single-process)
+        # sharded parts — one host round trip for the whole batch.
+        # Multi-host sharded parts own their collective gather and run in
+        # list order — the same deterministic order every host derived,
+        # so multi-host bucketed dispatch stays in lockstep.
+        single = jax.process_count() == 1
+        batched = [
+            (idx, pend)
+            for idx, pend in self.parts
+            if isinstance(pend, PendingResult) or single
+        ]
+        raws = (
+            jax.device_get(
+                [
+                    pend.raw if isinstance(pend, PendingResult) else pend.out
+                    for _, pend in batched
+                ]
+            )
+            if batched
+            else []
+        )
+        for (idx, pend), raw in zip(batched, raws):
             out[idx] = np.asarray(raw).reshape(-1, 3)[: pend.count]
+        for idx, pend in self.parts:
+            if not (isinstance(pend, PendingResult) or single):
+                out[idx] = pend.result()
         return out
 
 
@@ -374,10 +397,14 @@ class AlignmentScorer:
     ) -> "PendingResult | BucketedPending":
         """``score_codes`` without forcing the device->host copy.
 
-        The local jitted paths dispatch asynchronously, so the caller can
-        overlap host work (e.g. parsing the next input chunk) with device
-        compute and call ``.result()`` later; the oracle and sharded paths
-        materialise internally and return an already-complete result.
+        The local jitted paths and the sharded paths dispatch
+        asynchronously, so the caller can overlap host work (e.g. parsing
+        the next input chunk) with device compute and call ``.result()``
+        later; only the oracle path materialises internally.  The sharded
+        paths return a ``parallel.sharding.ShardedPending`` whose
+        ``result()`` performs the cross-host gather — a collective on
+        multi-host jobs, so every process must reach ``result()`` in the
+        same order (the CLI's chunk-lockstep schedule does).
         Multi-length-bucket batches return a :class:`BucketedPending`
         (same ``.result()`` contract, input order restored).
         """
@@ -403,7 +430,8 @@ class AlignmentScorer:
                 raise ValueError(
                     f"val_table must be [27, 27]; got {val_flat.size} elements"
                 )
-        if self.sharding is None:
+        unbounded = bool(getattr(self.sharding, "unbounded", False))
+        if not unbounded:
             # Caps validated on the WHOLE batch first so the error names
             # the caller's input index (a per-bucket pad_problem would
             # report a bucket-local one, after earlier buckets already
@@ -419,23 +447,35 @@ class AlignmentScorer:
                         f"Seq2[{i}] length {c.size} exceeds "
                         f"BUF_SIZE_SEQ2={BUF_SIZE_SEQ2}"
                     )
-            # Length-sorted bucketing (VERDICT r1 item 6, measured to pay
-            # ~10% on a bimodal batch): rows grouped by their L2P shape
-            # bucket dispatch as separate smaller programs — short rows
-            # stop riding max-len-wide buffers (and max-len chunking) —
-            # then scatter back to input order.  Local path only: the
-            # sharded paths own their chunk schedule and a per-bucket
-            # collective schedule would have to be agreed across hosts.
+        # Length-sorted bucketing (VERDICT r1 item 6, measured to pay
+        # ~10% on a bimodal batch): rows grouped by their L2P shape
+        # bucket dispatch as separate smaller programs — short rows
+        # stop riding max-len-wide buffers (and max-len chunking) —
+        # then scatter back to input order.  Applies to the local path
+        # and to batch-only meshes (VERDICT r2 item 8): buckets derive
+        # from the broadcast-identical global lens in sorted order, so
+        # every host runs the identical per-bucket collective schedule.
+        # The ring path keeps one program (its window schedule depends on
+        # L2P, and a per-bucket ring would rebuild windows per bucket).
+        bucketable = self.sharding is None or getattr(
+            self.sharding, "bucketed", False
+        )
+        if bucketable:
             groups: dict[int, list[int]] = {}
             for i, c in enumerate(seq2_codes):
                 groups.setdefault(round_up(max(c.size, 1), _LANE), []).append(i)
             # Each bucket costs a compilation + dispatch: straggler
             # buckets merge upward into the next wider one (padding a few
             # rows is cheaper than another program), so a length-spread
-            # batch cannot fan out into one program per 128-multiple.
+            # batch cannot fan out into one program per 128-multiple.  On
+            # a mesh a bucket also pads to the device count, so the
+            # threshold scales with it.
+            min_rows = MIN_BUCKET_ROWS * (
+                1 if self.sharding is None else self.sharding.n_devices
+            )
             keys = sorted(groups)
             for j, k in enumerate(keys[:-1]):
-                if len(groups[k]) < MIN_BUCKET_ROWS:
+                if len(groups[k]) < min_rows:
                     groups[keys[j + 1]].extend(groups.pop(k))
             if len(groups) > 1:
                 parts = []
@@ -444,25 +484,27 @@ class AlignmentScorer:
                     sub = pad_problem(
                         seq1_codes, [seq2_codes[i] for i in idx]
                     )
-                    parts.append((idx, self._score_local(sub, val_flat)))
+                    parts.append((idx, self._dispatch_batch(sub, val_flat)))
                 return BucketedPending(parts, len(seq2_codes))
-            return self._score_local(
-                pad_problem(seq1_codes, seq2_codes), val_flat
-            )
-        # Sequence-parallel shardings advertise `unbounded`: Seq1 is split
-        # across devices, so the reference's fixed buffer caps don't apply.
-        batch = pad_problem(
-            seq1_codes,
-            seq2_codes,
-            enforce_caps=not getattr(self.sharding, "unbounded", False),
+        return self._dispatch_batch(
+            pad_problem(seq1_codes, seq2_codes, enforce_caps=not unbounded),
+            val_flat,
         )
-        out = self.sharding.score(
+
+    def _dispatch_batch(self, batch: "PaddedBatch", val_flat: np.ndarray):
+        """Dispatch one shape-uniform padded batch on the configured path
+        (local jitted or sharded); returns a pending."""
+        if self.sharding is None:
+            return self._score_local(batch, val_flat)
+        # ShardedPending: dispatch returns before the gather; the fetch
+        # (a collective on multi-host) happens at .result() (VERDICT r2
+        # item 6 — forcing here serialised --stream's overlap on meshes).
+        return self.sharding.score_async(
             batch,
             val_flat,
             backend=self.backend,
             chunk_budget=self.chunk_budget,
         )
-        return PendingResult(out, out.shape[0])
 
     def _score_local(self, batch: PaddedBatch, val_flat: np.ndarray) -> PendingResult:
         import jax.numpy as jnp

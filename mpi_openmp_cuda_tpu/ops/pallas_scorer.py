@@ -78,9 +78,32 @@ re-litigate without new Mosaic capabilities):
   and routing the subtract through i32/bf16 costs 2-3 extra full-width
   VPU passes, erasing the saved matmul.  The pa - pb split with the
   all-ones-row t1 capture is the local optimum under that constraint.
+  r3 re-test with the subtract in INT32 (before one narrow cast + a
+  single prefix matmul): measured -38% (input3) / -40% (max-size) —
+  rejected in every legal form.
 * casting before the shear — the strided rotate only exists for 32-bit
   element types ("Rotate with non-32-bit data: not implemented").
 * 4-wide tile interleave — VMEM pressure regresses it ~5% vs 2-wide.
+  3-wide: +3.7% on input3 in isolation but loses to pp=1 with 2-wide on
+  the caps-size class; not adopted.
+* one-hot contraction-zero packing (VERDICT r2 item 4: 27 of 128 K
+  lanes live, pack 4 char blocks as 4x32 block-diagonal segments) —
+  cannot win: MXU time is M*K*N regardless of K-lane zeros, so packing
+  4 blocks with DISJOINT output lanes multiplies N by 4 (identical
+  total MACs to 4 separate tiles), while SHARED output lanes sum the 4
+  tiles' V values, destroying the per-char prefix/kappa resolution.
+  The r3 ablation confirms no headroom exists there anyway: removing
+  the one-hot matmul entirely saves only 2.8% (input3) / 9.5%
+  (max-size) — the kernel is VPU-pass-bound, not MAC-bound.
+* int32 prefix matmuls (skip the cast entirely) — Mosaic compile error:
+  int32 matmul is not legalizable.
+* a second base-1 strided rotate to 128-align the d1 operand — the
+  extra rotate costs more than the misaligned-slice copy it removes
+  (measured -33%).
+* deferring the packed row-max across the 2-wide tiles (one reduction
+  per iteration) — measured +-0; the reduction is not the bottleneck
+  pass, and with carryfold the carry re-injection per tile is needed
+  anyway.
 """
 
 from __future__ import annotations
@@ -152,6 +175,19 @@ _ITER_FLOOR_PER_SB_S = 0.024e-6
 _MAC_RATE = 160e12  # MACs/s, mixed one-hot i8 + int8 prefix stages
 
 
+def _live_superblocks(nbn: int, sb: int, len1: int, l2: int) -> int:
+    """Number of offset super-blocks the kernel executes for one pair:
+    block 0 always runs; block j*sb (j >= 1) runs while j*sb*128 <
+    len1 - l2.  Closed form of the kernel's ``nb == 0 or n0 < len1 - l2``
+    loop gate (ADVICE r2: the generator form was O(nbn/sb) per pair per
+    candidate, material host latency on unbounded ring grids)."""
+    jmax = -(-nbn // sb) - 1  # last super-block index
+    lim = len1 - l2
+    if lim <= 0 or jmax <= 0:
+        return 1
+    return 1 + min(jmax, (lim - 1) // (sb * _BLK))
+
+
 def choose_superblock(nbn: int, nbi: int, len1: int, lens, feed: str) -> int:
     """Adaptive offset-super-block width from the batch's length mix
     (VERDICT r1 item 4).
@@ -165,6 +201,15 @@ def choose_superblock(nbn: int, nbi: int, len1: int, lens, feed: str) -> int:
     divisors; concrete ``lens`` required (dispatch-time decision)."""
     if feed == "f32":
         return _superblock(nbn)  # wide=1 path: model not calibrated
+    return _choose_superblock_cached(
+        nbn, nbi, len1, tuple(int(l2) for l2 in lens)
+    )
+
+
+@functools.lru_cache(maxsize=256)
+def _choose_superblock_cached(
+    nbn: int, nbi: int, len1: int, lens: tuple
+) -> int:
     best_sb, best_cost = None, None
     # Every divisor of nbn in [2, 16], widest first; a prime nbn (13, 17,
     # 19, 23 -- real Seq1 buckets) has none, so it considers itself (up
@@ -186,17 +231,11 @@ def choose_superblock(nbn: int, nbi: int, len1: int, lens, feed: str) -> int:
         )
         cost = 0.0
         for l2 in lens:
-            l2 = int(l2)
             if l2 <= 0:
                 continue
             nbi_live = min(-(-l2 // _BLK), nbi)
             iters = -(-nbi_live // 2)
-            nsb = sum(
-                1
-                for nb in range(0, nbn, sb)
-                if nb == 0 or nb * _BLK < len1 - l2
-            )
-            cost += nsb * iters * t_iter
+            cost += _live_superblocks(nbn, sb, len1, l2) * iters * t_iter
         if best_cost is None or cost < best_cost:
             best_sb, best_cost = sb, cost
     return best_sb if best_sb is not None else _superblock(nbn)
@@ -228,10 +267,7 @@ def kernel_mxu_flops(
         l2 = int(l2)
         nbi_live = min(-(-l2 // _BLK), nbi)  # 0 tiles for an empty pair
         tiles = wide * (-(-nbi_live // wide))
-        nsb = sum(
-            1 for nb in range(0, nbn, sb) if nb == 0 or nb * _BLK < len1 - l2
-        )
-        total += nsb * tiles * per_iter
+        total += _live_superblocks(nbn, sb, len1, l2) * tiles * per_iter
     return 2 * total
 
 
@@ -325,11 +361,20 @@ def _pair(
                 if wide > 1:
                     # The trip count rounds nbi_live up to a multiple of
                     # `wide`; overhang tiles clamp into range with a
-                    # zeroed one-hot.  A zero tile's deltas are exactly
-                    # zero, so it only duplicates the running carry at a
-                    # LARGER kappa — which the smaller-kappa tie-break
-                    # already rejects (same argument as the rows-past-len2
-                    # duplication below).
+                    # zeroed one-hot, so their deltas are exactly zero and
+                    # every row presents the running carry — which at that
+                    # point is the FULL prefix G[len2] (endg).  LOAD-BEARING
+                    # INVARIANT (ADVICE r2): in the nbi_live == nbi clamp
+                    # case the overhang's kappas re-use the LAST block's
+                    # range (ib clamps to nbi-1), i.e. kappas SMALLER than
+                    # the value's true position, so when endg wins the
+                    # packed max, runkap is corrupted.  The output stays
+                    # correct only because the duplicated value always
+                    # EQUALS endg, and the epilogue's endg == runmax -> k=0
+                    # rule overrides runkap in exactly that case (k=0
+                    # outranks every k >= 1 at equal score in the
+                    # reference's tie order).  Changing the k=0 rule or the
+                    # overhang masking breaks tie-break parity here.
                     ib = jnp.minimum(raw, nbi - 1)
                     ohb = (codes_ref[pj, ib, :, :] == ci1) & (raw < nbi)
                 else:
@@ -424,19 +469,37 @@ def _pair(
                     t1incs.append(pb[_BLK - 1, :])
 
             # -- stage 4: streaming reductions (VPU) ---------------------
+            # The carry is constant across rows, so it COMMUTES with the
+            # row-max: reduce the TILE-LOCAL prefix surface first, inject
+            # the carry on the reduced [sbw] lane vector after (r3
+            # ablation 'carryfold': one fewer full-width pass per tile on
+            # a VPU-bound kernel, measured +4-7%).
+            # No kappa-validity mask: rows past len2 have zero deltas
+            # (the self-masking table), so their row DUPLICATES the last
+            # valid row's value — the max is unchanged, and the
+            # smaller-kappa tie-break (min-index / packed low bits)
+            # picks the real row.
             for i0, lp, t1i in zip(i0s, lps, t1incs):
                 t1 = t1 + t1i
-                g = lp + carry[None, :]
-                # No kappa-validity mask: rows past len2 have zero deltas
-                # (the self-masking table), so their g DUPLICATES the last
-                # valid row's value — the max is unchanged, and the
-                # smaller-kappa tie-break (min-index / packed low bits)
-                # picks the real row.
                 if packed:
                     # kappa = i0 + riw + 1: 4095 - kappa = (4094-i0) - riw.
-                    gpack = g * _KB + ((_KB - 2 - i0) - riw)
-                    runmax = jnp.maximum(runmax, jnp.max(gpack, axis=0))
+                    # (lp + carry)*KB + kb == lp*KB + kb + carry*KB: the
+                    # carry term joins after the reduction.  |lp| <=
+                    # 128*127 so |tp| < 2^27; adding |carry*KB| <=
+                    # 2048*127*4096 keeps the total < 2^31 (the same
+                    # bound as the pre-fold packing).
+                    tp = lp * _KB + ((_KB - 2 - i0) - riw)
+                    runmax = jnp.maximum(
+                        runmax, jnp.max(tp, axis=0) + carry * _KB
+                    )
                 else:
+                    # No carry fold here: folding (bmax = max(lp) + carry)
+                    # trips "Not implemented: Sublane broadcast" in the
+                    # select_n below on the f32 wide=1 lowering (r3,
+                    # measured on-device); this branch only serves the
+                    # non-critical f32/bf16/wide-bucket feeds, so it keeps
+                    # the full-width g pass.
+                    g = lp + carry[None, :]
                     bmax = jnp.max(g, axis=0)  # [sbw]
                     brow = jnp.min(
                         jnp.where(g == bmax[None, :], riw, _BIGROW), axis=0
@@ -667,9 +730,13 @@ def _pallas_best(seq1ext, len1, rows, lens, val_flat, feed="f32", sb=None):
     # Off-TPU (the 8-virtual-device CPU test mesh) the Mosaic kernel cannot
     # lower; interpret mode runs the same kernel semantics for parity tests.
     interpret = jax.default_backend() != "tpu"
-    # Two pairs per grid cell amortise the per-cell overhead (DMA setup,
-    # prologue) when the batch divides evenly.
-    pp = 2 if b % 2 == 0 else 1
+    # Pairs per grid cell — workload-adaptive (r3 ablation): two pairs
+    # amortise the per-cell overhead (DMA setup, prologue) when each
+    # pair's tile walk is SHORT (input3-class: nbi*nsb ~ 9, pp=2 measured
+    # +5%), but on long walks smaller cells pipeline better across the
+    # grid (max-size caps-class: nbi*nsb ~ 32, pp=1 measured +20%; skew
+    # pp1 +2%).  Threshold between the measured calibration points.
+    pp = 1 if nbi * (-(-nbn // sb)) >= 16 or b % 2 else 2
     out = _pallas_call(nbn, nbi, wneed, b, interpret, feed, sb, pp)(
         meta, codes, a_in
     )[0][:, 0, :]

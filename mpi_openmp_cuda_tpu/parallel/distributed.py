@@ -263,6 +263,13 @@ def broadcast_chunk(codes=None, *, end: bool = False, failed: bool = False):
     if int(header[3]):
         return None  # end of stream
     n, maxl = int(header[0]), int(header[1])
+    if not n:
+        # Fully-journalled chunk: skip the payload collectives entirely,
+        # exactly like broadcast_index_set — every host derives n from the
+        # header it just received, so the skip stays in lockstep (ADVICE
+        # r2: broadcasting (0, 0)-shaped arrays relied on zero-size
+        # support in the transport).
+        return []
     rows = np.zeros((n, maxl), dtype=np.int8)
     lens = np.zeros(n, dtype=np.int32)
     for i, c in enumerate(codes or ()):

@@ -78,7 +78,20 @@ class RingSharding:
         backend: str = "xla",
         chunk_budget: int = DEFAULT_CHUNK_BUDGET,
     ) -> np.ndarray:
-        """Returns [B, 3] int32 host array, input order.
+        """Returns [B, 3] int32 host array, input order."""
+        return self.score_async(
+            batch, val_flat, backend=backend, chunk_budget=chunk_budget
+        ).result()
+
+    def score_async(
+        self,
+        batch: PaddedBatch,
+        val_flat: np.ndarray,
+        backend: str = "xla",
+        chunk_budget: int = DEFAULT_CHUNK_BUDGET,
+    ):
+        """``score`` without forcing the gather (VERDICT r2 item 6):
+        returns a ShardedPending immediately after the shard_map dispatch.
 
         Formulations: the XLA gather path (always available) and the fused
         Pallas kernel run per shard on its ring-assembled window
@@ -128,7 +141,7 @@ class RingSharding:
         bp = bl * dp
         rows, lens = pad_batch_rows(batch, bp)
 
-        from .sharding import _fetch_global, _put_global
+        from .sharding import ShardedPending, _put_global
 
         rows_d = _put_global(rows, NamedSharding(self.mesh, P(BATCH_AXIS)))
         lens_d = _put_global(lens, NamedSharding(self.mesh, P(BATCH_AXIS)))
@@ -139,7 +152,7 @@ class RingSharding:
         out = _ring_fn(self.mesh, bs, batch.l2p, cb, mode)(
             seq1_d, jnp.int32(batch.len1), rows_d, lens_d, val_d
         )
-        return _fetch_global(out)[:b]
+        return ShardedPending(out, b)
 
 
 @functools.lru_cache(maxsize=32)

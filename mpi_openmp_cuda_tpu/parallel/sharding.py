@@ -55,11 +55,35 @@ def _fetch_global(out) -> np.ndarray:
     return np.asarray(multihost_utils.process_allgather(out, tiled=True))
 
 
+@dataclass(frozen=True)
+class ShardedPending:
+    """A dispatched-but-unfetched sharded scoring result (VERDICT r2
+    item 6).  ``out`` is the still-sharded device array of the shard_map
+    call — dispatch has returned, the device computes in the background —
+    and ``result()`` performs the gather (``_fetch_global``; a collective
+    on multi-host, so every process must reach it, which the CLI's
+    chunk-lockstep schedule guarantees).  Deferring the fetch preserves
+    --stream's parse/compute overlap and the bucketed back-to-back
+    dispatch on meshes, where forcing inside ``score`` serialised them."""
+
+    out: object
+    count: int
+
+    def result(self) -> np.ndarray:
+        return _fetch_global(self.out)[: self.count]
+
+
 @dataclass
 class BatchSharding:
     """Scores a PaddedBatch data-parallel over a 1-D device mesh."""
 
     mesh: Mesh
+
+    # Batch-only meshes support length-bucketed dispatch (VERDICT r2
+    # item 8): the bucket schedule derives deterministically from the
+    # broadcast-identical global lens, so every host runs the same
+    # sequence of per-bucket collectives.
+    bucketed = True
 
     @classmethod
     def over_devices(cls, n_devices: int | None = None) -> "BatchSharding":
@@ -77,6 +101,19 @@ class BatchSharding:
         chunk_budget: int = DEFAULT_CHUNK_BUDGET,
     ) -> np.ndarray:
         """Returns [B, 3] int32 host array, input order."""
+        return self.score_async(
+            batch, val_flat, backend=backend, chunk_budget=chunk_budget
+        ).result()
+
+    def score_async(
+        self,
+        batch: PaddedBatch,
+        val_flat: np.ndarray,
+        backend: str = "xla",
+        chunk_budget: int = DEFAULT_CHUNK_BUDGET,
+    ) -> ShardedPending:
+        """``score`` without forcing the gather: returns a
+        :class:`ShardedPending` immediately after the shard_map dispatch."""
         import jax.numpy as jnp
 
         from ..ops.dispatch import choose_pallas_formulation, xla_formulation_mode
@@ -135,7 +172,7 @@ class BatchSharding:
         out = _sharded_fn(self.mesh, cb, mode)(
             seq1_d, len1_d, rows_d, lens_d, val_d
         )
-        return _fetch_global(out)[:b]
+        return ShardedPending(out, b)
 
 
 @functools.lru_cache(maxsize=64)

@@ -174,29 +174,37 @@ def main() -> int:
 
 
 # A kernel regression must fail a command the round already runs, not
-# surface as a quiet BENCH delta (VERDICT r1 item 5).  Floor chosen well
-# under the measured 2.5-3.5e13 band so co-tenant load on the shared chip
-# doesn't false-alarm; raise it as the kernel improves.
-INPUT3_FLOOR_ELEMS_PER_SEC = 2.0e13
+# surface as a quiet BENCH delta (VERDICT r1 item 5).  The floor is
+# QUIET-CHIP-EQUIVALENT: the measured rate is probe-normalized before the
+# comparison (VERDICT r2 item 5 — a fixed raw floor either false-alarmed
+# under co-tenant load or was too loose to catch real regressions).
+# Quiet-chip measurements read ~4.0-4.4e13 with the r3 kernel; 3.2e13
+# catches a ~25% regression while leaving margin for the linear
+# normalization's error.  Ratchet as the kernel improves.
+INPUT3_FLOOR_ELEMS_PER_SEC = 3.2e13
 
 
 def perf_floor() -> int:
-    """Steady-state input3 throughput floor (skipped off-reference-tree or
-    when the MXU probe says the chip is under external load)."""
+    """Probe-normalized steady-state input3 throughput floor (skipped
+    off-reference-tree or when the chip is too degraded to normalize)."""
     import bench
 
     path = "/root/reference/input3.txt"
     if not os.path.exists(path):
         print("perf floor: input3.txt not mounted; skipping", file=sys.stderr)
         return 0
-    probe = bench.mxu_probe_tflops()
-    if probe < 100:
-        # The probe's own roofline is ~200 TFLOP/s on a quiet v5e; far
-        # below that the chip is shared with a heavy co-tenant and any
-        # framework number would blame the kernel for foreign load.
+    import jax
+
+    quiet = bench.QUIET_BF16_BY_KIND.get(jax.devices()[0].device_kind)
+    probe0 = bench.mxu_probe_tflops()
+    if probe0 < 100:
+        # Below ~half the quiet roofline the slowdown is dominated by a
+        # heavy co-tenant and the linear probe normalization is itself
+        # unreliable; a pass/fail either way would be noise.
         print(
-            f"perf floor: MXU probe {probe:.0f} TFLOP/s < 100 — chip under "
-            "external load; skipping the floor check (re-run later)",
+            f"perf floor: MXU probe {probe0:.0f} TFLOP/s < 100 — chip "
+            "heavily loaded; normalization unreliable, skipping "
+            "(re-run later)",
             file=sys.stderr,
         )
         return 0
@@ -204,17 +212,37 @@ def perf_floor() -> int:
 
     problem = load_problem(path)
     wall = bench.steady_state_wall(problem, "pallas", reps=512, medians=1)
+    probe1 = bench.mxu_probe_tflops()
+    probe = min(probe0, probe1)
+    if probe < 100:
+        # A co-tenant arriving MID-RUN degrades probe1 the same way a
+        # pre-degraded probe0 would: the uncapped scale-up factor below
+        # would inflate a regressed rate past the floor, so the same
+        # unreliability skip applies to both bracketing probes.
+        print(
+            f"perf floor: post-run MXU probe {probe:.0f} TFLOP/s < 100 — "
+            "load arrived mid-measurement; normalization unreliable, "
+            "skipping (re-run later)",
+            file=sys.stderr,
+        )
+        return 0
     elems = bench.brute_force_elements(
         problem.seq1_codes.size, [c.size for c in problem.seq2_codes]
     )
     rate = elems / wall
-    status = "OK  " if rate >= INPUT3_FLOOR_ELEMS_PER_SEC else "FAIL"
+    # Scale UP only (a probe reading slightly above the quiet reference
+    # must not shrink a legitimate measurement).
+    factor = max(1.0, quiet / probe) if quiet and probe > 0 else 1.0
+    norm = rate * factor
+    status = "OK  " if norm >= INPUT3_FLOOR_ELEMS_PER_SEC else "FAIL"
     print(
-        f"{status} perf floor: input3 {rate:.2e} elem/s "
-        f"(floor {INPUT3_FLOOR_ELEMS_PER_SEC:.1e}; probe {probe:.0f} TFLOP/s)",
+        f"{status} perf floor: input3 {rate:.2e} elem/s raw, "
+        f"{norm:.2e} quiet-normalized (floor "
+        f"{INPUT3_FLOOR_ELEMS_PER_SEC:.1e}; probes {probe0:.0f}/"
+        f"{probe1:.0f} TFLOP/s, quiet ref {quiet or float('nan'):.0f})",
         file=sys.stderr,
     )
-    if rate < INPUT3_FLOOR_ELEMS_PER_SEC:
+    if norm < INPUT3_FLOOR_ELEMS_PER_SEC:
         print("tpu_conformance: perf floor FAILED", file=sys.stderr)
         return 1
     return 0

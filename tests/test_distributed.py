@@ -234,6 +234,64 @@ def test_two_process_stream_with_journal_resume(tmp_path):
 
 
 @pytest.mark.slow
+def test_two_process_stream_fully_journalled_chunk(tmp_path):
+    """A chunk whose EVERY row is already journalled reduces to an empty
+    broadcast (n=0): the coordinator skips the payload collectives and the
+    workers skip scoring, in lockstep (ADVICE r2 — this path previously
+    broadcast (0, 0)-shaped arrays and had no 2-process coverage)."""
+    import json
+
+    from mpi_openmp_cuda_tpu.io.parse import load_problem
+    from mpi_openmp_cuda_tpu.utils.journal import (
+        _STREAM_FORMAT,
+        seq_hash,
+        stream_fingerprint,
+    )
+
+    problem = load_problem(fixture_path("mixedcase"))
+    journal = tmp_path / "dist-stream-full.jsonl"
+    # --stream 2 makes chunks of 2: journal BOTH rows of the second chunk
+    # (indices 2, 3) so its pend set is empty.
+    tampered = {2: (555, 1, 2), 3: (-444, 5, 6)}
+    with open(journal, "w", encoding="utf-8") as f:
+        fp = stream_fingerprint(
+            problem.weights, problem.seq1_codes, len(problem.seq2_codes)
+        )
+        f.write(
+            json.dumps({"format": _STREAM_FORMAT, "fingerprint": fp}) + "\n"
+        )
+        for i, (s, n, k) in tampered.items():
+            f.write(
+                json.dumps(
+                    {
+                        "index": i,
+                        "h": seq_hash(problem.seq2_codes[i]),
+                        "score": s,
+                        "n": n,
+                        "k": k,
+                    }
+                )
+                + "\n"
+            )
+
+    (rc0, out0, err0), (rc1, out1, err1) = _launch_pair(
+        "--stream", "2", "--journal", str(journal),
+        stdin_path=fixture_path("mixedcase"),
+    )
+    assert rc0 == 0, f"coordinator failed:\n{err0}"
+    assert rc1 == 0, f"worker failed:\n{err1}"
+    assert out1 == ""
+    lines = out0.splitlines()
+    want = golden("mixedcase").splitlines()
+    for i, line in enumerate(lines):
+        if i in tampered:
+            s, n, k = tampered[i]
+            assert line == f"#{i}: score: {s}, n: {n}, k: {k}"
+        else:
+            assert line == want[i]
+
+
+@pytest.mark.slow
 def test_two_process_stream_stale_journal_aborts_worker(tmp_path):
     """A coordinator-side journal mismatch after the stream-meta broadcast
     must broadcast an abort: the worker (blocked on the first chunk) exits

@@ -80,6 +80,60 @@ def test_sharded_matches_oracle():
     assert [tuple(int(x) for x in r) for r in shard] == want
 
 
+def test_sharded_dispatch_is_async():
+    """score_codes_async on a sharded scorer returns BEFORE the gather
+    (VERDICT r2 item 6): the pending holds the still-sharded device array,
+    not a host copy, and materialises correctly on .result()."""
+    import jax as jax_mod
+
+    from mpi_openmp_cuda_tpu.parallel.ring import RingSharding
+    from mpi_openmp_cuda_tpu.parallel.sharding import ShardedPending
+
+    rng = np.random.default_rng(7)
+    seq1 = rng.integers(1, 27, size=90).astype(np.int8)
+    seqs = [
+        rng.integers(1, 27, size=int(rng.integers(1, 80))).astype(np.int8)
+        for _ in range(9)
+    ]
+    want = [prefix_best(seq1, s, W) for s in seqs]
+    for sharding in (
+        BatchSharding.over_devices(8),
+        RingSharding.over_devices(seq=2, batch=2),
+    ):
+        pend = AlignmentScorer("xla", sharding=sharding).score_codes_async(
+            seq1, seqs, W
+        )
+        assert isinstance(pend, ShardedPending)
+        # Still a device-side (sharded) jax Array — the host gather has
+        # not run at dispatch time.
+        assert isinstance(pend.out, jax_mod.Array)
+        assert len(pend.out.sharding.device_set) > 1
+        got = [tuple(int(x) for x in r) for r in pend.result()]
+        assert got == want
+
+
+def test_sharded_bucketed_dispatch_matches_oracle():
+    """A bimodal batch on a batch mesh splits into per-bucket sharded
+    dispatches (VERDICT r2 item 8): every bucket is a ShardedPending, the
+    schedule derives from global lens (host-deterministic), and the
+    scattered result matches the oracle in input order."""
+    from mpi_openmp_cuda_tpu.ops.dispatch import BucketedPending
+    from mpi_openmp_cuda_tpu.parallel.sharding import ShardedPending
+
+    rng = np.random.default_rng(21)
+    seq1 = rng.integers(1, 27, size=900).astype(np.int8)
+    seqs = [rng.integers(1, 27, size=30).astype(np.int8) for _ in range(17)]
+    seqs += [rng.integers(1, 27, size=800).astype(np.int8) for _ in range(16)]
+    pend = AlignmentScorer(
+        "xla", sharding=BatchSharding.over_devices(2)
+    ).score_codes_async(seq1, seqs, W)
+    assert isinstance(pend, BucketedPending)
+    assert len(pend.parts) == 2
+    assert all(isinstance(p, ShardedPending) for _, p in pend.parts)
+    got = [tuple(int(x) for x in r) for r in pend.result()]
+    assert got == [prefix_best(seq1, s, W) for s in seqs]
+
+
 def test_sharded_output_is_batch_sharded():
     # The compute must actually distribute: inspect the pre-fetch jax Array's
     # sharding and per-device shards, not just the gathered host result.

@@ -47,6 +47,7 @@ def test_ring_2d_mesh_batch_and_seq(rng):
     assert _score_ring(seq1, seqs, sp=4, dp=2) == _oracle(seq1, seqs)
 
 
+@pytest.mark.slow
 def test_ring_long_context_beyond_reference_cap(rng):
     """Seq1 > BUF_SIZE_SEQ1=3000: the regime the reference cannot represent."""
     seq1 = rng.integers(1, 27, size=6144).astype(np.int8)
@@ -55,6 +56,7 @@ def test_ring_long_context_beyond_reference_cap(rng):
     assert got == _oracle(seq1, seqs)
 
 
+@pytest.mark.slow
 def test_ring_long_context_8x_cap(rng):
     """Seq1 at 8x the reference cap over 8 shards: per-shard memory stays
     O(Bs + L2) for the window and O(Bs * L2) for the grid, independent of
@@ -71,6 +73,7 @@ def test_ring_long_context_8x_cap(rng):
     assert got == _oracle(seq1, seqs)
 
 
+@pytest.mark.slow
 def test_ring_seq2_longer_than_block(rng):
     """L2 spans several ring blocks: window needs multiple ppermute hops."""
     seq1 = rng.integers(1, 27, size=512).astype(np.int8)
