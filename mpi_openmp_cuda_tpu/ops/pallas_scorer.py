@@ -84,8 +84,9 @@ re-litigate without new Mosaic capabilities):
 * casting before the shear — the strided rotate only exists for 32-bit
   element types ("Rotate with non-32-bit data: not implemented").
 * 4-wide tile interleave — VMEM pressure regresses it ~5% vs 2-wide.
-  3-wide: +3.7% on input3 in isolation but loses to pp=1 with 2-wide on
-  the caps-size class; not adopted.
+  3-wide: read +3.7% on input3 in one sequential A/B, within the
+  co-tenant noise band on re-measurement; not adopted.  (Same lesson as
+  the pp=1 episode: only interleaved A/Bs count on this shared chip.)
 * one-hot contraction-zero packing (VERDICT r2 item 4: 27 of 128 K
   lanes live, pack 4 char blocks as 4x32 block-diagonal segments) —
   cannot win: MXU time is M*K*N regardless of K-lane zeros, so packing
@@ -551,16 +552,39 @@ def _pair(
         # All quantities stay [1, 1] VECTORS (keepdims reductions): each
         # vector->scalar extraction is a scalar-unit round trip that
         # stalls the vector pipeline, and there are four per super-block.
-        svec = (t1 + runmax).astype(jnp.float32)
         kvec = jnp.where(endg == runmax, 0, runkap)  # k=0 wins ties
         # Reversed lanes: lane m holds global offset n = n0 + sbw-1-m.
         nvec = (n0 + sbw - 1) - liw
-        sm = jnp.where(nvec < len1 - l2, svec[None, :], _NEG)  # [1, sbw]
-        sbbest = jnp.max(sm, axis=1, keepdims=True)  # [1, 1]
-        # First-hit tie-break = smallest n = LARGEST reversed lane index.
-        mstar = jnp.max(
-            jnp.where(sm == sbbest, liw, -1), axis=1, keepdims=True
-        )
+        if packed:
+            # r3 'epipack': (score, lane) in ONE int32 so the masked best
+            # and the first-hit lane come from a single max reduction
+            # (equal scores pick the larger lane = the smaller offset =
+            # first hit; the unpacked path needs max + broadcast-compare
+            # + second max).  Lane field = pow2 >= sbw (<= 4096 at the
+            # sb <= 24 grid bound); |score| <= 2048*127 on the packed
+            # feed, so |pack| <= 260096*4096 + 4095 < 2^31.  Negative
+            # packs decode exactly: >> is arithmetic (floor) and the low
+            # bits hold liw verbatim in two's complement.
+            klb = max((sbw - 1).bit_length(), 1)
+            sv = t1 + runmax  # int32 [sbw]
+            spack = jnp.where(
+                nvec < len1 - l2,
+                sv[None, :] * (1 << klb) + liw,
+                jnp.int32(-(2**31 - 1)),
+            )
+            best = jnp.max(spack, axis=1, keepdims=True)  # [1, 1]
+            mstar = best & ((1 << klb) - 1)
+            sbbest = (best >> klb).astype(jnp.float32)
+        else:
+            svec = (t1 + runmax).astype(jnp.float32)
+            sm = jnp.where(
+                nvec < len1 - l2, svec[None, :], _NEG
+            )  # [1, sbw]
+            sbbest = jnp.max(sm, axis=1, keepdims=True)  # [1, 1]
+            # First-hit tie-break = smallest n = LARGEST reversed lane.
+            mstar = jnp.max(
+                jnp.where(sm == sbbest, liw, -1), axis=1, keepdims=True
+            )
         nstar = (n0 + sbw - 1) - mstar
         kstar = jnp.sum(
             jnp.where(liw == mstar, kvec[None, :], 0), axis=1, keepdims=True
@@ -730,13 +754,14 @@ def _pallas_best(seq1ext, len1, rows, lens, val_flat, feed="f32", sb=None):
     # Off-TPU (the 8-virtual-device CPU test mesh) the Mosaic kernel cannot
     # lower; interpret mode runs the same kernel semantics for parity tests.
     interpret = jax.default_backend() != "tpu"
-    # Pairs per grid cell — workload-adaptive (r3 ablation): two pairs
-    # amortise the per-cell overhead (DMA setup, prologue) when each
-    # pair's tile walk is SHORT (input3-class: nbi*nsb ~ 9, pp=2 measured
-    # +5%), but on long walks smaller cells pipeline better across the
-    # grid (max-size caps-class: nbi*nsb ~ 32, pp=1 measured +20%; skew
-    # pp1 +2%).  Threshold between the measured calibration points.
-    pp = 1 if nbi * (-(-nbn // sb)) >= 16 or b % 2 else 2
+    # Two pairs per grid cell amortise the per-cell overhead (DMA setup,
+    # prologue) when the batch divides evenly.  An r3 sequential A/B
+    # suggested pp=1 paid +20% on the caps-size class, but an
+    # INTERLEAVED re-run showed ±0.5% — the delta was co-tenant drift
+    # between measurements, not the kernel; pp=2 stands on the only
+    # other datum (the r3 sequential matrix read pp=1 as -5.3% on
+    # input3, same caveat about sequential A/Bs).
+    pp = 2 if b % 2 == 0 else 1
     out = _pallas_call(nbn, nbi, wneed, b, interpret, feed, sb, pp)(
         meta, codes, a_in
     )[0][:, 0, :]

@@ -46,6 +46,10 @@ are EXPERIMENTS — a winner gets promoted into the production kernel):
              BEFORE the packed reduction) — the reverse A/B of the r3
              'carryfold' promotion, which the base now includes
              (measured: carryfold saves 4-7% on input3)
+  epipack    per-super-block epilogue packs (score, lane) into one int32
+             so the masked best + first-hit lane come from a single max
+             reduction instead of max + broadcast-compare + max.
+             SEMANTICS-PRESERVING — promotion candidate.
 """
 
 from __future__ import annotations
@@ -304,18 +308,42 @@ def _pair_var(
                 eqv = endg[0:1][None, :].astype(jnp.float32)
             continue
 
-        svec = (t1 + runmax).astype(jnp.float32)
-        kvec = jnp.where(endg == runmax, 0, runkap)
-        nvec = (n0 + sbw - 1) - liw
-        sm = jnp.where(nvec < len1 - l2, svec[None, :], -(2.0**40))
-        sbbest = jnp.max(sm, axis=1, keepdims=True)
-        mstar = jnp.max(
-            jnp.where(sm == sbbest, liw, -1), axis=1, keepdims=True
-        )
-        nstar = (n0 + sbw - 1) - mstar
-        kstar = jnp.sum(
-            jnp.where(liw == mstar, kvec[None, :], 0), axis=1, keepdims=True
-        )
+        if var == "epipack":
+            # (score, lane) in one int32: equal scores pick the larger
+            # lane = the smaller offset (reversed lanes) = first hit.
+            # |score| <= l2p*127 so |pack| <= 260096*2048 + 2047 < 2^31.
+            sv = t1 + runmax  # int32 [sbw]
+            kvec = jnp.where(endg == runmax, 0, runkap)
+            nvec = (n0 + sbw - 1) - liw
+            spack = jnp.where(
+                nvec < len1 - l2,
+                sv[None, :] * 2048 + liw,
+                jnp.int32(-(2**31 - 1)),
+            )
+            best = jnp.max(spack, axis=1, keepdims=True)
+            mstar = best & 2047
+            sbbest = (best >> 11).astype(jnp.float32)
+            nstar = (n0 + sbw - 1) - mstar
+            kstar = jnp.sum(
+                jnp.where(liw == mstar, kvec[None, :], 0),
+                axis=1,
+                keepdims=True,
+            )
+        else:
+            svec = (t1 + runmax).astype(jnp.float32)
+            kvec = jnp.where(endg == runmax, 0, runkap)
+            nvec = (n0 + sbw - 1) - liw
+            sm = jnp.where(nvec < len1 - l2, svec[None, :], -(2.0**40))
+            sbbest = jnp.max(sm, axis=1, keepdims=True)
+            mstar = jnp.max(
+                jnp.where(sm == sbbest, liw, -1), axis=1, keepdims=True
+            )
+            nstar = (n0 + sbw - 1) - mstar
+            kstar = jnp.sum(
+                jnp.where(liw == mstar, kvec[None, :], 0),
+                axis=1,
+                keepdims=True,
+            )
         if nb == 0:
             bscore, bn, bk = sbbest, nstar, kstar
             eqv = jnp.sum(
@@ -472,7 +500,7 @@ def main() -> int:
     variants = [
         "base", "nooh", "norot", "nocast", "nopfx", "onepfx", "nored",
         "noepi", "unpacked", "wide1", "wide3", "pp1", "flat",
-        "bf16pfx", "defermax", "d1roll", "deltai32", "prefold",
+        "bf16pfx", "defermax", "d1roll", "deltai32", "prefold", "epipack",
     ]
     if args.only:
         variants = args.only.split(",")
