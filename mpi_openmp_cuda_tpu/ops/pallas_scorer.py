@@ -474,7 +474,9 @@ def _pair(
             # row-max: reduce the TILE-LOCAL prefix surface first, inject
             # the carry on the reduced [sbw] lane vector after (r3
             # ablation 'carryfold': one fewer full-width pass per tile on
-            # a VPU-bound kernel, measured +4-7%).
+            # a VPU-bound kernel; pooled interleaved A/Bs read ~+2.5%,
+            # within the shared-chip noise band — kept on the pass-count
+            # argument).
             # No kappa-validity mask: rows past len2 have zero deltas
             # (the self-masking table), so their row DUPLICATES the last
             # valid row's value — the max is unchanged, and the

@@ -44,8 +44,9 @@ are EXPERIMENTS — a winner gets promoted into the production kernel):
              'int8 delta' rejection, with the subtract in int32)
   prefold    the r2 stage-4 ordering (full-width g = lp + carry pass
              BEFORE the packed reduction) — the reverse A/B of the r3
-             'carryfold' promotion, which the base now includes
-             (measured: carryfold saves 4-7% on input3)
+             'carryfold' promotion, which the base now includes (pooled
+             interleaved A/Bs read carryfold at ~+2.5%, within the
+             shared-chip noise band; kept on the pass-count argument)
   epipack    per-super-block epilogue packs (score, lane) into one int32
              so the masked best + first-hit lane come from a single max
              reduction instead of max + broadcast-compare + max.
@@ -421,6 +422,17 @@ def main() -> int:
     ap.add_argument("--reps", type=int, default=512)
     ap.add_argument("--only", default=None)
     ap.add_argument(
+        "--ab",
+        type=int,
+        default=1,
+        metavar="PASSES",
+        help="interleave the --only variant list PASSES times (A/B/A/B) "
+        "and report per-pass deltas + the median — the promotion "
+        "protocol on this shared chip: a sequential single pass once "
+        "fabricated a 20%% effect that interleaving showed was "
+        "co-tenant drift (BASELINE.md r3)",
+    )
+    ap.add_argument(
         "--synthetic",
         default=None,
         metavar="L1xNxLO-HI",
@@ -504,48 +516,69 @@ def main() -> int:
     ]
     if args.only:
         variants = args.only.split(",")
+        if len(set(variants)) != len(variants):
+            # results is keyed per unique name: duplicates would pair
+            # mismatched passes in the delta report.  Interleaving is
+            # --ab's job.
+            ap.error("--only names must be unique (use --ab to interleave)")
 
-    results = {}
+    def make(k, call):
+        def f(meta, codes, a_in):
+            def step(c, i):
+                out = call(meta, jnp.roll(codes, i, axis=0), a_in)
+                return c + out[0].sum(), None
+
+            tot, _ = lax.scan(step, jnp.float32(0), jnp.arange(k))
+            return tot
+
+        return jax.jit(f)
+
+    # Compile every variant up front so the timing passes are pure
+    # measurement and can interleave tightly (--ab).
+    progs_by_var = {}
     for var in variants:
         a_in = a_flat if var == "flat" else a_tiled
         call = _call(nbn, nbi, wneed, b, sb, var)
-
-        def make(k, call=call):
-            def f(meta, codes, a_in):
-                def step(c, i):
-                    out = call(meta, jnp.roll(codes, i, axis=0), a_in)
-                    return c + out[0].sum(), None
-
-                tot, _ = lax.scan(step, jnp.float32(0), jnp.arange(k))
-                return tot
-
-            return jax.jit(f)
-
         t0 = time.perf_counter()
         fns = {}
         for k in (1, 1 + args.reps):
-            fns[k] = make(k)
+            fns[k] = make(k, call)
             float(fns[k](meta, codes, a_in))
-        compile_s = time.perf_counter() - t0
-        progs = {
-            k: (lambda f=f: float(f(meta, codes, a_in))) for k, f in fns.items()
-        }
-        slopes = sorted(min_wall_slope(progs) for _ in range(3))
-        results[var] = slopes[1]
         print(
-            f"{var:9s} {slopes[1] * 1e6:7.1f} us/call "
-            f"(slopes {'/'.join(f'{s * 1e6:.1f}' for s in slopes)}; "
-            f"compile {compile_s:.0f}s)",
-            flush=True,
+            f"compiled {var} in {time.perf_counter() - t0:.0f}s", flush=True
         )
+        progs_by_var[var] = {
+            k: (lambda f=f, a=a_in: float(f(meta, codes, a)))
+            for k, f in fns.items()
+        }
+
+    results = {v: [] for v in variants}
+    for p in range(max(1, args.ab)):
+        for var in variants:
+            slopes = sorted(
+                min_wall_slope(progs_by_var[var]) for _ in range(3)
+            )
+            results[var].append(slopes[1])
+            print(
+                f"[pass {p + 1}] {var:9s} {slopes[1] * 1e6:7.1f} us/call "
+                f"(slopes {'/'.join(f'{s * 1e6:.1f}' for s in slopes)})",
+                flush=True,
+            )
     if "base" in results:
-        base = results["base"]
-        for var, wall in results.items():
-            if var != "base":
-                print(
-                    f"{var:9s} saves {(base - wall) * 1e6:7.1f} us "
-                    f"({(base - wall) / base * 100:5.1f}%)"
-                )
+        import statistics
+
+        for var in variants:
+            if var == "base":
+                continue
+            deltas = [
+                (b0 - w) / b0 * 100
+                for b0, w in zip(results["base"], results[var])
+            ]
+            med = statistics.median(deltas)
+            print(
+                f"{var:9s} per-pass deltas "
+                f"{'/'.join(f'{d:+.1f}%' for d in deltas)}  median {med:+.1f}%"
+            )
     return 0
 
 
