@@ -278,6 +278,26 @@ def mxu_probe_tflops(feed: str = "bf16") -> float:
     return 2 * 4096**3 / slope / 1e12
 
 
+def probe_or_none(feed: str = "bf16") -> float | None:
+    """Guarded MXU probe: None on failure (preempted / co-tenant-OOMed
+    shared chip) or an implausible reading (probe slope swamped by link
+    jitter).  The shared discipline for every probe consumer (bench.py's
+    attempt loop, scripts/bench_table.py row stamps)."""
+    try:
+        t = mxu_probe_tflops(feed)
+    except Exception as e:
+        print(f"[bench] WARNING: MXU probe failed ({e})", file=sys.stderr)
+        return None
+    if t > (600 if feed == "bf16" else 1200):
+        print(
+            f"[bench] WARNING: {feed} probe at {t:.0f} TFLOP/s is "
+            "implausibly high — calibration invalid, discarding",
+            file=sys.stderr,
+        )
+        return None
+    return t
+
+
 def main() -> None:
     # Respect an explicit JAX_PLATFORMS choice (TPU site hooks clobber it):
     # a CPU-forced bench (the pytest contract test) must actually run CPU.
@@ -329,23 +349,7 @@ def main() -> None:
     ) if on_tpu else None
     gate = quiet_ref * PROBE_GATE_FRACTION if quiet_ref else None
 
-    def _probe(feed="bf16"):
-        try:
-            t = mxu_probe_tflops(feed)
-        except Exception as e:  # preempted / co-tenant-OOMed shared chip
-            print(f"[bench] WARNING: MXU probe failed ({e})", file=sys.stderr)
-            return None
-        if t > (600 if feed == "bf16" else 1200):
-            # Above any current TPU's roofline: the probe's own slope was
-            # swamped by link jitter — calibration invalid, not the
-            # device fast.
-            print(
-                f"[bench] WARNING: {feed} probe at {t:.0f} TFLOP/s is "
-                "implausibly high — calibration invalid, discarding",
-                file=sys.stderr,
-            )
-            return None
-        return t
+    _probe = probe_or_none
 
     attempts = []  # (wall, probe_min_or_None); probes None off-TPU
     for att in range(max_attempts if gate else 1):

@@ -98,10 +98,25 @@ def measure(problem: Problem, backend: str, reps: int = 32):
         run()
         times.append(time.perf_counter() - t0)
     e2e = float(np.median(times))
-    steady = bench.steady_state_wall(problem, backend, reps=reps, medians=3)
     elements = bench.brute_force_elements(
         problem.seq1_codes.size, [c.size for c in problem.seq2_codes]
     )
+    # Bracket the steady measurement with guarded MXU probes
+    # (bench.probe_or_none — same discipline as bench.py's attempt loop):
+    # a table row without its probe is unusable as evidence on this
+    # shared chip.  Latency-bound configs never display a probe, so they
+    # skip the two multi-second probe chains.
+    want_probe = (
+        jax.devices()[0].platform == "tpu"
+        and elements >= LATENCY_BOUND_ELEMENTS
+    )
+    probes = []
+    if want_probe:
+        probes.append(bench.probe_or_none())
+    steady = bench.steady_state_wall(problem, backend, reps=reps, medians=3)
+    if want_probe:
+        probes.append(bench.probe_or_none())
+    probes = [p for p in probes if p is not None]
     return {
         "device": jax.devices()[0].device_kind,
         "backend": backend,
@@ -109,6 +124,7 @@ def measure(problem: Problem, backend: str, reps: int = 32):
         "steady_wall": steady,
         "e2e_wall": e2e,
         "eps": elements / steady,
+        "probe": min(probes) if probes else None,
         # steady_state_wall clamps a <=0 slope to its floor/reps: per-run
         # device time below timer resolution.
         "clamped": steady <= 2 * bench.STEADY_CLAMP_FLOOR / reps,
@@ -131,9 +147,13 @@ def row(config: str, hw: str, m: dict) -> str:
         )
         vs = "n/a (latency-bound)"
     else:
+        probe = (
+            f", probe {m['probe']:.0f} TFLOP/s" if m["probe"] is not None else ""
+        )
         measured = (
             f"{m['eps']:.3g} elem/s/chip "
-            f"(steady {m['steady_wall']*1e3:.2g} ms, e2e {m['e2e_wall']*1e3:.3g} ms)"
+            f"(steady {m['steady_wall']*1e3:.2g} ms, "
+            f"e2e {m['e2e_wall']*1e3:.3g} ms{probe})"
         )
         vs = f"{m['eps']/bench.REF_BASELINE_ELEMS_PER_SEC:.3g}x"
     return f"| {config} | {hw} ({m['backend']}) | {measured} | {vs} |"
