@@ -212,14 +212,17 @@ def _choose_superblock_cached(
     nbn: int, nbi: int, len1: int, lens: tuple
 ) -> int:
     best_sb, best_cost = None, None
-    # Every divisor of nbn in [2, 16], widest first; a prime nbn (13, 17,
-    # 19, 23 -- real Seq1 buckets) has none, so it considers itself (up
-    # to the cap-scale grid bound -- a huge prime ring shard must not
-    # allocate an nbn-wide band) rather than falling to sb=1, whose
-    # per-iteration floor is the slowest measured shape.
-    candidates = [sb for sb in range(min(nbn, 16), 1, -1) if nbn % sb == 0]
-    if not candidates and 1 < nbn <= 24:
-        candidates = [nbn]
+    # Every divisor of nbn in [2, 24], widest first (ties go wide).  The
+    # r3 bound extension 16 -> 24 lets tiny-Seq2 batches against the
+    # caps-size Seq1 run ONE 24-block super-block instead of two
+    # (interleaved A/B on input4: sb=24 beats sb=12 in both passes,
+    # median +45%); the cost model keeps sb=12 for max-size-class
+    # batches, whose dead-lane waste at sb=24 outweighs the halved
+    # iteration count.  For 2 <= nbn <= 24 the divisors always include
+    # nbn itself, which also covers the prime Seq1 buckets (13, 17, 19,
+    # 23); a larger prime nbn (huge ring shard) must not allocate an
+    # nbn-wide band and falls back to the static policy.
+    candidates = [sb for sb in range(min(nbn, 24), 1, -1) if nbn % sb == 0]
     for sb in candidates:
         sbw = sb * _BLK
         # wide=2: one iteration issues two tiles.

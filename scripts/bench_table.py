@@ -116,7 +116,9 @@ def measure(problem: Problem, backend: str, reps: int = 32):
     steady = bench.steady_state_wall(problem, backend, reps=reps, medians=3)
     if want_probe:
         probes.append(bench.probe_or_none())
-    probes = [p for p in probes if p is not None]
+    # BOTH bracketing probes must be present (bench.py's gate rule): a
+    # one-sided bracket cannot vouch for the measurement window.
+    bracketed = len(probes) == 2 and all(p is not None for p in probes)
     return {
         "device": jax.devices()[0].device_kind,
         "backend": backend,
@@ -124,7 +126,8 @@ def measure(problem: Problem, backend: str, reps: int = 32):
         "steady_wall": steady,
         "e2e_wall": e2e,
         "eps": elements / steady,
-        "probe": min(probes) if probes else None,
+        "probe": min(probes) if bracketed else None,
+        "probe_expected": want_probe,
         # steady_state_wall clamps a <=0 slope to its floor/reps: per-run
         # device time below timer resolution.
         "clamped": steady <= 2 * bench.STEADY_CLAMP_FLOOR / reps,
@@ -147,9 +150,12 @@ def row(config: str, hw: str, m: dict) -> str:
         )
         vs = "n/a (latency-bound)"
     else:
-        probe = (
-            f", probe {m['probe']:.0f} TFLOP/s" if m["probe"] is not None else ""
-        )
+        if m["probe"] is not None:
+            probe = f", probe {m['probe']:.0f} TFLOP/s"
+        elif m.get("probe_expected"):
+            probe = ", probe n/a (bracket incomplete — not quiet-window evidence)"
+        else:
+            probe = ""
         measured = (
             f"{m['eps']:.3g} elem/s/chip "
             f"(steady {m['steady_wall']*1e3:.2g} ms, "

@@ -51,6 +51,9 @@ are EXPERIMENTS — a winner gets promoted into the production kernel):
              so the masked best + first-hit lane come from a single max
              reduction instead of max + broadcast-compare + max.
              SEMANTICS-PRESERVING — promotion candidate.
+  sbN        the production pipeline at offset-super-block width N
+             (e.g. sb24) — A-bands re-tiled for N; lets --ab compare
+             super-block widths interleaved in one invocation.
 """
 
 from __future__ import annotations
@@ -533,12 +536,35 @@ def main() -> int:
 
         return jax.jit(f)
 
+    def tile_a(sb_v):
+        sbw_v = sb_v * _BLK
+        bandw_v = sbw_v + _BLK
+        return jnp.stack(
+            [
+                lax.slice_in_dim(
+                    a_flat, wneed - (n0 + ib * _BLK) - bandw_v,
+                    wneed - (n0 + ib * _BLK), axis=1
+                )
+                for n0 in range(0, nbn * _BLK, sbw_v)
+                for ib in range(nbi)
+            ]
+        )
+
     # Compile every variant up front so the timing passes are pure
     # measurement and can interleave tightly (--ab).
     progs_by_var = {}
     for var in variants:
-        a_in = a_flat if var == "flat" else a_tiled
-        call = _call(nbn, nbi, wneed, b, sb, var)
+        sb_v, kvar = sb, var
+        if var.startswith("sb") and var[2:].isdigit():
+            sb_v, kvar = int(var[2:]), "base"
+            if sb_v < 1 or nbn % sb_v:
+                ap.error(f"{var}: width must divide nbn={nbn} (and be >= 1)")
+        a_in = (
+            a_flat
+            if var == "flat"
+            else (a_tiled if sb_v == sb else tile_a(sb_v))
+        )
+        call = _call(nbn, nbi, wneed, b, sb_v, kvar)
         t0 = time.perf_counter()
         fns = {}
         for k in (1, 1 + args.reps):

@@ -102,6 +102,12 @@ def pretile_boundary_cases():
     yield seq1q, [
         rng.integers(1, 27, size=n).astype(np.int8) for n in (400, 1590)
     ], [10, 2, 3, 4]
+    # Tiny-Seq2 caps-Seq1 batch: the adaptive chooser picks the r3-widened
+    # sb=24 single super-block (input4's regime) — gate its Mosaic
+    # lowering (3200-lane bands, klb=12 epilogue pack) on the real chip.
+    yield seq1, [
+        rng.integers(1, 27, size=n).astype(np.int8) for n in (5, 40, 82)
+    ], [10, 2, 3, 4]
 
 
 def main() -> int:
