@@ -223,10 +223,12 @@ def _choose_superblock_cached(
     # 23); a larger prime nbn (huge ring shard) must not allocate an
     # nbn-wide band and falls back to the static policy.
     candidates = [sb for sb in range(min(nbn, 24), 1, -1) if nbn % sb == 0]
+    # Tiles per iteration mirrors the kernel: wide=1 for single-char-block
+    # buckets (no overhang tile), wide=2 otherwise.
+    wide = 1 if nbi == 1 else 2
     for sb in candidates:
         sbw = sb * _BLK
-        # wide=2: one iteration issues two tiles.
-        per_iter_macs = 2 * (
+        per_iter_macs = wide * (
             _BLK * _BLK * (sbw + _BLK) + 2 * _BLK * _BLK * sbw
         )
         t_iter = max(
@@ -238,7 +240,7 @@ def _choose_superblock_cached(
             if l2 <= 0:
                 continue
             nbi_live = min(-(-l2 // _BLK), nbi)
-            iters = -(-nbi_live // 2)
+            iters = -(-nbi_live // wide)
             cost += _live_superblocks(nbn, sb, len1, l2) * iters * t_iter
         if best_cost is None or cost < best_cost:
             best_sb, best_cost = sb, cost
@@ -264,7 +266,7 @@ def kernel_mxu_flops(
     sb = _superblock(nbn) if sb is None else sb
     sbw = sb * _BLK
     prefix_matmuls = 1 if feed == "f32" else 2
-    wide = 1 if feed == "f32" else 2
+    wide = 1 if feed == "f32" or nbi == 1 else 2
     per_iter = _BLK * _BLK * (sbw + _BLK) + prefix_matmuls * _BLK * _BLK * sbw
     total = 0
     for l2 in lens2:
@@ -342,8 +344,16 @@ def _pair(
     # overlap MXU matmuls with VPU rotates/reductions — the stages are
     # cost-ADDITIVE in the 1-wide loop (measured by scripts/kernel_ablate:
     # pair2 ~10% faster; 4-wide regresses on VMEM pressure).  The f32
-    # feed keeps the 1-wide loop (double-width f32 tiles spill).
-    wide = 1 if feed == "f32" else 2
+    # feed keeps the 1-wide loop (double-width f32 tiles spill), and so
+    # does nbi == 1 (tiny-Seq2 buckets): there the second tile is ALWAYS
+    # the zeroed overhang, so wide=2 doubles every stage for nothing —
+    # interleaved A/B on input4 (sb=24): wide=1 +33% median.
+    wide = 1 if feed == "f32" or nbi == 1 else 2
+    # The carryfold stage-4 form only lowers at wide=2: at wide=1 Mosaic
+    # hits "Not implemented: Sublane broadcast" in the folded reduction
+    # (same limitation as the f32 branch), so wide=1 keeps the pre-fold
+    # full-width g pass.
+    fold = packed and wide == 2
 
     for nb in range(0, nbn, sb):
         n0 = nb * _BLK
@@ -487,7 +497,7 @@ def _pair(
             # picks the real row.
             for i0, lp, t1i in zip(i0s, lps, t1incs):
                 t1 = t1 + t1i
-                if packed:
+                if fold:
                     # kappa = i0 + riw + 1: 4095 - kappa = (4094-i0) - riw.
                     # (lp + carry)*KB + kb == lp*KB + kb + carry*KB: the
                     # carry term joins after the reduction.  |lp| <=
@@ -498,6 +508,11 @@ def _pair(
                     runmax = jnp.maximum(
                         runmax, jnp.max(tp, axis=0) + carry * _KB
                     )
+                elif packed:
+                    # wide=1 packed path: pre-fold form (see `fold`).
+                    g = lp + carry[None, :]
+                    gpack = g * _KB + ((_KB - 2 - i0) - riw)
+                    runmax = jnp.maximum(runmax, jnp.max(gpack, axis=0))
                 else:
                     # No carry fold here: folding (bmax = max(lp) + carry)
                     # trips "Not implemented: Sublane broadcast" in the

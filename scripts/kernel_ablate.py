@@ -255,7 +255,9 @@ def _pair_var(
                 return carry, runmax, runkap, t1
             for i0, lp, t1i in zip(i0s, lps, t1incs):
                 t1 = t1 + t1i
-                if packed and var != "prefold":
+                # The carryfold form does not lower at wide=1 (Mosaic
+                # "Sublane broadcast", same as the f32 branch).
+                if packed and var != "prefold" and wide != 1:
                     # Production (r3): carry rides the reduced lane vector.
                     tp = lp * _KB + ((_KB - 2 - i0) - riw)
                     if var != "nored":
@@ -315,18 +317,20 @@ def _pair_var(
         if var == "epipack":
             # (score, lane) in one int32: equal scores pick the larger
             # lane = the smaller offset (reversed lanes) = first hit.
-            # |score| <= l2p*127 so |pack| <= 260096*2048 + 2047 < 2^31.
+            # Lane field = pow2 >= sbw, as in production (sb can now
+            # exceed 16): |pack| <= 260096*4096 + 4095 < 2^31.
+            klb = max((sbw - 1).bit_length(), 1)
             sv = t1 + runmax  # int32 [sbw]
             kvec = jnp.where(endg == runmax, 0, runkap)
             nvec = (n0 + sbw - 1) - liw
             spack = jnp.where(
                 nvec < len1 - l2,
-                sv[None, :] * 2048 + liw,
+                sv[None, :] * (1 << klb) + liw,
                 jnp.int32(-(2**31 - 1)),
             )
             best = jnp.max(spack, axis=1, keepdims=True)
-            mstar = best & 2047
-            sbbest = (best >> 11).astype(jnp.float32)
+            mstar = best & ((1 << klb) - 1)
+            sbbest = (best >> klb).astype(jnp.float32)
             nstar = (n0 + sbw - 1) - mstar
             kstar = jnp.sum(
                 jnp.where(liw == mstar, kvec[None, :], 0),
@@ -477,7 +481,6 @@ def main() -> int:
     wneed = w + l2p
     sb = choose_superblock(nbn, nbi, batch.len1, batch.len2, "i8")
     sbw = sb * _BLK
-    bandw = sbw + _BLK
     print(f"shapes: b={b} l1p={l1p} l2p={l2p} sb={sb}", flush=True)
 
     # Host-side operand prep (mirrors _pallas_best: lane-reversed,
@@ -493,16 +496,22 @@ def main() -> int:
     a_ext = np.zeros((_BLK, wneed), np.float32)
     a_ext[:ALPHABET_SIZE] = a_small[:, ::-1]
     a_flat = jnp.asarray(a_ext.astype(np.int8))
-    a_tiled = jnp.stack(
-        [
-            lax.slice_in_dim(
-                a_flat, wneed - (n0 + ib * _BLK) - bandw,
-                wneed - (n0 + ib * _BLK), axis=1
-            )
-            for n0 in range(0, nbn * _BLK, sbw)
-            for ib in range(nbi)
-        ]
-    )
+
+    def tile_a(sb_v):
+        sbw_v = sb_v * _BLK
+        bandw_v = sbw_v + _BLK
+        return jnp.stack(
+            [
+                lax.slice_in_dim(
+                    a_flat, wneed - (n0 + ib * _BLK) - bandw_v,
+                    wneed - (n0 + ib * _BLK), axis=1
+                )
+                for n0 in range(0, nbn * _BLK, sbw_v)
+                for ib in range(nbi)
+            ]
+        )
+
+    a_tiled = tile_a(sb)
 
     codes = jnp.asarray(batch.seq2.astype(np.int32).reshape(b, nbi, _BLK, 1))
     meta = jnp.concatenate(
@@ -535,20 +544,6 @@ def main() -> int:
             return tot
 
         return jax.jit(f)
-
-    def tile_a(sb_v):
-        sbw_v = sb_v * _BLK
-        bandw_v = sbw_v + _BLK
-        return jnp.stack(
-            [
-                lax.slice_in_dim(
-                    a_flat, wneed - (n0 + ib * _BLK) - bandw_v,
-                    wneed - (n0 + ib * _BLK), axis=1
-                )
-                for n0 in range(0, nbn * _BLK, sbw_v)
-                for ib in range(nbi)
-            ]
-        )
 
     # Compile every variant up front so the timing passes are pure
     # measurement and can interleave tightly (--ab).
