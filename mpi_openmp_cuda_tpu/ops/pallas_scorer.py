@@ -223,25 +223,25 @@ def _choose_superblock_cached(
     # 23); a larger prime nbn (huge ring shard) must not allocate an
     # nbn-wide band and falls back to the static policy.
     candidates = [sb for sb in range(min(nbn, 24), 1, -1) if nbn % sb == 0]
-    # Tiles per iteration mirrors the kernel: wide=1 for single-char-block
-    # buckets (no overhang tile), wide=2 otherwise.
+    # Mirrors the kernel's r3 walk: 2-wide even part + a 1-wide tail for
+    # odd tile counts (wide=1 throughout for single-char-block buckets).
     wide = 1 if nbi == 1 else 2
     for sb in candidates:
         sbw = sb * _BLK
-        per_iter_macs = wide * (
-            _BLK * _BLK * (sbw + _BLK) + 2 * _BLK * _BLK * sbw
-        )
-        t_iter = max(
-            _ITER_FLOOR_BASE_S + sb * _ITER_FLOOR_PER_SB_S,
-            per_iter_macs / _MAC_RATE,
-        )
+        tile_macs = _BLK * _BLK * (sbw + _BLK) + 2 * _BLK * _BLK * sbw
+        floor = _ITER_FLOOR_BASE_S + sb * _ITER_FLOOR_PER_SB_S
+        t_iter2 = max(floor, 2 * tile_macs / _MAC_RATE)
+        t_iter1 = max(floor, tile_macs / _MAC_RATE)
         cost = 0.0
         for l2 in lens:
             if l2 <= 0:
                 continue
             nbi_live = min(-(-l2 // _BLK), nbi)
-            iters = -(-nbi_live // wide)
-            cost += _live_superblocks(nbn, sb, len1, l2) * iters * t_iter
+            if wide == 1:
+                t_pair = nbi_live * t_iter1
+            else:
+                t_pair = (nbi_live // 2) * t_iter2 + (nbi_live % 2) * t_iter1
+            cost += _live_superblocks(nbn, sb, len1, l2) * t_pair
         if best_cost is None or cost < best_cost:
             best_sb, best_cost = sb, cost
     return best_sb if best_sb is not None else _superblock(nbn)
@@ -255,25 +255,26 @@ def kernel_mxu_flops(
 
     Mirrors `_kernel`'s control flow exactly: per pair, super-block 0
     always runs, later super-blocks only while n0 < len1 - len2, and each
-    executed super-block runs ``nbi_live`` char-block tiles — rounded up
-    to the `wide`-tile interleave granularity, because the zeroed overhang
-    tiles are real issued matmuls — of one one-hot matmul
-    ([128, 128] @ [128, sbw + 128]) plus the prefix matmuls (two on the
-    narrow feeds, one fused on f32).  Update in lockstep with any kernel
-    reformulation, or the MFU line silently lies.
+    executed super-block runs EXACTLY ``nbi_live`` char-block tiles (the
+    r3 'tail1' walk: 2-wide even part + a 1-wide tail for odd counts —
+    no rounded-up overhang tiles on any feed), each tile one one-hot
+    matmul ([128, 128] @ [128, sbw + 128]) plus the prefix matmuls (two
+    on the narrow feeds, one fused on f32).  Update in lockstep with any
+    kernel reformulation, or the MFU line silently lies.
     """
     nbn, nbi = l1p // _BLK, l2p // _BLK
     sb = _superblock(nbn) if sb is None else sb
     sbw = sb * _BLK
     prefix_matmuls = 1 if feed == "f32" else 2
-    wide = 1 if feed == "f32" or nbi == 1 else 2
-    per_iter = _BLK * _BLK * (sbw + _BLK) + prefix_matmuls * _BLK * _BLK * sbw
+    per_tile = _BLK * _BLK * (sbw + _BLK) + prefix_matmuls * _BLK * _BLK * sbw
     total = 0
     for l2 in lens2:
         l2 = int(l2)
-        nbi_live = min(-(-l2 // _BLK), nbi)  # 0 tiles for an empty pair
-        tiles = wide * (-(-nbi_live // wide))
-        total += _live_superblocks(nbn, sb, len1, l2) * tiles * per_iter
+        # r3 tail1: the walk issues EXACTLY nbi_live tiles (even part
+        # 2-wide + a 1-wide tail for odd counts) — no rounded-up overhang
+        # tiles on any feed.
+        tiles = min(-(-l2 // _BLK), nbi)  # 0 tiles for an empty pair
+        total += _live_superblocks(nbn, sb, len1, l2) * tiles * per_tile
     return 2 * total
 
 
@@ -359,7 +360,7 @@ def _pair(
         n0 = nb * _BLK
         slot0 = (nb // sb) * nbi  # static base into the pre-tiled A bands
 
-        def ibody(ibw, car, slot0=slot0, n0=n0):
+        def ibody_gen(ibw, car, w, fold, slot0=slot0, n0=n0):
             carry, runmax, runkap, t1 = car
             acc_t = jnp.int32 if feed == "i8" else jnp.float32
             # TPU MXUs multiply f32 at bf16 precision by default; the f32
@@ -370,25 +371,20 @@ def _pair(
 
             # -- stage 1: one-hot matmuls (MXU) --------------------------
             i0s, vps = [], []
-            for half in range(wide):
-                raw = ibw * wide + half if wide > 1 else ibw
-                if wide > 1:
-                    # The trip count rounds nbi_live up to a multiple of
-                    # `wide`; overhang tiles clamp into range with a
-                    # zeroed one-hot, so their deltas are exactly zero and
-                    # every row presents the running carry — which at that
-                    # point is the FULL prefix G[len2] (endg).  LOAD-BEARING
-                    # INVARIANT (ADVICE r2): in the nbi_live == nbi clamp
-                    # case the overhang's kappas re-use the LAST block's
-                    # range (ib clamps to nbi-1), i.e. kappas SMALLER than
-                    # the value's true position, so when endg wins the
-                    # packed max, runkap is corrupted.  The output stays
-                    # correct only because the duplicated value always
-                    # EQUALS endg, and the epilogue's endg == runmax -> k=0
-                    # rule overrides runkap in exactly that case (k=0
-                    # outranks every k >= 1 at equal score in the
-                    # reference's tie order).  Changing the k=0 rule or the
-                    # overhang masking breaks tie-break parity here.
+            for half in range(w):
+                raw = ibw * w + half if w > 1 else ibw
+                if w > 1:
+                    # With the r3 exact even-trip + 1-wide-tail walk
+                    # (`nbody` below), raw never exceeds nbi_live - 1, so
+                    # the clamp and the zeroing mask are belt-and-braces
+                    # (they used to realise zeroed overhang tiles; see
+                    # BASELINE.md r3 'tail1').  If a rounded-up trip
+                    # count ever returns, note the ADVICE-r2 invariant:
+                    # an overhang tile duplicates the running carry at
+                    # kappas SMALLER than its true position, and the
+                    # output stays correct only because the duplicate
+                    # equals endg, which the epilogue's endg == runmax ->
+                    # k=0 rule outranks.
                     ib = jnp.minimum(raw, nbi - 1)
                     ohb = (codes_ref[pj, ib, :, :] == ci1) & (raw < nbi)
                 else:
@@ -531,6 +527,8 @@ def _pair(
                 carry = carry + lp[_BLK - 1, :]
             return carry, runmax, runkap, t1
 
+        ibody = functools.partial(ibody_gen, w=wide, fold=fold)
+
         zeros = jnp.zeros((sbw,), sc_t)
         init = (
             zeros,
@@ -540,7 +538,22 @@ def _pair(
         )
 
         def nbody():
-            return lax.fori_loop(0, (nbi_live + wide - 1) // wide, ibody, init)
+            if wide == 1:
+                return lax.fori_loop(0, nbi_live, ibody, init)
+            # r3 'tail1': exact even trip count, then ONE 1-wide tail
+            # iteration when nbi_live is odd — the former rounded-up trip
+            # ran a full zeroed-overhang tile pipeline for every
+            # odd-nbi_live pair (interleaved A/Bs on input3: +5.6%
+            # median; tail1's walls are also markedly more stable).  The
+            # tail uses the pre-fold stage-4 (the carryfold reduction
+            # does not lower at 1-wide — Mosaic "Sublane broadcast").
+            car = lax.fori_loop(0, nbi_live // 2, ibody, init)
+            return lax.cond(
+                nbi_live % 2 == 1,
+                lambda c: ibody_gen(nbi_live - 1, c, w=1, fold=False),
+                lambda c: c,
+                car,
+            )
 
         if nb == 0:
             # Always runs: carries the equal-length k=0 capture at n=0.

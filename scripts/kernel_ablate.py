@@ -54,6 +54,10 @@ are EXPERIMENTS — a winner gets promoted into the production kernel):
   sbN        the production pipeline at offset-super-block width N
              (e.g. sb24) — A-bands re-tiled for N; lets --ab compare
              super-block widths interleaved in one invocation.
+  tail1      even part of the char-block walk 2-wide, then a SINGLE
+             1-wide tail iteration when nbi_live is odd — the overhang
+             tile (a full zeroed one-hot pipeline pass) disappears.
+             SEMANTICS-PRESERVING — promotion candidate.
 """
 
 from __future__ import annotations
@@ -115,14 +119,14 @@ def _pair_var(
         n0 = nb * _BLK
         slot0 = (nb // sb) * nbi
 
-        def ibody(ibw, car, slot0=slot0, n0=n0):
+        def ibody_gen(ibw, car, w, fold, slot0=slot0, n0=n0):
             carry, runmax, runkap, t1 = car
 
             # -- stage 1: one-hot matmuls (MXU) --------------------------
             i0s, vps = [], []
-            for half in range(wide):
-                raw = ibw * wide + half if wide > 1 else ibw
-                if wide > 1:
+            for half in range(w):
+                raw = ibw * w + half if w > 1 else ibw
+                if w > 1:
                     ib = jnp.minimum(raw, nbi - 1)
                     ohb = (codes_ref[pj, ib, :, :] == ci1) & (raw < nbi)
                 else:
@@ -255,9 +259,7 @@ def _pair_var(
                 return carry, runmax, runkap, t1
             for i0, lp, t1i in zip(i0s, lps, t1incs):
                 t1 = t1 + t1i
-                # The carryfold form does not lower at wide=1 (Mosaic
-                # "Sublane broadcast", same as the f32 branch).
-                if packed and var != "prefold" and wide != 1:
+                if fold:
                     # Production (r3): carry rides the reduced lane vector.
                     tp = lp * _KB + ((_KB - 2 - i0) - riw)
                     if var != "nored":
@@ -283,6 +285,16 @@ def _pair_var(
                 carry = carry + lp[_BLK - 1, :]
             return carry, runmax, runkap, t1
 
+        ibody = functools.partial(
+            ibody_gen,
+            w=wide,
+            # The carryfold form does not lower at wide=1 (Mosaic
+            # "Sublane broadcast", same as the f32 branch).  nored stays
+            # on the fold path (its runmax skip lives inside it) so
+            # base-minus-nored isolates the reduction alone.
+            fold=packed and var != "prefold" and wide != 1,
+        )
+
         zeros = jnp.zeros((sbw,), sc_t)
         init = (
             zeros,
@@ -292,6 +304,17 @@ def _pair_var(
         )
 
         def nbody():
+            if var == "tail1":
+                # Even part 2-wide with the EXACT trip count, then one
+                # 1-wide (pre-fold) tail iteration when nbi_live is odd:
+                # the zeroed-overhang tile disappears.
+                car = lax.fori_loop(0, nbi_live // 2, ibody, init)
+                return lax.cond(
+                    nbi_live % 2 == 1,
+                    lambda c: ibody_gen(nbi_live - 1, c, w=1, fold=False),
+                    lambda c: c,
+                    car,
+                )
             return lax.fori_loop(0, (nbi_live + wide - 1) // wide, ibody, init)
 
         if nb == 0:
@@ -525,6 +548,7 @@ def main() -> int:
         "base", "nooh", "norot", "nocast", "nopfx", "onepfx", "nored",
         "noepi", "unpacked", "wide1", "wide3", "pp1", "flat",
         "bf16pfx", "defermax", "d1roll", "deltai32", "prefold", "epipack",
+        "tail1",
     ]
     if args.only:
         variants = args.only.split(",")
