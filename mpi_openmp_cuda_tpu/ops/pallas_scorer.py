@@ -105,6 +105,10 @@ re-litigate without new Mosaic capabilities):
   per iteration) — measured +-0; the reduction is not the bottleneck
   pass, and with carryfold the carry re-injection per tile is needed
   anyway.
+* narrowing the int32->int8 cast to the consumed union slice
+  [127, sbw+128) (~8% less cast area) — does not reproduce across
+  interleaved passes (+2.8/-5.7%): the misaligned slice source costs
+  the realignment what the area saves.
 """
 
 from __future__ import annotations
