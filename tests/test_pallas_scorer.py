@@ -43,6 +43,22 @@ def test_pallas_tie_break_low_entropy():
     assert [tuple(int(x) for x in row) for row in got] == want
 
 
+def test_pallas_tile_walk_parity_boundaries():
+    """The r3 exact tile walk (2-wide even part + 1-wide tail) must be
+    oracle-exact exactly at the char-block-count parity flips: lengths
+    straddling 128-multiples toggle nbi_live between odd (tail runs) and
+    even (tail skipped), including the full-bucket nbi_live == nbi case
+    that used to exercise the clamped overhang."""
+    rng = np.random.default_rng(33)
+    seq1 = rng.integers(1, 27, size=300).astype(np.int8)
+    seqs = [
+        rng.integers(1, 27, size=n).astype(np.int8)
+        for n in (127, 128, 129, 255, 256)
+    ]
+    got = [tuple(int(x) for x in r) for r in _score(seq1, seqs, W)]
+    assert got == [prefix_best(seq1, s, W) for s in seqs]
+
+
 def test_pallas_k0_and_edge_rows():
     seq1 = encode("ABCD" * 40)  # 160 chars
     seqs = [
