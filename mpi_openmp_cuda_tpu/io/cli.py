@@ -161,6 +161,26 @@ def _retrying(fn, retries: int, describe: str, used: list[int] | None = None):
             )
 
 
+def _materialise_retrying(promise, rescore, retries: int, budget):
+    """Materialise an async chunk dispatch under the shared retry budget.
+
+    The first attempt materialises ``promise``; every retry calls
+    ``rescore()`` (a synchronous rescoring of the same chunk).  The
+    coordinator's _finish and the worker stream loop BOTH go through this
+    helper so a job-wide transient failure sees every host take the same
+    attempt sequence and re-enter the same sharded collectives in
+    lockstep — two diverging copies of this pattern would turn such a
+    failure into a coordination-timeout teardown (ADVICE r3)."""
+    first = [promise]
+
+    def attempt():
+        if first:
+            return first.pop().result()
+        return rescore()
+
+    return _retrying(attempt, retries, "chunk scoring", used=budget)
+
+
 def _feature_import(what: str, importer):
     """Import a lazily-loaded subsystem with a clear error if absent."""
     try:
@@ -218,6 +238,23 @@ def _run_streaming_worker(args, timer: PhaseTimer, dist) -> int:
         # the coordinator runs them the other way around — two
         # communicating collectives in opposite orders across hosts is a
         # deadlock until the coordination timeout.
+        # Retries here (dispatch AND materialise) only help when the
+        # failure is JOB-WIDE: every host fails the same stage and
+        # re-enters the sharded collectives in lockstep with the
+        # coordinator's own _finish retry (whose fallback is the same
+        # synchronous rescore as _worker_finish below).  A failure seen
+        # by one host alone desynchronises the collective schedules
+        # either way and is torn down by the coordination timeout; see
+        # the --retries help (ADVICE r2).
+        def _worker_finish(pending):
+            promise, codes, budget = pending
+            _materialise_retrying(
+                promise,
+                lambda: scorer.score_codes(seq1_codes, codes, weights),
+                args.retries,
+                budget,
+            )
+
         pending = None
         while True:
             codes = dist.broadcast_chunk(None)
@@ -225,25 +262,21 @@ def _run_streaming_worker(args, timer: PhaseTimer, dist) -> int:
                 break
             cur = None
             if codes:
-                # This retry only helps when the failure is JOB-WIDE
-                # (every host fails and re-enters the sharded collectives
-                # in lockstep with the coordinator's own chunk retry).  A
-                # failure seen by one host alone desynchronises the
-                # collective schedules either way — with or without this
-                # loop — and is torn down by the coordination timeout;
-                # see the --retries help (ADVICE r2).
-                cur = _retrying(
+                budget = [0]
+                promise = _retrying(
                     lambda: scorer.score_codes_async(
                         seq1_codes, codes, weights
                     ),
                     args.retries,
                     "chunk dispatch",
+                    used=budget,
                 )
+                cur = (promise, codes, budget)
             if pending is not None:
-                pending.result()
+                _worker_finish(pending)
             pending = cur
         if pending is not None:
-            pending.result()
+            _worker_finish(pending)
     timer.report()
     return 0
 
@@ -402,19 +435,16 @@ def _run_streaming(
         def _finish(promise, start, codes, pend, rows, hashes, budget):
             res = None
             if promise is not None:
-                first = [promise]
 
-                def attempt():
-                    # First attempt materialises the async dispatch; any
-                    # retry rescores the chunk synchronously from codes.
-                    if first:
-                        return first.pop().result()
+                def rescore():
                     sub = codes if pend is None else [codes[j] for j in pend]
                     return scorer.score_codes(
                         header.seq1_codes, sub, header.weights
                     )
 
-                res = _retrying(attempt, args.retries, "chunk scoring", used=budget)
+                res = _materialise_retrying(
+                    promise, rescore, args.retries, budget
+                )
             if pend is None:
                 out = res
             else:

@@ -206,14 +206,28 @@ def choose_superblock(nbn: int, nbi: int, len1: int, lens, feed: str) -> int:
     divisors; concrete ``lens`` required (dispatch-time decision)."""
     if feed == "f32":
         return _superblock(nbn)  # wide=1 path: model not calibrated
+    # Bounded cache key (ADVICE r3): the cost model consumes lens only
+    # through ceil(l2/128) (live char-blocks) and len1 - l2 at sb*128
+    # granularity (live super-blocks), so a histogram of lens rounded UP
+    # to 128-multiples carries all the signal; the raw multi-thousand-
+    # element tuple made large streaming batches store big keys that
+    # mostly missed.  Rounding up can undercount live super-blocks by at
+    # most one per pair — noise at the model's calibration accuracy.
+    hist: dict[int, int] = {}
+    for l2 in lens:
+        l2 = int(l2)
+        if l2 <= 0:
+            continue
+        l2r = -(-l2 // _BLK) * _BLK
+        hist[l2r] = hist.get(l2r, 0) + 1
     return _choose_superblock_cached(
-        nbn, nbi, len1, tuple(int(l2) for l2 in lens)
+        nbn, nbi, len1, tuple(sorted(hist.items()))
     )
 
 
 @functools.lru_cache(maxsize=256)
 def _choose_superblock_cached(
-    nbn: int, nbi: int, len1: int, lens: tuple
+    nbn: int, nbi: int, len1: int, lens_hist: tuple
 ) -> int:
     best_sb, best_cost = None, None
     # Every divisor of nbn in [2, 24], widest first (ties go wide).  The
@@ -237,15 +251,13 @@ def _choose_superblock_cached(
         t_iter2 = max(floor, 2 * tile_macs / _MAC_RATE)
         t_iter1 = max(floor, tile_macs / _MAC_RATE)
         cost = 0.0
-        for l2 in lens:
-            if l2 <= 0:
-                continue
+        for l2, count in lens_hist:
             nbi_live = min(-(-l2 // _BLK), nbi)
             if wide == 1:
                 t_pair = nbi_live * t_iter1
             else:
                 t_pair = (nbi_live // 2) * t_iter2 + (nbi_live % 2) * t_iter1
-            cost += _live_superblocks(nbn, sb, len1, l2) * t_pair
+            cost += count * _live_superblocks(nbn, sb, len1, l2) * t_pair
         if best_cost is None or cost < best_cost:
             best_sb, best_cost = sb, cost
     return best_sb if best_sb is not None else _superblock(nbn)
@@ -611,7 +623,16 @@ def _pair(
             )
             best = jnp.max(spack, axis=1, keepdims=True)  # [1, 1]
             mstar = best & ((1 << klb) - 1)
-            sbbest = (best >> klb).astype(jnp.float32)
+            # All-invalid super-block (every lane masked): decode to the
+            # same _NEG sentinel the unpacked path carries, instead of
+            # leaking the decoded pack sentinel -(2^31-1) >> klb (~-5e5)
+            # as a plausible int32 score — the ring combine's all-invalid
+            # guard tests against _NEG (ADVICE r3).
+            sbbest = jnp.where(
+                best == jnp.int32(-(2**31 - 1)),
+                jnp.float32(_NEG),
+                (best >> klb).astype(jnp.float32),
+            )
         else:
             svec = (t1 + runmax).astype(jnp.float32)
             sm = jnp.where(
@@ -726,7 +747,9 @@ def _pallas_best(seq1ext, len1, rows, lens, val_flat, feed="f32", sb=None):
 
     ``score`` is the masked best over valid offsets n < len1 - len2 with
     the reference's first-hit tie-break (offset-major, k-ascending with
-    k=0 first); all-invalid pairs carry the ``_NEG`` sentinel.  ``eq`` is
+    k=0 first); all-invalid pairs carry the ``_NEG`` sentinel on every
+    feed (the packed i8 epilogue maps its internal pack sentinel back to
+    ``_NEG`` — ADVICE r3).  ``eq`` is
     the positional k=0 score at offset 0 (the equal-length fast path and
     the ring combine's device-0 capture).  Offset validity is the caller's
     ``len1`` view — the ring path passes a block-local effective len1, so

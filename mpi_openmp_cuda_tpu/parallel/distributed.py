@@ -249,7 +249,11 @@ def broadcast_chunk(codes=None, *, end: bool = False, failed: bool = False):
     elif end:
         header = np.array([0, 0, 0, 1], dtype=np.int32)
     elif codes is not None:
-        maxl = max((c.size for c in codes), default=0)
+        # maxl floor of 1: a chunk of n > 0 all-empty sequences must not
+        # broadcast (n, 0)-shaped rows — the zero-size-transport reliance
+        # the n == 0 skip removed (ADVICE r3).  Workers still recover
+        # empty arrays via lens.
+        maxl = max(max((c.size for c in codes), default=0), 1)
         header = np.array([len(codes), maxl, 0, 0], dtype=np.int32)
     else:
         header = np.zeros(4, dtype=np.int32)

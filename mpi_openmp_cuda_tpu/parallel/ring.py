@@ -197,8 +197,10 @@ def _ring_fn(mesh, bs, l2p, cb, mode: tuple = ("gather",)):
                 win_k, len1_eff, rows, lens, val_flat, feed=mode[1],
                 sb=mode[2],
             )
-            # All-invalid shards carry the kernel's f32 sentinel, far
-            # below int32 range: map to INT32_MIN before the int cast.
+            # All-invalid shards carry the kernel's f32 _NEG sentinel
+            # (every feed — the packed epilogue maps its pack sentinel
+            # back to _NEG), far below int32 range: map to INT32_MIN
+            # before the int cast.
             sc = jnp.where(
                 bv <= jnp.float32(INT32_MIN), neg, bv.astype(jnp.int32)
             )
