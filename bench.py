@@ -21,6 +21,7 @@ import json
 import os
 import sys
 import time
+import typing
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
@@ -189,23 +190,31 @@ def steady_state_wall(problem, backend: str, reps: int, medians: int = 1) -> flo
 
     progs = {k: (lambda f=f: int(f(*args))) for k, f in fns.items()}
     slopes = [min_wall_slope(progs) for _ in range(max(1, medians))]
-    # Spread only signals interference when the timed increment is itself
-    # well above link jitter; latency-bound micro-workloads (sub-us slopes)
-    # spread arbitrarily and meaninglessly.  Gate on the UNcontaminated
-    # (minimum) increment: a single jitter-inflated slope must not re-open
-    # the gate it is supposed to be filtered by.
+    warn = slope_spread_warning(slopes, reps)
+    if warn:
+        print(warn, file=sys.stderr)
+    return float(np.median(slopes))
+
+
+def slope_spread_warning(slopes, reps: int) -> str | None:
+    """Interference heuristic over repeated slope measurements.
+
+    Spread only signals interference when the timed increment is itself
+    well above link jitter; latency-bound micro-workloads (sub-us slopes)
+    spread arbitrarily and meaninglessly.  Gate on the UNcontaminated
+    (minimum) increment: a single jitter-inflated slope must not re-open
+    the gate it is supposed to be filtered by.  A co-tenant saturating
+    the (shared, tunnelled) chip inflates every slope it overlaps and the
+    median cannot recover if the load spans the whole invocation, so the
+    warning makes a recorded outlier traceable to interference rather
+    than a code regression.  Returns the warning text, or None."""
     if min(slopes) * reps > 0.1 and max(slopes) > 2.5 * min(slopes) > 0:
-        # A co-tenant saturating the (shared, tunnelled) chip inflates
-        # every slope it overlaps; the median cannot recover if the load
-        # spans the whole invocation.  Flag it so a recorded outlier is
-        # traceable to interference rather than a code regression.
-        print(
+        return (
             f"[bench] WARNING: steady-state slopes spread {min(slopes):.2e}.."
             f"{max(slopes):.2e} s/rep (>2.5x): device/tunnel interference "
-            "suspected; treat this invocation's number as a lower bound",
-            file=sys.stderr,
+            "suspected; the median may still be contaminated"
         )
-    return float(np.median(slopes))
+    return None
 
 
 def mxu_probe_tflops(feed: str = "bf16") -> float:
@@ -298,12 +307,153 @@ def probe_or_none(feed: str = "bf16") -> float | None:
     return t
 
 
+class Attempt(typing.NamedTuple):
+    """One bracketed measurement: a steady-state wall and the MXU probes
+    taken immediately before and after it (None = probe failed/off-TPU)."""
+
+    wall: float
+    p0: float | None
+    p1: float | None
+
+    @property
+    def pmin(self) -> float | None:
+        """The attempt's quiet-window credential: the WORSE of the two
+        bracketing probes, present only when both are.  A mid-measurement
+        co-tenant burst or probe failure must not read as quiet."""
+        if self.p0 is None or self.p1 is None:
+            return None
+        return min(self.p0, self.p1)
+
+
+def run_attempts(
+    measure, probe, *, gate, max_attempts, sleep=time.sleep, log=None
+) -> list[Attempt]:
+    """Repeat probe-bracketed measurements until one lands in a quiet
+    window (``Attempt.pmin >= gate``), ``max_attempts`` are exhausted, or
+    both bracketing probes fail (retrying cannot gate then).  ``gate``
+    None (off-TPU / unknown chip kind) takes a single ungated attempt.
+    Exponential backoff between attempts (5 s doubling, capped at 60 s)
+    gives a transient co-tenant burst a chance to clear — with the r4
+    default of 12 attempts the loop spans ~7 minutes of chip time before
+    giving up on a quiet window (VERDICT r3 item 1c).
+
+    Injectable ``measure``/``probe``/``sleep``/``log`` so every branch is
+    testable off-device (tests/test_bench.py)."""
+    attempts: list[Attempt] = []
+    rounds = max_attempts if gate is not None else 1
+    for att in range(rounds):
+        p0 = probe() if probe is not None else None
+        w = measure()
+        p1 = probe() if probe is not None else None
+        a = Attempt(w, p0, p1)
+        attempts.append(a)
+        if log is not None:
+            log(att, rounds, a)
+        if gate is None or (a.pmin is not None and a.pmin >= gate):
+            break
+        if p0 is None and p1 is None:
+            break
+        if att < rounds - 1:
+            sleep(min(5.0 * 2.0**att, 60.0))
+    return attempts
+
+
+def select_attempt(attempts, gate) -> tuple[Attempt, bool]:
+    """The attempt to record, and whether it was probe-gated.
+
+    Gated pool first: fastest wall among quiet-window attempts (within a
+    quiet window the remaining noise — host-link jitter — is one-sided,
+    so min is the estimator).  When the chip never went quiet, min-wall
+    selection is BIASED: under interference the two-point slope can
+    UNDERestimate per-rep time (the short loop's wall inflates more than
+    the long loop's), which is how r3 recorded a 128 us "steady" at probe
+    141 below every gated quiet reading (VERDICT r3 weakness 1).  So the
+    ungated fallback records the attempt measured CLOSEST to quiet — the
+    highest min bracketing probe — and when no attempt has both probes,
+    the median wall (robust to the artifact in both directions)."""
+    gated = [
+        a
+        for a in attempts
+        if gate is not None and a.pmin is not None and a.pmin >= gate
+    ]
+    if gated:
+        return min(gated, key=lambda a: a.wall), True
+    probed = [a for a in attempts if a.pmin is not None]
+    if probed:
+        return max(probed, key=lambda a: a.pmin), False
+    by_wall = sorted(attempts, key=lambda a: a.wall)
+    return by_wall[(len(by_wall) - 1) // 2], False
+
+
+# Empirical wall-inflation bound for ungated records, fitted over the
+# session's recorded (min bracketing probe, steady input3 wall) pairs
+# (scripts/probe_wall_fit.py; analysis in BASELINE.md): across probes
+# 133-206 the kernel's wall is nearly FLAT in the probe — quiet-window
+# walls (157-162 us) overlap degraded-window walls (156-162 us; worst
+# ever observed 177 us), nothing like the linear 1/probe model r3 used
+# (which predicts ~230 us at probe 134 and so overestimated the quiet
+# value by ~60% when inverted).  The bound is the worst observed
+# degraded wall over the session's best gated wall (176.6/150 = 1.18,
+# rounded up); an ungated record brackets the quiet value as
+# [value, value * WALL_INFLATION_BOUND] instead of publishing a linear
+# "normalized estimate" (VERDICT r3 item 1b: validated and replaced).
+WALL_INFLATION_BOUND = 1.2
+
+
+def probe_record_fields(
+    attempt: Attempt, gated: bool, gate, quiet_ref, on_tpu: bool,
+    n_attempts: int, value: float,
+) -> tuple[dict, str | None]:
+    """The probe-context JSON fields for a recorded attempt, plus an
+    optional stderr warning line.  Pure function of the selection outcome
+    so the labelling logic is testable off-device."""
+    rec: dict = {}
+    warn = None
+    if attempt.pmin is not None:
+        rec["mxu_probe_bf16_tflops"] = round(attempt.pmin, 1)
+        if quiet_ref:
+            rec["probe_quiet_ref_tflops"] = quiet_ref
+        if gate is not None:
+            rec["probe_gated"] = bool(gated)
+            if not gated:
+                # Explicitly bounded, not "normalized": the recorded raw
+                # value and the empirical inflation bound bracket the
+                # quiet-chip value (see WALL_INFLATION_BOUND).
+                rec["value_quiet_band_est"] = [
+                    round(value, 1),
+                    round(value * WALL_INFLATION_BOUND, 1),
+                ]
+                warn = (
+                    f"[bench] WARNING: no quiet window in {n_attempts} "
+                    f"attempts (closest probe {attempt.pmin:.0f} < "
+                    f"{gate:.0f} TFLOP/s): recorded the closest-to-quiet "
+                    "attempt; quiet value bracketed by "
+                    "value_quiet_band_est (empirical "
+                    f"<={WALL_INFLATION_BOUND - 1:.0%} inflation, "
+                    "BASELINE.md wall-vs-probe fit)"
+                )
+    elif on_tpu:
+        # Both bracketing probes failed or read implausibly on the
+        # recorded attempt: say so in the record rather than emitting a
+        # bare line indistinguishable from a clean run.
+        rec["probe_failed"] = True
+    return rec, warn
+
+
 def main() -> None:
     # Respect an explicit JAX_PLATFORMS choice (TPU site hooks clobber it):
     # a CPU-forced bench (the pytest contract test) must actually run CPU.
-    from mpi_openmp_cuda_tpu.utils.platform import apply_platform_override
+    from mpi_openmp_cuda_tpu.utils.platform import (
+        apply_platform_override,
+        enable_compilation_cache,
+    )
 
     apply_platform_override()
+    # Persistent compile cache: the first-ever process pays the ~10 s
+    # XLA/Mosaic compile; every later COLD process loads it from disk
+    # (VERDICT r3 item 4 — the reference's deployment is cold batch runs).
+    # e2e_first_run_s in the record shows which this invocation was.
+    enable_compilation_cache()
     import jax
 
     from mpi_openmp_cuda_tpu.ops.dispatch import AlignmentScorer
@@ -335,50 +485,39 @@ def main() -> None:
     # co-tenant can depress any single reading ~40%):  each ATTEMPT is one
     # steady-state slope (1024 amortised reps so the device increment
     # dominates the ±25 ms link jitter; median of BENCH_MEDIAN slopes,
-    # min-of-5 walls each) BRACKETED by MXU probes.  Attempts repeat until
-    # one lands in a quiet window (both bracketing probes >=
-    # PROBE_GATE_TFLOPS) or BENCH_ATTEMPTS are exhausted; the recorded
-    # value is the best gated attempt, or — when the chip never went quiet
-    # — the best ungated attempt plus an explicit probe-normalized field.
+    # min-of-5 walls each) BRACKETED by MXU probes.  Attempts repeat with
+    # exponential backoff until one lands in a quiet window (both
+    # bracketing probes >= the gate) or BENCH_ATTEMPTS are exhausted; the
+    # recorded value is the fastest gated attempt, or — when the chip
+    # never went quiet — the closest-to-quiet attempt with an explicit
+    # quiet-band bracket (see select_attempt / probe_record_fields).
     reps = max(1, int(os.environ.get("BENCH_AMORT_REPS", "1024")))
     medians = int(os.environ.get("BENCH_MEDIAN", "3"))
-    max_attempts = max(1, int(os.environ.get("BENCH_ATTEMPTS", "5")))
+    max_attempts = max(1, int(os.environ.get("BENCH_ATTEMPTS", "12")))
     on_tpu = jax.devices()[0].platform == "tpu"
     quiet_ref = QUIET_BF16_BY_KIND.get(
         jax.devices()[0].device_kind
     ) if on_tpu else None
     gate = quiet_ref * PROBE_GATE_FRACTION if quiet_ref else None
 
-    _probe = probe_or_none
-
-    attempts = []  # (wall, probe_min_or_None); probes None off-TPU
-    for att in range(max_attempts if gate else 1):
-        p0 = _probe() if on_tpu else None
-        w = steady_state_wall(problem, backend, reps=reps, medians=medians)
-        p1 = _probe() if on_tpu else None
-        # A quiet window needs BOTH bracketing probes present and above
-        # the gate — a mid-measurement co-tenant burst or probe failure
-        # must not record as gated.
-        pmin = min(p0, p1) if p0 is not None and p1 is not None else None
-        attempts.append((w, pmin))
+    def log(att, rounds, a):
         print(
-            f"[bench] attempt {att + 1}/{max_attempts}: steady {w:.2e}s"
-            + (f" probes {p0 if p0 is not None else float('nan'):.0f}/"
-               f"{p1 if p1 is not None else float('nan'):.0f} TFLOP/s"
+            f"[bench] attempt {att + 1}/{rounds}: steady {a.wall:.2e}s"
+            + (f" probes {a.p0 if a.p0 is not None else float('nan'):.0f}/"
+               f"{a.p1 if a.p1 is not None else float('nan'):.0f} TFLOP/s"
                if on_tpu else ""),
             file=sys.stderr,
         )
-        if gate is None or (pmin is not None and pmin >= gate):
-            break
-        if p0 is None and p1 is None:
-            break  # probes persistently failing: retrying cannot gate
-        time.sleep(5)  # give a transient co-tenant burst a chance to clear
 
-    gated = [
-        a for a in attempts if gate and a[1] is not None and a[1] >= gate
-    ]
-    pool = gated or attempts
-    wall, probe_min = min(pool, key=lambda a: a[0])
+    attempts = run_attempts(
+        lambda: steady_state_wall(problem, backend, reps=reps, medians=medians),
+        probe_or_none if on_tpu else None,
+        gate=gate,
+        max_attempts=max_attempts,
+        log=log,
+    )
+    chosen, was_gated = select_attempt(attempts, gate)
+    wall, probe_min = chosen.wall, chosen.pmin
 
     elements = brute_force_elements(
         problem.seq1_codes.size, [c.size for c in problem.seq2_codes]
@@ -391,36 +530,22 @@ def main() -> None:
         "value": round(value, 1),
         "unit": "elements/s/chip",
         "vs_baseline": round(value / REF_BASELINE_ELEMS_PER_SEC, 2),
+        # Cold-start accounting (VERDICT r3 item 4): first in-process run
+        # (compile, or persistent-cache load on a later cold process) vs
+        # the warm in-process median — the north-star e2e story lives in
+        # BASELINE.md's cold/warm table.
+        "e2e_first_run_s": round(compile_and_run, 2),
+        "e2e_warm_s": round(e2e_wall, 4),
     }
-    if probe_min is not None:
-        # The probe bracketing the recorded measurement, IN the record
-        # (VERDICT r2: a degraded-probe run must be recognisable from the
-        # JSON alone).
-        record["mxu_probe_bf16_tflops"] = round(probe_min, 1)
-        if quiet_ref:
-            record["probe_quiet_ref_tflops"] = quiet_ref
-        if gate and probe_min < gate:
-            # Chip never went quiet across every attempt: report the raw
-            # number as the contract value (lower bound) plus a linear
-            # probe-normalized estimate, clearly labelled as an estimate.
-            record["probe_gated"] = False
-            record["value_probe_normalized_est"] = round(
-                value * quiet_ref / probe_min, 1
-            )
-            print(
-                f"[bench] WARNING: no quiet window in {len(attempts)} "
-                f"attempts (best probe {probe_min:.0f} < "
-                f"{gate:.0f} TFLOP/s): recorded value is a "
-                "co-tenant-degraded lower bound",
-                file=sys.stderr,
-            )
-        elif gate:
-            record["probe_gated"] = True
-    elif on_tpu:
-        # Both bracketing probes failed or read implausibly on the
-        # recorded attempt: say so in the record rather than emitting a
-        # bare line indistinguishable from a clean run.
-        record["probe_failed"] = True
+    # The probe context bracketing the recorded measurement, IN the record
+    # (VERDICT r2: a degraded-probe run must be recognisable from the JSON
+    # alone).
+    fields, warn = probe_record_fields(
+        chosen, was_gated, gate, quiet_ref, on_tpu, len(attempts), value
+    )
+    record.update(fields)
+    if warn:
+        print(warn, file=sys.stderr)
 
     # True-MFU accounting (VERDICT r1): FLOPs the kernel actually issues
     # (live tiles only), not eq-comparisons — makes efficiency headroom
@@ -485,7 +610,7 @@ def main() -> None:
             # i8 reading must never shrink the denominator and overstate
             # MFU (both depressed together roughly cancels — real_tflops
             # is depressed the same way).
-            i8 = _probe("i8")
+            i8 = probe_or_none("i8")
             if i8 is not None and i8 > 2 * probe_min:
                 roof, roof_kind = i8, "i8_probe"
             else:

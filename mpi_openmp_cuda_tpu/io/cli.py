@@ -509,9 +509,13 @@ def _run_streaming(
 
 
 def run(argv: list[str] | None = None) -> int:
-    from ..utils.platform import apply_platform_override
+    from ..utils.platform import (
+        apply_platform_override,
+        enable_compilation_cache,
+    )
 
     apply_platform_override()
+    enable_compilation_cache()
     args = build_arg_parser().parse_args(argv)
     timer = PhaseTimer(enabled=args.profile)
     # Static argument-compatibility checks: fail before any expensive phase

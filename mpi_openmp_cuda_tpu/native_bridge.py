@@ -29,7 +29,7 @@ from .models.encoding import encode
 from .ops.dispatch import AlignmentScorer
 from .ops.values import signed_weights
 from .utils.constants import ALPHABET_SIZE
-from .utils.platform import apply_platform_override
+from .utils.platform import apply_platform_override, enable_compilation_cache
 
 
 def value_table_from_levels(mat1: np.ndarray, mat2: np.ndarray, weights) -> np.ndarray:
@@ -79,6 +79,7 @@ def score_strided(
     form) runs single-device.
     """
     apply_platform_override()
+    enable_compilation_cache()
     if rows <= 0:
         return b""
     if stride <= 0 or len(seq2_all) < rows * stride:

@@ -26,3 +26,46 @@ def apply_platform_override() -> None:
 
     if jax.config.jax_platforms != envp:
         jax.config.update("jax_platforms", envp)
+
+
+def enable_compilation_cache() -> None:
+    """Point JAX's persistent compilation cache at a stable directory.
+
+    The reference deployment is a COLD batch run (`mpiexec -np 2 ./final
+    < input.txt`, makefile:11): every invocation pays its full startup.
+    Here a cold process pays ~10 s of XLA/Mosaic compiles — the dominant
+    end-to-end cost on every fixture — so all entry points (CLI, native
+    bridge, bench) enable the on-disk cache and the second cold process
+    skips straight to execution (VERDICT r3 item 4).
+
+    ``TPU_SEQALIGN_COMPILE_CACHE`` overrides the location; ``off`` (or
+    ``0``) disables.  Failures are non-fatal: a read-only home directory
+    degrades to the in-memory cache, never to an error.  Idempotent and
+    once-per-process: the native bridge calls this on every scoring
+    batch, which must not repeat the mkdir/config writes on a hot path.
+    """
+    if getattr(enable_compilation_cache, "_done", False):
+        return
+    enable_compilation_cache._done = True
+    loc = os.environ.get("TPU_SEQALIGN_COMPILE_CACHE")
+    if loc is not None and loc.strip().lower() in ("off", "0", ""):
+        return
+    if loc is None:
+        loc = os.path.join(
+            os.path.expanduser("~"), ".cache", "mpi_openmp_cuda_tpu", "jax"
+        )
+    try:
+        os.makedirs(loc, exist_ok=True)
+        import jax
+
+        jax.config.update("jax_compilation_cache_dir", loc)
+        # Cache every compile worth having: the kernel's Mosaic compiles
+        # take seconds, but even sub-second XLA epilogues add up across
+        # the six fixtures' bucket shapes.
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.2)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    except Exception as e:  # pragma: no cover - depends on local FS/jax
+        print(
+            f"mpi_openmp_cuda_tpu: persistent compilation cache disabled ({e})",
+            file=__import__("sys").stderr,
+        )

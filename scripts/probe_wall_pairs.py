@@ -1,0 +1,42 @@
+"""Collect (bracketing MXU probe, steady input3 wall) pairs on the real
+chip — the dataset behind BASELINE.md's wall-vs-probe analysis and the
+round-4 decision on bench.py's probe normalization (VERDICT r3 item 1b).
+
+Each line: p0 p1 wall_us — one steady-state slope measurement bracketed
+by the standard bf16 probes, exactly as a bench.py attempt runs them.
+Run repeatedly across load states; append to a log for the fit.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import bench
+
+
+def main() -> None:
+    problem, workload = bench.load_workload()
+    backend = bench.pick_backend()
+    n = int(os.environ.get("PAIRS_N", "6"))
+    reps = int(os.environ.get("BENCH_AMORT_REPS", "1024"))
+    medians = int(os.environ.get("BENCH_MEDIAN", "3"))
+    # Warm the compile outside the timed pairs.
+    bench.steady_state_wall(problem, backend, reps=reps, medians=1)
+    for _ in range(n):
+        p0 = bench.probe_or_none()
+        w = bench.steady_state_wall(problem, backend, reps=reps, medians=medians)
+        p1 = bench.probe_or_none()
+        print(
+            f"{p0 if p0 is not None else float('nan'):.1f} "
+            f"{p1 if p1 is not None else float('nan'):.1f} {w * 1e6:.1f}",
+            flush=True,
+        )
+        time.sleep(2)
+
+
+if __name__ == "__main__":
+    main()

@@ -181,18 +181,22 @@ def main() -> int:
 
 # A kernel regression must fail a command the round already runs, not
 # surface as a quiet BENCH delta (VERDICT r1 item 5).  The floor is
-# QUIET-CHIP-EQUIVALENT: the measured rate is probe-normalized before the
-# comparison (VERDICT r2 item 5 — a fixed raw floor either false-alarmed
-# under co-tenant load or was too loose to catch real regressions).
-# Quiet-chip measurements read ~4.0-4.4e13 with the r3 kernel; 3.2e13
-# catches a ~25% regression while leaving margin for the linear
-# normalization's error.  Ratchet as the kernel improves.
+# QUIET-CHIP-EQUIVALENT: the r4 wall-vs-probe fit
+# (scripts/probe_wall_fit.py) showed the kernel's wall is ~FLAT in the
+# probe (a degraded window inflates it <= ~20%, nothing like 1/probe),
+# so the measurement below runs the full bench protocol (1024 amortised
+# reps, median of 3) and is scaled up by at most bench's empirical
+# WALL_INFLATION_BOUND — the r3 linear quiet/probe scale-up could
+# inflate a real regression past the floor (VERDICT r3 weakness 2).
+# Gated quiet-window measurements read 3.8-4.1e13 with the r3/r4 kernel;
+# 3.2e13 catches a ~20% regression through the bound's slack.
 INPUT3_FLOOR_ELEMS_PER_SEC = 3.2e13
 
 
 def perf_floor() -> int:
-    """Probe-normalized steady-state input3 throughput floor (skipped
-    off-reference-tree or when the chip is too degraded to normalize)."""
+    """Steady-state input3 throughput floor with the empirical
+    degraded-window allowance (skipped off-reference-tree or when the
+    chip is too degraded for the allowance's fit to apply)."""
     import bench
 
     path = "/root/reference/input3.txt"
@@ -203,32 +207,36 @@ def perf_floor() -> int:
 
     quiet = bench.QUIET_BF16_BY_KIND.get(jax.devices()[0].device_kind)
     probe0 = bench.mxu_probe_tflops()
-    if probe0 < 100:
-        # Below ~half the quiet roofline the slowdown is dominated by a
-        # heavy co-tenant and the linear probe normalization is itself
-        # unreliable; a pass/fail either way would be noise.
+    # The wall-vs-probe fit's support starts at probe ~133
+    # (scripts/probe_wall_fit.py): below ~130 the x1.2 degraded-window
+    # allowance is unvalidated — inflation there can exceed the bound,
+    # so a pass/fail either way would be noise.
+    fit_support = 130
+    if probe0 < fit_support:
         print(
-            f"perf floor: MXU probe {probe0:.0f} TFLOP/s < 100 — chip "
-            "heavily loaded; normalization unreliable, skipping "
-            "(re-run later)",
+            f"perf floor: MXU probe {probe0:.0f} TFLOP/s < {fit_support} "
+            "— chip heavily loaded; outside the wall-vs-probe fit's "
+            "support, skipping (re-run later)",
             file=sys.stderr,
         )
         return 0
     from mpi_openmp_cuda_tpu.io.parse import load_problem
 
     problem = load_problem(path)
-    wall = bench.steady_state_wall(problem, "pallas", reps=512, medians=1)
+    # Same protocol as the bench record (1024 amortised reps, median of
+    # 3 slopes): the floor must be comparable to the gated quiet band it
+    # was calibrated on — the old 512-rep single-slope read ~30% low.
+    wall = bench.steady_state_wall(problem, "pallas", reps=1024, medians=3)
     probe1 = bench.mxu_probe_tflops()
     probe = min(probe0, probe1)
-    if probe < 100:
+    if probe < fit_support:
         # A co-tenant arriving MID-RUN degrades probe1 the same way a
-        # pre-degraded probe0 would: the uncapped scale-up factor below
-        # would inflate a regressed rate past the floor, so the same
-        # unreliability skip applies to both bracketing probes.
+        # pre-degraded probe0 would: the same fit-support skip applies
+        # to both bracketing probes.
         print(
-            f"perf floor: post-run MXU probe {probe:.0f} TFLOP/s < 100 — "
-            "load arrived mid-measurement; normalization unreliable, "
-            "skipping (re-run later)",
+            f"perf floor: post-run MXU probe {probe:.0f} TFLOP/s < "
+            f"{fit_support} — load arrived mid-measurement; outside the "
+            "fit's support, skipping (re-run later)",
             file=sys.stderr,
         )
         return 0
@@ -236,14 +244,19 @@ def perf_floor() -> int:
         problem.seq1_codes.size, [c.size for c in problem.seq2_codes]
     )
     rate = elems / wall
-    # Scale UP only (a probe reading slightly above the quiet reference
-    # must not shrink a legitimate measurement).
-    factor = max(1.0, quiet / probe) if quiet and probe > 0 else 1.0
+    # Degraded-window allowance: wall is ~flat in the probe (the fit),
+    # so grant at most the empirical inflation bound — never the linear
+    # quiet/probe factor, which overstated ~50% and could hide a real
+    # regression.
+    gate = quiet * bench.PROBE_GATE_FRACTION if quiet else None
+    factor = (
+        bench.WALL_INFLATION_BOUND if gate and probe < gate else 1.0
+    )
     norm = rate * factor
     status = "OK  " if norm >= INPUT3_FLOOR_ELEMS_PER_SEC else "FAIL"
     print(
         f"{status} perf floor: input3 {rate:.2e} elem/s raw, "
-        f"{norm:.2e} quiet-normalized (floor "
+        f"{norm:.2e} with x{factor:g} degraded-window allowance (floor "
         f"{INPUT3_FLOOR_ELEMS_PER_SEC:.1e}; probes {probe0:.0f}/"
         f"{probe1:.0f} TFLOP/s, quiet ref {quiet or float('nan'):.0f})",
         file=sys.stderr,

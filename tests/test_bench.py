@@ -1,17 +1,26 @@
-"""Smoke test for the driver's benchmark hook.
+"""Tests for the driver's benchmark hook.
 
 The round driver runs ``python bench.py`` on real TPU hardware and records
 the single JSON line it prints; a bitrotten bench silently zeroes the
-round's perf record.  This drives the real script as a subprocess on the
-CPU backend with a small fixture workload and asserts the JSON contract.
-"""
+round's perf record.  One test drives the real script as a subprocess on
+the CPU backend and asserts the JSON contract; the rest exercise every
+branch of the measurement protocol — attempt gating, backoff, selection,
+labelling, probe failure, slope spread — off-device with injected
+measure/probe/sleep fakes (VERDICT r3 item 5)."""
 
 import json
 import os
 import subprocess
 import sys
 
+import pytest
+
 from test_cli import ENV, REPO
+
+sys.path.insert(0, REPO)
+
+import bench
+from bench import Attempt, run_attempts, select_attempt, probe_record_fields
 
 
 def test_bench_emits_contract_json_line():
@@ -38,12 +47,211 @@ def test_bench_emits_contract_json_line():
     # pallas backend (real TPU runs).
     assert set(rec) >= {"metric", "value", "unit", "vs_baseline"}
     assert set(rec) <= {"metric", "value", "unit", "vs_baseline",
+                        "e2e_first_run_s", "e2e_warm_s",
                         "real_tflops", "kernel_feed", "mfu_vs_probe",
                         "mxu_probe_bf16_tflops", "probe_quiet_ref_tflops",
                         "probe_gated", "probe_failed",
-                        "value_probe_normalized_est",
+                        "value_quiet_band_est",
                         "feed_roofline_tflops", "feed_roofline_kind",
                         "mfu_vs_feed_roofline"}
+    assert rec["e2e_first_run_s"] >= 0 and rec["e2e_warm_s"] >= 0
     assert rec["unit"] == "elements/s/chip"
     assert rec["value"] > 0 and rec["vs_baseline"] > 0
     assert "stress_small.txt" in rec["metric"]
+
+
+# ---------------------------------------------------------------------------
+# Protocol branch coverage, off-device (injected fakes — no jax involved).
+# ---------------------------------------------------------------------------
+
+GATE = 180.0
+
+
+class Seq:
+    """Deterministic probe/measure fake reading from a value sequence."""
+
+    def __init__(self, values):
+        self.values = list(values)
+        self.calls = 0
+
+    def __call__(self):
+        self.calls += 1
+        return self.values.pop(0)
+
+
+def test_attempts_gated_first_try_stops_immediately():
+    probe = Seq([200.0, 199.0])
+    sleeps = []
+    attempts = run_attempts(
+        Seq([1e-4]), probe, gate=GATE, max_attempts=12,
+        sleep=sleeps.append,
+    )
+    assert len(attempts) == 1
+    assert attempts[0] == Attempt(1e-4, 200.0, 199.0)
+    assert sleeps == []  # no backoff after a gated attempt
+    chosen, gated = select_attempt(attempts, GATE)
+    assert gated and chosen is attempts[0]
+
+
+def test_attempts_gated_late_with_exponential_backoff():
+    # Two busy windows, then a quiet one: the loop must stop at 3 and the
+    # backoff must have doubled from 5 s.
+    probe = Seq([120.0, 130.0, 150.0, 140.0, 195.0, 188.0])
+    sleeps = []
+    attempts = run_attempts(
+        Seq([2e-4, 2e-4, 1.6e-4]), probe, gate=GATE, max_attempts=12,
+        sleep=sleeps.append,
+    )
+    assert len(attempts) == 3
+    assert sleeps == [5.0, 10.0]
+    chosen, gated = select_attempt(attempts, GATE)
+    assert gated
+    assert chosen.wall == 1.6e-4 and chosen.pmin == 188.0
+
+
+def test_attempts_backoff_caps_at_60s():
+    n = 8
+    probe = Seq([100.0] * (2 * n))
+    sleeps = []
+    attempts = run_attempts(
+        Seq([1e-4] * n), probe, gate=GATE, max_attempts=n,
+        sleep=sleeps.append,
+    )
+    assert len(attempts) == n
+    assert sleeps == [5.0, 10.0, 20.0, 40.0, 60.0, 60.0, 60.0]
+
+
+def test_never_gated_selects_closest_to_quiet_not_min_wall():
+    # The r3 failure mode (VERDICT r3 weakness 1): the FASTEST ungated
+    # wall (a slope artifact) must NOT be recorded; the attempt with the
+    # highest bracketing probe must.
+    walls = [1.58e-4, 1.60e-4, 1.56e-4, 1.61e-4, 1.28e-4]
+    probes = [293, 137, 134, 206, 137, 134, 133, 173, 189, 141]
+    sleeps = []
+    attempts = run_attempts(
+        Seq(walls), Seq([float(p) for p in probes]), gate=GATE,
+        max_attempts=5, sleep=sleeps.append,
+    )
+    assert len(attempts) == 5 and len(sleeps) == 4
+    chosen, gated = select_attempt(attempts, GATE)
+    assert not gated
+    # pmin per attempt: 137, 134, 133, 134, 141 -> attempt 5 is closest
+    # to quiet; it happens to also be the artifact wall here, so check
+    # the policy on a reshuffled set too.
+    assert chosen.pmin == 141.0
+    shuffled = [
+        Attempt(1.28e-4, 140.0, 137.0),   # fastest wall, low probe
+        Attempt(1.60e-4, 170.0, 171.0),   # slowest wall, best probe
+        Attempt(1.55e-4, 150.0, 150.0),
+    ]
+    chosen, gated = select_attempt(shuffled, GATE)
+    assert not gated
+    assert chosen.wall == 1.60e-4 and chosen.pmin == 170.0
+
+
+def test_mid_measurement_burst_is_not_gated():
+    # One bracketing probe above the gate is not enough: pmin governs.
+    a = Attempt(1e-4, 200.0, 120.0)
+    assert a.pmin == 120.0
+    chosen, gated = select_attempt([a], GATE)
+    assert not gated
+
+
+def test_probe_failure_breaks_loop_and_labels_record():
+    probe = Seq([None, None])
+    sleeps = []
+    attempts = run_attempts(
+        Seq([1e-4, 1e-4]), probe, gate=GATE, max_attempts=12,
+        sleep=sleeps.append,
+    )
+    assert len(attempts) == 1 and sleeps == []  # retrying cannot gate
+    chosen, gated = select_attempt(attempts, GATE)
+    assert not gated and chosen.pmin is None
+    rec, warn = probe_record_fields(
+        chosen, gated, GATE, 197.0, True, len(attempts), 1e13
+    )
+    assert rec == {"probe_failed": True} and warn is None
+
+
+def test_half_failed_probe_attempt_keeps_looping():
+    # p0 present, p1 failed: pmin None -> ungated, but not the
+    # both-probes-dead break.
+    probe = Seq([200.0, None, 195.0, 199.0])
+    sleeps = []
+    attempts = run_attempts(
+        Seq([1e-4, 1e-4]), probe, gate=GATE, max_attempts=12,
+        sleep=sleeps.append,
+    )
+    assert len(attempts) == 2
+    assert attempts[0].pmin is None and attempts[1].pmin == 195.0
+
+
+def test_median_wall_fallback_when_no_probes_usable():
+    attempts = [
+        Attempt(3e-4, None, None),
+        Attempt(1e-4, None, None),
+        Attempt(2e-4, 150.0, None),
+    ]
+    chosen, gated = select_attempt(attempts, GATE)
+    assert not gated and chosen.wall == 2e-4  # median of sorted walls
+
+
+def test_off_tpu_single_attempt_no_probes():
+    measure = Seq([1e-4])
+    attempts = run_attempts(measure, None, gate=None, max_attempts=12)
+    assert attempts == [Attempt(1e-4, None, None)]
+    chosen, gated = select_attempt(attempts, None)
+    assert not gated
+    rec, warn = probe_record_fields(
+        chosen, gated, None, None, False, 1, 1e13
+    )
+    assert rec == {} and warn is None
+
+
+def test_gated_pool_prefers_fastest_gated_wall():
+    attempts = [
+        Attempt(1.2e-4, 130.0, 130.0),  # faster but ungated
+        Attempt(1.6e-4, 195.0, 190.0),
+        Attempt(1.5e-4, 185.0, 186.0),
+    ]
+    chosen, gated = select_attempt(attempts, GATE)
+    assert gated and chosen.wall == 1.5e-4
+
+
+def test_gated_record_fields():
+    rec, warn = probe_record_fields(
+        Attempt(1.5e-4, 195.0, 185.0), True, GATE, 197.0, True, 1, 4e13
+    )
+    assert rec == {
+        "mxu_probe_bf16_tflops": 185.0,
+        "probe_quiet_ref_tflops": 197.0,
+        "probe_gated": True,
+    }
+    assert warn is None
+
+
+def test_ungated_record_brackets_quiet_band_no_linear_estimate():
+    value = 4.0e13
+    rec, warn = probe_record_fields(
+        Attempt(1.6e-4, 140.0, 137.0), False, GATE, 197.0, True, 12, value
+    )
+    assert rec["probe_gated"] is False
+    lo, hi = rec["value_quiet_band_est"]
+    assert lo == pytest.approx(value)
+    assert hi == pytest.approx(value * bench.WALL_INFLATION_BOUND)
+    # The r3 linear 1/probe normalization is gone for good (VERDICT r3
+    # item 1b: it overestimated the quiet value ~60%).
+    assert "value_probe_normalized_est" not in rec
+    assert warn and "closest-to-quiet" in warn
+    # The old "lower bound" framing is dropped: under interference the
+    # two-point slope can UNDERestimate wall.
+    assert "lower bound" not in warn
+
+
+def test_slope_spread_warning_branches():
+    # Spread above 2.5x with a well-resolved increment: warn.
+    assert bench.slope_spread_warning([1e-4, 3e-4], 1024)
+    # Same spread on a sub-resolution (micro-workload) increment: silent.
+    assert bench.slope_spread_warning([1e-8, 3e-8], 1024) is None
+    # Tight slopes: silent.
+    assert bench.slope_spread_warning([1.5e-4, 1.6e-4], 1024) is None
