@@ -287,6 +287,97 @@ def mxu_probe_tflops(feed: str = "bf16") -> float:
     return 2 * 4096**3 / slope / 1e12
 
 
+# Demonstrated VPU co-issue allowance for the floor (VERDICT r3 item 2).
+# Measured chain pairings on this chip (BASELINE.md "VPU-pass floor"):
+# rotate+add costs ~= rotate alone, (y+1)-(y*3) costs ~1.45x a single
+# add, an add co-issues with casts for free — the hardware overlaps ~2
+# full-width ops but nothing measured ever demonstrated more.  The floor
+# grants every counted pass element the BEST genuine single-op rate
+# times this factor; claiming more overlap would be unsupported.
+VPU_COISSUE = 2.0
+
+
+def vpu_probe_gelems(op: str = "arith") -> float:
+    """Sustained full-width VPU throughput (elements/s) on a
+    VMEM-resident [128, 1536] tile, via a Pallas kernel chaining
+    dependent passes of one stage-class op (VERDICT r3 item 2 — the
+    denominator of the VPU-floor accounting):
+
+    - ``fma``:    f32 ``y * c + d`` — the float pipeline class.
+    - ``arith``:  int32 ``y * 3 + 1`` — the integer pipeline class
+                  (lp subtract, pack, row-max on the packed feed); the
+                  best GENUINE single-op rate observed on this chip, so
+                  the floor's reference rate.
+    - ``rotate``: the strided ``pltpu.roll`` the kernel's shear uses
+                  (int32 — the only data width Mosaic rotates; the
+                  slowest class, ~0.37 Telem/s).
+
+    There is deliberately NO cast probe: an int32->int8->int32 chain is
+    FOLDED by Mosaic (a 4-cast body measured identical to a 2-cast body,
+    207 vs 211 ns/iter — the round trips collapse), so any "cast rate"
+    from such a chain is an artifact; the mix model prices the kernel's
+    single narrowing cast at the arith-class rate instead
+    (scripts/vpu_floor.py).
+
+    Measured rates drift with co-tenant load and MUST be compared only
+    within interleaved same-invocation rounds (3-round medians
+    2026-07-31: fma 0.47-0.52, arith 0.62-0.66, rotate 0.34-0.37
+    Telem/s; ~1 vreg-op/cycle is 0.96e12 lane-elements/s at 940 MHz).
+    The tile width matches the production kernel's sb=12 super-block
+    (sbw = 1536).  Rate comes from the slope between two chain lengths
+    (same protocol as min_wall_slope): launch/prologue cancels.  Chains
+    are long (32K / 1M iterations, ~0.4 s increment) — shorter chains
+    produced ±3x scatter under link jitter.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    W = 1536
+
+    def make(iters):
+        if op == "fma":
+            x0 = jnp.full((128, W), 1.0000001, jnp.float32)
+
+            def body(i, y):
+                return y * 1.0000001 + 1e-7
+
+        elif op == "arith":
+            x0 = jnp.ones((128, W), jnp.int32)
+
+            def body(i, y):
+                return y * 3 + 1
+
+        elif op == "rotate":
+            x0 = jnp.ones((128, W), jnp.int32)
+
+            def body(i, y):
+                return pltpu.roll(y, shift=0, axis=1, stride=1, stride_axis=0) + 1
+
+        else:  # pragma: no cover - caller bug
+            raise ValueError(op)
+
+        def kern(x_ref, o_ref):
+            o_ref[...] = lax.fori_loop(0, iters, body, x_ref[...])
+
+        call = pl.pallas_call(
+            kern, out_shape=jax.ShapeDtypeStruct((128, W), x0.dtype)
+        )
+        return jax.jit(call), x0
+
+    fns = {}
+    for n in (32768, 1048576):
+        f, x0 = make(n)
+        fns[n] = (f, x0)
+        np.asarray(f(x0))  # compile + force
+    slope_per_iter = min_wall_slope(
+        {n: (lambda f=f, x=x: np.asarray(f(x))) for n, (f, x) in fns.items()}
+    )
+    return 128 * W / slope_per_iter
+
+
 def probe_or_none(feed: str = "bf16") -> float | None:
     """Guarded MXU probe: None on failure (preempted / co-tenant-OOMed
     shared chip) or an implausible reading (probe slope swamped by link
@@ -563,6 +654,7 @@ def main() -> None:
         from mpi_openmp_cuda_tpu.ops.pallas_scorer import (
             choose_superblock,
             kernel_mxu_flops,
+            kernel_vpu_pass_elems,
         )
         from mpi_openmp_cuda_tpu.ops.values import value_table
 
@@ -574,23 +666,61 @@ def main() -> None:
         fm = choose_pallas_formulation(val_flat, (padded.l1p, padded.l2p))
         if fm[0] == "pallas":
             feed = fm[1]
+            # ONE sb for both accountings (MFU + VPU floor): two
+            # independent lookups could silently diverge and describe
+            # different walks for the same run.
+            sb = choose_superblock(
+                padded.l1p // 128,
+                padded.l2p // 128,
+                padded.len1,
+                padded.len2,
+                feed,
+            )
             flops = kernel_mxu_flops(
                 padded.len1,
                 [c.size for c in problem.seq2_codes],
                 padded.l1p,
                 padded.l2p,
                 feed,
-                sb=choose_superblock(
-                    padded.l1p // 128,
-                    padded.l2p // 128,
-                    padded.len1,
-                    padded.len2,
-                    feed,
-                ),
+                sb=sb,
             )
             real_tflops = flops / wall / 1e12
             record["real_tflops"] = round(real_tflops, 1)
             record["kernel_feed"] = feed
+            if feed == "i8" and on_tpu:
+                # VPU-pass floor (VERDICT r3 item 2): the kernel is
+                # VPU-pass-bound, so its floor is the irreducible
+                # full-width pass elements (kernel_vpu_pass_elems — the
+                # rotate/cast/build/sub/pack/row-max walk) granted the
+                # best genuine single-op rate (the int32 arith chain)
+                # TIMES the demonstrated ~2-op co-issue allowance
+                # (VPU_COISSUE).  No measurement on this chip supports a
+                # lower floor; the per-stage mix model (each stage at
+                # its own dedicated-chain rate) lands ABOVE the measured
+                # wall, i.e. the kernel already overlaps stages beyond
+                # what isolated chains achieve.  BASELINE.md holds the
+                # full analysis.
+                try:
+                    vrate = vpu_probe_gelems("arith")
+                except Exception as e:
+                    vrate = None
+                    print(
+                        f"[bench] WARNING: VPU probe failed ({e})",
+                        file=sys.stderr,
+                    )
+                if vrate:
+                    passes = kernel_vpu_pass_elems(
+                        padded.len1,
+                        [c.size for c in problem.seq2_codes],
+                        padded.l1p,
+                        padded.l2p,
+                        feed,
+                        sb=sb,
+                    )
+                    floor_s = sum(passes.values()) / (VPU_COISSUE * vrate)
+                    record["vpu_probe_arith_gelems"] = round(vrate / 1e9, 1)
+                    record["vpu_floor_us"] = round(floor_s * 1e6, 1)
+                    record["wall_vs_vpu_floor"] = round(wall / floor_s, 2)
 
     probe = ""
     if real_tflops is not None and probe_min is not None:

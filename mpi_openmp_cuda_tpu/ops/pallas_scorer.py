@@ -294,6 +294,47 @@ def kernel_mxu_flops(
     return 2 * total
 
 
+def kernel_vpu_pass_elems(
+    len1: int, lens2, l1p: int, l2p: int, feed: str, sb: int | None = None
+) -> dict:
+    """Full-width VPU-pass element counts per stage class for one batch
+    call — the numerator of bench.py's VPU-floor accounting (VERDICT r3
+    item 2: "bytes per full-width pass per tile for each stage").
+
+    Mirrors `_kernel`'s walk exactly like :func:`kernel_mxu_flops` does
+    (same live-super-block and tile counts); per executed tile the VPU
+    touches:
+
+    - ``rotate``: one strided rotate over the [128, sbw+128] accumulator
+      (the shear; 32-bit, the only legal Mosaic formulation).
+    - ``cast``: one narrowing int32->int8 pass over the same accumulator
+      (narrow feeds only; the f32 feed's delta subtract is counted in
+      the fma class instead).
+    - ``fma``: the elementwise/reduction remainder at roughly fma-class
+      cost per element — one-hot build (compare + cast on [128, 128]),
+      the lp = pa - pb subtract, the pack-fma, and the row-max
+      reduction, each one pass over [128, sbw].
+
+    Epilogue/carry work on [1, sbw] / [sbw] vectors is ~1/128 of a tile
+    pass and is not counted.  Update in lockstep with any kernel
+    reformulation, or the floor silently lies.
+    """
+    nbn, nbi = l1p // _BLK, l2p // _BLK
+    sb = _superblock(nbn) if sb is None else sb
+    sbw = sb * _BLK
+    per_tile = {
+        "rotate": (sbw + _BLK) * _BLK,
+        "cast": (sbw + _BLK) * _BLK if feed != "f32" else 0,
+        "fma": 2 * _BLK * _BLK + 3 * sbw * _BLK,
+    }
+    tiles = 0
+    for l2 in lens2:
+        l2 = int(l2)
+        t = min(-(-l2 // _BLK), nbi)
+        tiles += _live_superblocks(nbn, sb, len1, l2) * t
+    return {k: v * tiles for k, v in per_tile.items()}
+
+
 def _kernel(
     meta_ref, codes_ref, a_ref, out_ref, *, nbn, nbi, feed, pretiled, sb, pp
 ):

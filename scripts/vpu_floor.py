@@ -1,0 +1,122 @@
+"""The quantitative VPU-pass floor analysis — VERDICT r3 item 2.
+
+Round 3 argued qualitatively that the fused kernel is VPU-pass-bound and
+the r2 perf targets unreachable ("~4 full-width passes per tile, each
+pinned; ~2 must go").  This script makes that quantitative on-device:
+
+1. Measures sustained per-element VPU throughput for each stage CLASS
+   with dependent Pallas chains (``bench.vpu_probe_gelems``), three
+   interleaved rounds (sequential measurements on this shared chip
+   fabricate effects; see BASELINE.md's methodology notes).
+2. Counts the kernel's irreducible full-width pass elements per stage
+   for the workload (``kernel_vpu_pass_elems`` mirrors the production
+   walk tile by tile).
+3. Prints the per-stage mix model, the co-issue floor, and the
+   measured-wall ratios.
+
+Two methodology findings baked in (both measured 2026-07-31, full data
+in BASELINE.md "VPU-pass floor"):
+
+- **Cast chains are un-measurable**: Mosaic folds int32->int8->int32
+  round trips (a 4-cast body timed identical to a 2-cast body, 211 vs
+  207 ns/iter), so the kernel's single narrowing cast is priced at the
+  int-arith class rate instead of a bogus "cast rate".
+- **The VPU co-issues ~2 full-width ops**: rotate+add ~= rotate alone
+  (557 vs 538 ns), (y+1)-(y*3) costs 1.45x a single add (473 vs 325),
+  adds hide under casts.  The floor therefore grants every counted
+  element the best genuine single-op rate x2 (bench.VPU_COISSUE);
+  nothing measured supports more.
+
+Run: ``python scripts/vpu_floor.py`` on the TPU.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import bench  # noqa: E402
+
+OPS = ("fma", "arith", "rotate")
+# Stage-class assignment of kernel_vpu_pass_elems' counters: the packed
+# i8 pipeline's sub/pack/row-max and the one-hot build are int32 ops
+# ('arith'); the narrowing cast is priced at 'arith' too (no genuine
+# cast rate is measurable, see module docstring).
+CLASS_OF = {"rotate": "rotate", "cast": "arith", "fma": "arith"}
+
+
+def main() -> None:
+    from mpi_openmp_cuda_tpu.io.parse import load_problem
+    from mpi_openmp_cuda_tpu.ops.dispatch import pad_problem
+    from mpi_openmp_cuda_tpu.ops.pallas_scorer import (
+        choose_superblock,
+        kernel_vpu_pass_elems,
+    )
+
+    path = os.environ.get("BENCH_INPUT", "/root/reference/input3.txt")
+    problem = load_problem(path)
+    padded = pad_problem(problem.seq1_codes, problem.seq2_codes)
+    sb = choose_superblock(
+        padded.l1p // 128, padded.l2p // 128, padded.len1, padded.len2, "i8"
+    )
+    passes = kernel_vpu_pass_elems(
+        padded.len1,
+        [c.size for c in problem.seq2_codes],
+        padded.l1p,
+        padded.l2p,
+        "i8",
+        sb=sb,
+    )
+
+    p0 = bench.probe_or_none()
+    rates: dict[str, list] = {op: [] for op in OPS}
+    for rnd in range(3):
+        for op in OPS:
+            rates[op].append(bench.vpu_probe_gelems(op))
+        print(
+            f"round {rnd}: "
+            + " ".join(f"{op}={rates[op][-1] / 1e12:.3f}" for op in OPS),
+            file=sys.stderr,
+        )
+    p1 = bench.probe_or_none()
+    med = {op: float(np.median(v)) for op, v in rates.items()}
+
+    total = sum(passes.values())
+    best = max(med.values())
+    floor_s = total / (bench.VPU_COISSUE * best)
+    mix_s = sum(passes[k] / med[CLASS_OF[k]] for k in passes)
+
+    print(f"workload: {os.path.basename(path)}  sb={sb}")
+    print(
+        "stage-class rates (median of 3 interleaved rounds, Telem/s): "
+        + " ".join(f"{op}={med[op] / 1e12:.3f}" for op in OPS)
+        + f"  [probes {p0 or float('nan'):.0f}/{p1 or float('nan'):.0f}]"
+    )
+    for k in passes:
+        t = passes[k] / med[CLASS_OF[k]]
+        print(
+            f"  {k:>6}: {passes[k] / 1e6:7.1f}M elems @ {CLASS_OF[k]} rate"
+            f" -> {t * 1e6:6.1f} us"
+        )
+    print(
+        f"mix model (sum of stages at own dedicated-chain rates): "
+        f"{mix_s * 1e6:.1f} us — the measured wall BEATING this means the "
+        "kernel already overlaps stages better than isolated chains"
+    )
+    print(
+        f"CO-ISSUE FLOOR ({total / 1e6:.0f}M elems at best genuine rate "
+        f"{best / 1e12:.2f} Te/s x {bench.VPU_COISSUE:g} co-issue): "
+        f"{floor_s * 1e6:.1f} us"
+    )
+    print(
+        "gated wall band 150-162 us -> wall_vs_vpu_floor "
+        f"{150e-6 / floor_s:.2f}-{162e-6 / floor_s:.2f}"
+    )
+
+
+if __name__ == "__main__":
+    main()

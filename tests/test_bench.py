@@ -53,7 +53,9 @@ def test_bench_emits_contract_json_line():
                         "probe_gated", "probe_failed",
                         "value_quiet_band_est",
                         "feed_roofline_tflops", "feed_roofline_kind",
-                        "mfu_vs_feed_roofline"}
+                        "mfu_vs_feed_roofline",
+                        "vpu_probe_arith_gelems", "vpu_floor_us",
+                        "wall_vs_vpu_floor"}
     assert rec["e2e_first_run_s"] >= 0 and rec["e2e_warm_s"] >= 0
     assert rec["unit"] == "elements/s/chip"
     assert rec["value"] > 0 and rec["vs_baseline"] > 0
