@@ -116,6 +116,67 @@ def min_wall_slope(progs: dict) -> float:
     return max(walls[ks[1]] - walls[ks[0]], STEADY_CLAMP_FLOOR) / (ks[1] - ks[0])
 
 
+def production_schedule(problem, backend: str):
+    """The bucket schedule the production dispatch would run for this
+    problem — one entry per length bucket (including the r4 row-packing
+    sub-classes) with its padded chunked rows and resolved chunks body.
+
+    SHARED by the steady-state harness (which times it) and the MFU /
+    VPU-floor accounting (which counts it): a single derivation is the
+    only way "the bench times and accounts exactly the production
+    schedule" stays true (r4 code review).  Entries carry the PADDED
+    per-chunk lens — the packed kernel executes super-block 0 even for
+    all-padding tiles, and the accounting must count them.
+    """
+    from mpi_openmp_cuda_tpu.ops.dispatch import (
+        choose_chunk,
+        choose_pallas_formulation,
+        DEFAULT_CHUNK_BUDGET,
+        effective_backend,
+        pad_batch_rows,
+        pad_problem,
+        plan_buckets,
+        resolve_chunks_body,
+        round_up,
+    )
+    from mpi_openmp_cuda_tpu.ops.values import value_table
+
+    val = value_table(problem.weights).astype(np.int32).reshape(-1)
+    packable = backend == "pallas" and choose_pallas_formulation(val, ())[
+        :2
+    ] == ("pallas", "i8")
+    groups = plan_buckets(
+        [c.size for c in problem.seq2_codes], packable=packable
+    )
+    sched = []
+    for key in sorted(groups):
+        codes = [problem.seq2_codes[i] for i in groups[key]]
+        batch = pad_problem(problem.seq1_codes, codes)
+        # Same chunk policy the dispatch layer applies: pallas-sized
+        # chunks only when the kernel actually runs (wide weights route
+        # to gather).
+        cb = choose_chunk(
+            batch, DEFAULT_CHUNK_BUDGET, backend=effective_backend(backend, val)
+        )
+        bp = round_up(batch.batch_size, cb)
+        rows, lens = pad_batch_rows(batch, bp)
+        body = resolve_chunks_body(
+            backend,
+            val,
+            problem_dims=(batch.l1p, batch.l2p, batch.len1, batch.len2),
+        )
+        sched.append(
+            {
+                "batch": batch,
+                "cb": cb,
+                "rows": rows.reshape(bp // cb, cb, batch.l2p),
+                "lens": lens.reshape(bp // cb, cb),
+                "body": body,
+            }
+        )
+    return val, sched
+
+
 def steady_state_wall(problem, backend: str, reps: int, medians: int = 1) -> float:
     """Per-run device wall-clock with host round-trip latency amortised.
 
@@ -135,60 +196,41 @@ def steady_state_wall(problem, backend: str, reps: int, medians: int = 1) -> flo
     import jax.numpy as jnp
     from jax import lax
 
-    from mpi_openmp_cuda_tpu.ops.dispatch import (
-        choose_chunk,
-        DEFAULT_CHUNK_BUDGET,
-        pad_batch_rows,
-        pad_problem,
-        resolve_chunks_body,
-        round_up,
-    )
-    from mpi_openmp_cuda_tpu.ops.values import value_table
-
-    batch = pad_problem(problem.seq1_codes, problem.seq2_codes)
-    val = value_table(problem.weights).astype(np.int32).reshape(-1)
-    b = batch.batch_size
-    # Same chunk policy the dispatch layer applies: pallas-sized chunks
-    # only when the kernel actually runs (wide weights route to gather).
-    from mpi_openmp_cuda_tpu.ops.dispatch import effective_backend
-
-    cb = choose_chunk(
-        batch, DEFAULT_CHUNK_BUDGET, backend=effective_backend(backend, val)
-    )
-    bp = round_up(b, cb)
-    rows, lens = pad_batch_rows(batch, bp)
-    body = resolve_chunks_body(
-        backend,
-        val,
-        problem_dims=(batch.l1p, batch.l2p, batch.len1, batch.len2),
-    )
-    args = (
-        jnp.asarray(batch.seq1ext),
-        jnp.int32(batch.len1),
-        jnp.asarray(rows.reshape(bp // cb, cb, batch.l2p)),
-        jnp.asarray(lens.reshape(bp // cb, cb)),
-        jnp.asarray(val),
-    )
+    val, sched = production_schedule(problem, backend)
+    parts = [part["body"] for part in sched]
+    args_flat = [
+        (
+            jnp.asarray(part["batch"].seq1ext),
+            jnp.int32(part["batch"].len1),
+            jnp.asarray(part["rows"]),
+            jnp.asarray(part["lens"]),
+        )
+        for part in sched
+    ]
+    valj = jnp.asarray(val)
 
     def make(k):
-        def f(seq1ext, len1, rows, lens, val_flat):
+        def f(val_flat, *flat):
             def step(carry, i):
-                r = jnp.roll(rows, i, axis=1)
-                l = jnp.roll(lens, i, axis=1)
-                out = body(seq1ext, len1, r, l, val_flat)
-                return carry + out.sum(), None
+                tot = carry
+                for body, (seq1ext, len1, rows, lens) in zip(parts, flat):
+                    r = jnp.roll(rows, i, axis=1)
+                    l = jnp.roll(lens, i, axis=1)
+                    tot = tot + body(seq1ext, len1, r, l, val_flat).sum()
+                return tot, None
 
             tot, _ = lax.scan(step, jnp.int32(0), jnp.arange(k))
             return tot
 
         return jax.jit(f)
 
+    call_args = (valj, *args_flat)
     fns = {}
     for k in (1, 1 + reps):
         fns[k] = make(k)
-        int(fns[k](*args))  # warm/compile + force, once per program
+        int(fns[k](*call_args))  # warm/compile + force, once per program
 
-    progs = {k: (lambda f=f: int(f(*args))) for k, f in fns.items()}
+    progs = {k: (lambda f=f: int(f(*call_args))) for k, f in fns.items()}
     slopes = [min_wall_slope(progs) for _ in range(max(1, medians))]
     warn = slope_spread_warning(slopes, reps)
     if warn:
@@ -649,7 +691,7 @@ def main() -> None:
     if backend == "pallas" and wall > 50e-6:
         from mpi_openmp_cuda_tpu.ops.dispatch import (
             choose_pallas_formulation,
-            pad_problem,
+            choose_rowpack,
         )
         from mpi_openmp_cuda_tpu.ops.pallas_scorer import (
             choose_superblock,
@@ -658,32 +700,44 @@ def main() -> None:
         )
         from mpi_openmp_cuda_tpu.ops.values import value_table
 
-        padded = pad_problem(problem.seq1_codes, problem.seq2_codes)
         val_flat = value_table(problem.weights).reshape(-1)
-        # Same routing the dispatch layer applies: wide weights or
-        # unaligned buckets fall back to non-kernel bodies, where this
-        # FLOP model would describe work that never ran.
-        fm = choose_pallas_formulation(val_flat, (padded.l1p, padded.l2p))
-        if fm[0] == "pallas":
+        # The FLOP/VPU accounting walks the SAME schedule the steady
+        # measurement timed (production_schedule), chunk by chunk with
+        # each bucket's own sb and row-packing decision — including the
+        # chunk-padding rows, whose all-padding packed tiles still
+        # execute super-block 0.
+        _, sched = production_schedule(problem, backend)
+        flops = 0
+        vpu_elems = 0
+        all_kernel = True
+        for part in sched:
+            sub = part["batch"]
+            # Same routing the dispatch layer applies: wide weights or
+            # unaligned buckets fall back to non-kernel bodies, where
+            # this FLOP model would describe work that never ran.
+            fm = choose_pallas_formulation(val_flat, (sub.l1p, sub.l2p))
+            if fm[0] != "pallas":
+                all_kernel = False
+                break
             feed = fm[1]
-            # ONE sb for both accountings (MFU + VPU floor): two
-            # independent lookups could silently diverge and describe
-            # different walks for the same run.
             sb = choose_superblock(
-                padded.l1p // 128,
-                padded.l2p // 128,
-                padded.len1,
-                padded.len2,
-                feed,
+                sub.l1p // 128, sub.l2p // 128, sub.len1, sub.len2, feed
             )
-            flops = kernel_mxu_flops(
-                padded.len1,
-                [c.size for c in problem.seq2_codes],
-                padded.l1p,
-                padded.l2p,
-                feed,
-                sb=sb,
-            )
+            l2s = choose_rowpack(feed, sub.l2p, sub.len2)
+            for chunk_lens in np.asarray(part["lens"]):
+                flops += kernel_mxu_flops(
+                    sub.len1, chunk_lens, sub.l1p, sub.l2p, feed,
+                    sb=sb, l2s=l2s,
+                )
+                vpu_elems += sum(
+                    kernel_vpu_pass_elems(
+                        sub.len1, chunk_lens, sub.l1p, sub.l2p, feed,
+                        sb=sb, l2s=l2s,
+                    ).values()
+                )
+        if not all_kernel:
+            feed = None
+        if feed is not None:
             real_tflops = flops / wall / 1e12
             record["real_tflops"] = round(real_tflops, 1)
             record["kernel_feed"] = feed
@@ -709,15 +763,7 @@ def main() -> None:
                         file=sys.stderr,
                     )
                 if vrate:
-                    passes = kernel_vpu_pass_elems(
-                        padded.len1,
-                        [c.size for c in problem.seq2_codes],
-                        padded.l1p,
-                        padded.l2p,
-                        feed,
-                        sb=sb,
-                    )
-                    floor_s = sum(passes.values()) / (VPU_COISSUE * vrate)
+                    floor_s = vpu_elems / (VPU_COISSUE * vrate)
                     record["vpu_probe_arith_gelems"] = round(vrate / 1e9, 1)
                     record["vpu_floor_us"] = round(floor_s * 1e6, 1)
                     record["wall_vs_vpu_floor"] = round(wall / floor_s, 2)

@@ -109,6 +109,23 @@ re-litigate without new Mosaic capabilities):
   [127, sbw+128) (~8% less cast area) — does not reproduce across
   interleaved passes (+2.8/-5.7%): the misaligned slice source costs
   the realignment what the area saves.
+
+Adopted r4: **row packing** (`_kernel_packed`) — single-char-block
+buckets whose every pair has len2 <= 64 pack p = 128/l2s pairs per
+tile.  The affine strided rotate gives each l2s-row segment a uniform
+extra rotation of j*l2s, so segment diagonals land CYCLICALLY permuted
+in the lane axis; with a block-diagonal ltri and the prefix matmul run
+over the full W = sbw+128 lanes (ONE matmul — prefix commutes with the
+lane shift, so prefix(d1) = roll(prefix(d0), 1 lane); the d1-adjacency
+seam sits at offsets >= n0+sbw+128-l2s, outside the per-block window —
+cell-verified in scripts/rowpack_proto.py), every (segment, offset,
+kappa) cell is exact.  The per-lane argmax packs an offset-ORDER key
+(sbw-1-(n-n0)) instead of the raw lane index to keep the reference
+first-hit tie-break.  input4: 40.2 us gated vs r3's 75.1 (+87%
+throughput); packable-subset interleaved A/B reads packed 1.8-3.2x
+unpacked.  i8 feed only; dispatch buckets rows into packing classes
+{8, 16, 32, 64} so a long straggler splits off instead of blocking the
+batch (ops/dispatch.py::plan_buckets / choose_rowpack).
 """
 
 from __future__ import annotations
@@ -263,8 +280,28 @@ def _choose_superblock_cached(
     return best_sb if best_sb is not None else _superblock(nbn)
 
 
+def _packed_tile_superblocks(
+    lens2, nbn: int, sb: int, len1: int, l2s: int
+) -> int:
+    """Total executed super-blocks across the row-packed tiles: pairs
+    pack p = 128/l2s at a time IN ORDER, and each tile's block-skip gate
+    uses the tile's live minimum length (matching `_kernel_packed`)."""
+    p = _BLK // l2s
+    lens_list = [int(x) for x in lens2]
+    total = 0
+    for t0 in range(0, len(lens_list), p):
+        seg = [x for x in lens_list[t0 : t0 + p] if x > 0]
+        # An all-padding tile still executes super-block 0 (the kernel
+        # runs nb == 0 unconditionally; its l2min gate only skips later
+        # blocks) — count it, or chunk-padded batches under-report
+        # (accounting lockstep: callers pass the PADDED per-chunk lens).
+        total += _live_superblocks(nbn, sb, len1, min(seg)) if seg else 1
+    return total
+
+
 def kernel_mxu_flops(
-    len1: int, lens2, l1p: int, l2p: int, feed: str, sb: int | None = None
+    len1: int, lens2, l1p: int, l2p: int, feed: str, sb: int | None = None,
+    l2s: int | None = None,
 ) -> int:
     """MXU FLOPs (2 x MACs) the fused kernel ISSUES for one batch — the
     accounting for bench.py's true-MFU line (VERDICT r1 §1).
@@ -275,12 +312,20 @@ def kernel_mxu_flops(
     r3 'tail1' walk: 2-wide even part + a 1-wide tail for odd counts —
     no rounded-up overhang tiles on any feed), each tile one one-hot
     matmul ([128, 128] @ [128, sbw + 128]) plus the prefix matmuls (two
-    on the narrow feeds, one fused on f32).  Update in lockstep with any
-    kernel reformulation, or the MFU line silently lies.
+    on the narrow feeds, one fused on f32).  ``l2s`` switches to the
+    row-packed walk (`_kernel_packed`): p pairs per tile, one one-hot
+    and ONE full-W block-diagonal prefix matmul per executed tile.
+    Update in lockstep with any kernel reformulation, or the MFU line
+    silently lies.
     """
     nbn, nbi = l1p // _BLK, l2p // _BLK
     sb = _superblock(nbn) if sb is None else sb
     sbw = sb * _BLK
+    if l2s is not None:
+        per_tile = 2 * _BLK * _BLK * (sbw + _BLK)  # one-hot + prefix, full W
+        return 2 * per_tile * _packed_tile_superblocks(
+            lens2, nbn, sb, len1, l2s
+        )
     prefix_matmuls = 1 if feed == "f32" else 2
     per_tile = _BLK * _BLK * (sbw + _BLK) + prefix_matmuls * _BLK * _BLK * sbw
     total = 0
@@ -295,7 +340,8 @@ def kernel_mxu_flops(
 
 
 def kernel_vpu_pass_elems(
-    len1: int, lens2, l1p: int, l2p: int, feed: str, sb: int | None = None
+    len1: int, lens2, l1p: int, l2p: int, feed: str, sb: int | None = None,
+    l2s: int | None = None,
 ) -> dict:
     """Full-width VPU-pass element counts per stage class for one batch
     call — the numerator of bench.py's VPU-floor accounting (VERDICT r3
@@ -316,12 +362,28 @@ def kernel_vpu_pass_elems(
       reduction, each one pass over [128, sbw].
 
     Epilogue/carry work on [1, sbw] / [sbw] vectors is ~1/128 of a tile
-    pass and is not counted.  Update in lockstep with any kernel
-    reformulation, or the floor silently lies.
+    pass and is not counted on the UNPACKED walk; the packed walk
+    (``l2s`` set, mirroring `_kernel_packed`) runs p per-segment [1, W]
+    epilogues per tile, which at p = 16 exceed a full-width pass and ARE
+    counted (~10 thin passes per segment).  Update in lockstep with any
+    kernel reformulation, or the floor silently lies.
     """
     nbn, nbi = l1p // _BLK, l2p // _BLK
     sb = _superblock(nbn) if sb is None else sb
     sbw = sb * _BLK
+    if l2s is not None:
+        p = _BLK // l2s
+        W = sbw + _BLK
+        per_tile = {
+            # the shear + the cyclic rollP lane shift
+            "rotate": 2 * W * _BLK,
+            "cast": W * _BLK,
+            # one-hot build + g subtract + gpack + segmented row-max
+            # + p thin per-segment epilogues
+            "fma": 2 * _BLK * _BLK + 3 * W * _BLK + 10 * p * W,
+        }
+        tiles = _packed_tile_superblocks(lens2, nbn, sb, len1, l2s)
+        return {k: v * tiles for k, v in per_tile.items()}
     per_tile = {
         "rotate": (sbw + _BLK) * _BLK,
         "cast": (sbw + _BLK) * _BLK if feed != "f32" else 0,
@@ -874,11 +936,270 @@ def _pallas_best(seq1ext, len1, rows, lens, val_flat, feed="f32", sb=None):
     )
 
 
-def _pallas_rows(seq1ext, len1, rows, lens, val_flat, feed="f32", sb=None):
-    """Score a [B, L2P] padded batch with the fused kernel; returns [B, 3]."""
-    best, bn, bk, eq = _pallas_best(
-        seq1ext, len1, rows, lens, val_flat, feed=feed, sb=sb
+def _kernel_packed(meta_ref, codes_ref, a_ref, out_ref, *, nbn, pretiled, sb, l2s):
+    """Row-packed grid cell: p = 128/l2s pairs share ONE [128, W] tile
+    (VERDICT r3 item 3 — tiny-Seq2 batches wasted rows 82..127 of every
+    tile; the full-width stage passes now amortise over p pairs).
+
+    The affine strided rotate gives segment j (rows [j*l2s, (j+1)*l2s))
+    an extra uniform rotation of j*l2s, so its diagonals land CYCLICALLY
+    shifted in the lane axis; with a block-diagonal ltri and the prefix
+    matmul run over the FULL W = sbw+128 lanes, every (segment, offset,
+    kappa) cell inside the per-block window [n0, n0+sbw) is exact —
+    including the wrapped low lanes — because the rotate is cyclic over
+    in-band data (validated cell-by-cell in scripts/rowpack_proto.py;
+    the d1 seam only appears at offsets >= n0+sbw+128-l2s, outside the
+    window).  One full-W prefix matmul replaces the unpacked pa/pb pair:
+    prefix commutes with the lane shift, so pb = roll(P, 1 lane) and
+    lp = P - roll(P).  The per-lane argmax packs an offset-ORDER key
+    (sbw-1 - (n-n0)) instead of the raw lane index: segment j's lanes
+    are cyclically permuted, so the lane index no longer orders offsets
+    and the first-hit tie-break would break without it.
+
+    i8-feed only (gated at dispatch): values |v| <= 127, scores
+    |g| <= l2s*127 <= 8128, packs < 2^26 — every packing exact."""
+    p = _BLK // l2s
+    sbw = sb * _BLK
+    W = sbw + _BLK
+    _KB = 4096
+    klb = max((sbw - 1).bit_length(), 1)
+    neg32 = jnp.int32(-(2**31 - 1))
+    len1 = meta_ref[0]
+    l2 = [meta_ref[1 + pl.program_id(0) * p + j] for j in range(p)]
+    # Block-skip gate: a later super-block is dead when n0 >= len1 - l2
+    # for EVERY live segment; padded segments (l2 = 0) must not hold
+    # blocks alive, so they map to a huge length.
+    big = jnp.int32(1 << 20)
+    l2min = functools.reduce(
+        jnp.minimum, [jnp.where(x > 0, x, big) for x in l2]
     )
+
+    ri1 = lax.broadcasted_iota(jnp.int32, (_BLK, _BLK), 0)
+    ci1 = lax.broadcasted_iota(jnp.int32, (_BLK, _BLK), 1)
+    liw = lax.broadcasted_iota(jnp.int32, (1, W), 1)
+    # Block-diagonal ltri: prefix sums stay segment-local.
+    ltri_bd = ((ri1 >= ci1) & (ri1 // l2s == ci1 // l2s)).astype(jnp.int8)
+    # kappa bits use the row index WITHIN the segment.
+    rloc = lax.broadcasted_iota(jnp.int32, (_BLK, W), 0) & (l2s - 1)
+    ohb = codes_ref[0, 0, :, :] == ci1
+
+    bscore = [None] * p
+    bn = [None] * p
+    bk = [None] * p
+    eqv = [None] * p
+
+    for nb in range(0, nbn, sb):
+        n0 = nb * _BLK
+        slot = nb // sb
+
+        def cands(n0=n0, slot=slot):
+            if pretiled:
+                aband = a_ref[slot, :, :]
+            else:
+                astart = pl.multiple_of(a_ref.shape[1] - n0 - W, _BLK)
+                aband = a_ref[:, pl.ds(astart, W)]
+            vp = jnp.dot(
+                ohb.astype(jnp.int8), aband, preferred_element_type=jnp.int32
+            )
+            vp2 = pltpu.roll(vp, shift=0, axis=1, stride=1, stride_axis=0)
+            vb = vp2.astype(jnp.int8)
+            P = jnp.dot(ltri_bd, vb, preferred_element_type=jnp.int32)
+            # prefix(d1) = prefix(d0) shifted one lane (cyclic): the band
+            # is contiguous, so the cyclic neighbour IS position+1 inside
+            # the window (rowpack_proto.py part 1).
+            rollP = pltpu.roll(P, shift=1, axis=1)
+            g = P - rollP
+            gpack = g * _KB + ((_KB - 2) - rloc)
+            out = []
+            for j in range(p):
+                rend = (j + 1) * l2s - 1
+                seg = gpack[j * l2s : (j + 1) * l2s, :]
+                rmax = jnp.max(seg, axis=0, keepdims=True)  # [1, W]
+                kap = (_KB - 1) - (rmax & (_KB - 1))
+                gdec = rmax // _KB
+                endg = g[rend : rend + 1, :]
+                t1v = rollP[rend : rend + 1, :]
+                kvec = jnp.where(endg == gdec, 0, kap)  # k=0 wins ties
+                # Segment j's cyclic lane -> offset map (static shift).
+                tmp = (sbw + _BLK - 1 + j * l2s) - liw
+                nrel = jnp.where(tmp >= W, tmp - W, tmp)  # n - n0
+                # Offset-order key: bigger key = smaller n = first hit.
+                key = (sbw - 1) - nrel
+                sv = t1v + gdec
+                valid = (nrel < sbw) & (n0 + nrel < len1 - l2[j])
+                spack = jnp.where(valid, sv * (1 << klb) + key, neg32)
+                best = jnp.max(spack, axis=1, keepdims=True)  # [1, 1]
+                kstar_key = best & ((1 << klb) - 1)
+                sj = jnp.where(
+                    best == neg32,
+                    jnp.float32(_NEG),
+                    (best >> klb).astype(jnp.float32),
+                )
+                nj = n0 + (sbw - 1) - kstar_key
+                # key is unique among valid lanes (lane->n is a cyclic
+                # bijection), so this sum selects exactly the winner.
+                kj = jnp.sum(
+                    jnp.where(valid & (key == kstar_key), kvec, 0),
+                    axis=1,
+                    keepdims=True,
+                )
+                ej = jnp.sum(
+                    jnp.where(
+                        (nrel == 0) & (n0 == 0),
+                        (t1v + endg).astype(jnp.float32),
+                        0.0,
+                    ),
+                    axis=1,
+                    keepdims=True,
+                )
+                out.extend([sj, nj.astype(jnp.float32), kj.astype(jnp.float32), ej])
+            return tuple(out)
+
+        if nb == 0:
+            flat = cands()
+        else:
+            dead = tuple(
+                jnp.full((1, 1), _NEG if i % 4 == 0 else 0.0, jnp.float32)
+                for i in range(4 * p)
+            )
+            flat = lax.cond(n0 < len1 - l2min, cands, lambda: dead)
+        for j in range(p):
+            sj, nj, kj, ej = flat[4 * j : 4 * j + 4]
+            if nb == 0:
+                bscore[j], bn[j], bk[j], eqv[j] = sj, nj, kj, ej
+            else:
+                upd = sj > bscore[j]
+                bscore[j] = jnp.where(upd, sj, bscore[j])
+                bn[j] = jnp.where(upd, nj, bn[j])
+                bk[j] = jnp.where(upd, kj, bk[j])
+
+    lo = lax.broadcasted_iota(jnp.int32, (1, _BLK), 1)
+    for j in range(p):
+        vec = jnp.where(
+            lo == 0,
+            bscore[j],
+            jnp.where(
+                lo == 1,
+                bn[j],
+                jnp.where(lo == 2, bk[j], jnp.where(lo == 3, eqv[j], 0.0)),
+            ),
+        )
+        out_ref[j, :, :] = vec
+
+
+@functools.lru_cache(maxsize=32)
+def _pallas_call_packed(
+    nbn: int, wneed: int, tiles: int, interpret: bool, sb: int, l2s: int
+):
+    pretiled = _pretile_ok(nbn, 1, "i8", sb)
+    p = _BLK // l2s
+    kernel = functools.partial(
+        _kernel_packed, nbn=nbn, pretiled=pretiled, sb=sb, l2s=l2s
+    )
+    slots = nbn // sb
+    bandw = sb * _BLK + _BLK
+    a_spec = (
+        pl.BlockSpec((slots, _BLK, bandw), lambda t, lens: (0, 0, 0))
+        if pretiled
+        else pl.BlockSpec((_BLK, wneed), lambda t, lens: (0, 0))
+    )
+    return pl.pallas_call(
+        kernel,
+        interpret=interpret,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,  # [1 + tiles*p] int32 [len1, lens...]
+            grid=(tiles,),
+            in_specs=[
+                pl.BlockSpec((1, 1, _BLK, 1), lambda t, lens: (t, 0, 0, 0)),
+                a_spec,
+            ],
+            out_specs=[
+                pl.BlockSpec((p, 1, _BLK), lambda t, lens: (t, 0, 0)),
+            ],
+        ),
+        out_shape=[
+            jax.ShapeDtypeStruct((tiles * p, 1, _BLK), jnp.float32),
+        ],
+    )
+
+
+def _pallas_best_packed(seq1ext, len1, rows, lens, val_flat, sb=None, l2s=64):
+    """Row-packed variant of :func:`_pallas_best` for nbi == 1 buckets
+    whose every pair has len2 <= l2s (i8 feed only; enforced at
+    dispatch).  Same return contract; p = 128/l2s pairs per tile."""
+    b, l2p = rows.shape
+    assert l2p == _BLK, l2p
+    w = seq1ext.shape[0] - l2p - 1
+    nbn = w // _BLK
+    wneed = w + l2p
+    sb = _superblock(nbn) if sb is None else sb
+    p = _BLK // l2s
+    tiles = -(-b // p)
+
+    val27 = val_flat.reshape(ALPHABET_SIZE, ALPHABET_SIZE).astype(jnp.float32)
+    val27 = val27.at[0, :].set(0.0).at[:, 0].set(0.0)
+    oh1 = (
+        seq1ext[:wneed, None].astype(jnp.int32)
+        == jnp.arange(ALPHABET_SIZE, dtype=jnp.int32)[None, :]
+    ).astype(jnp.float32)
+    a_small = lax.dot_general(
+        val27, oh1, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    a_ext = (
+        jnp.zeros((_BLK, wneed), jnp.float32)
+        .at[:ALPHABET_SIZE]
+        .set(a_small[:, ::-1])
+    ).astype(jnp.int8)
+    if _pretile_ok(nbn, 1, "i8", sb):
+        sbw = sb * _BLK
+        bandw = sbw + _BLK
+        a_in = jnp.stack(
+            [
+                lax.slice_in_dim(
+                    a_ext, wneed - n0 - bandw, wneed - n0, axis=1
+                )
+                for n0 in range(0, nbn * _BLK, sbw)
+            ]
+        )
+    else:
+        a_in = a_ext
+
+    # Pack p pairs' first l2s code columns into each tile's 128 rows
+    # (columns >= l2s are zero for every pair by the l2s bound).
+    rows_p = jnp.zeros((tiles * p, l2s), rows.dtype).at[:b].set(rows[:, :l2s])
+    codes = rows_p.astype(jnp.int32).reshape(tiles, 1, _BLK, 1)
+    lens_p = jnp.zeros((tiles * p,), jnp.int32).at[:b].set(
+        lens.astype(jnp.int32)
+    )
+    meta = jnp.concatenate(
+        [jnp.reshape(len1, (1,)).astype(jnp.int32), lens_p]
+    )
+
+    interpret = jax.default_backend() != "tpu"
+    out = _pallas_call_packed(nbn, wneed, tiles, interpret, sb, l2s)(
+        meta, codes, a_in
+    )[0][:b, 0, :]
+    return (
+        out[:, 0],
+        out[:, 1].astype(jnp.int32),
+        out[:, 2].astype(jnp.int32),
+        out[:, 3],
+    )
+
+
+def _pallas_rows(seq1ext, len1, rows, lens, val_flat, feed="f32", sb=None, l2s=None):
+    """Score a [B, L2P] padded batch with the fused kernel; returns [B, 3].
+    ``l2s`` (dispatch-gated: i8 feed, L2P == 128, all len2 <= l2s) routes
+    to the row-packed kernel."""
+    if l2s is not None:
+        best, bn, bk, eq = _pallas_best_packed(
+            seq1ext, len1, rows, lens, val_flat, sb=sb, l2s=l2s
+        )
+    else:
+        best, bn, bk, eq = _pallas_best(
+            seq1ext, len1, rows, lens, val_flat, feed=feed, sb=sb
+        )
 
     # O(B)-scalar epilogue: equal-length / unsearchable selection (the
     # offset masking and argmax happen inside the kernel).
@@ -899,13 +1220,16 @@ def _shapes_supported(l1p: int, l2p: int) -> bool:
 
 
 def score_chunks_pallas_body(
-    seq1ext, len1, seq2_chunks, len2_chunks, val_flat, *, feed="f32", sb=None
+    seq1ext, len1, seq2_chunks, len2_chunks, val_flat, *, feed="f32", sb=None,
+    l2s=None,
 ):
     """Chunked-batch entry, same contract as the XLA bodies:
     [NC, CB, L2P] -> [NC, CB, 3].  Falls back to the XLA matmul body for
     non-128-aligned shape buckets (tiny problems).  ``feed`` must come
     from ``mxu_feed(val_flat)`` on concrete weights (checked at dispatch
-    sites; this body may be traced with abstract values)."""
+    sites; this body may be traced with abstract values).  ``l2s``
+    routes to the row-packed kernel (dispatch-gated: i8 feed,
+    L2P == 128, every len2 <= l2s)."""
     nc, cb, l2p = seq2_chunks.shape
     l1p = seq1ext.shape[0] - l2p - 1
     if not _shapes_supported(l1p, l2p):
@@ -929,12 +1253,13 @@ def score_chunks_pallas_body(
         val_flat,
         feed=feed,
         sb=sb,
+        l2s=l2s,
     )
     return out.reshape(nc, cb, 3)
 
 
 score_chunks_pallas = jax.jit(
-    score_chunks_pallas_body, static_argnames=("feed", "sb")
+    score_chunks_pallas_body, static_argnames=("feed", "sb", "l2s")
 )
 
 

@@ -1,0 +1,240 @@
+"""Numpy prototype of the row-packed tile (VERDICT r3 item 3).
+
+Goal: pack p = 128/l2s short pairs (len2 <= l2s) into ONE [128, W] tile
+of the fused kernel, so the per-tile full-width stage passes amortise
+over p pairs instead of 1.  The kernel's shear is an AFFINE strided
+rotate (shift = row index r), so segment j (rows [j*l2s, (j+1)*l2s)))
+picks up an extra uniform rotation of j*l2s: its diagonals land
+cyclically shifted in the lane axis.  This prototype verifies, in exact
+integer numpy, which (segment, offset) cells survive the cyclic algebra
+with a block-diagonal prefix matmul over the FULL W lanes:
+
+    vp[r, w]  = value(c[r], seq1[n0 + sbw + 127 - w])   (one-hot matmul)
+    vp2[r, m] = vp[r, (m - r) mod W]                    (strided rotate)
+    P = Lbd @ vp2      (block-diagonal ltri: segment-local prefix sums)
+
+and for segment j, offset n: d0 lane m0 = (sbw + 127 - (n - n0) + j*l2s) mod W,
+d1 lane m1 = (m0 - 1) mod W,
+
+    score(n, k) = P[rend, m1] + (P[j*l2s + k - 1, m0] - P[j*l2s + k - 1, m1])
+    score(n, 0) = P[rend, m0]          (rend = (j+1)*l2s - 1)
+
+The expected seam: ONE offset per segment per tile where the d1 lane
+wraps across the band's cyclic edge and the adjacency breaks.  The
+prototype locates it empirically so the kernel design can mask or
+re-derive it.
+"""
+
+from __future__ import annotations
+
+import sys
+
+import numpy as np
+
+rng = np.random.default_rng(7)
+
+# Small but non-trivial config: W must exceed every rotate shift.
+SBW = 256          # one super-block's offset lanes (sb = 2)
+BLK = 128
+W = SBW + BLK
+L2S = 32           # sub-tile height -> p = 4 segments
+P_SEG = BLK // L2S
+LEN1 = 300
+N0 = 0             # super-block base offset
+
+seq1 = rng.integers(1, 27, size=LEN1).astype(np.int32)
+seq1ext = np.zeros(LEN1 + 2 * BLK + 1, np.int32)
+seq1ext[:LEN1] = seq1
+val = rng.integers(-9, 10, size=(27, 27)).astype(np.int64)
+val[0, :] = 0
+val[:, 0] = 0
+
+lens = [rng.integers(5, L2S + 1) for _ in range(P_SEG)]
+codes = np.zeros((BLK,), np.int32)
+for j, l2 in enumerate(lens):
+    codes[j * L2S : j * L2S + l2] = rng.integers(1, 27, size=l2)
+
+
+def direct_scores(j: int, n: int):
+    """Reference semantics for segment j at offset n: score(k) for
+    k = 0 (hyphen after end) and 1..l2 (hyphen after char k)."""
+    l2 = lens[j]
+    c = codes[j * L2S : j * L2S + l2]
+    d0 = np.array([val[c[i], seq1ext[i + n]] for i in range(l2)])
+    d1 = np.array([val[c[i], seq1ext[i + n + 1]] for i in range(l2)])
+    out = {0: d0.sum()}
+    for k in range(1, l2 + 1):
+        out[k] = d0[:k].sum() + d1[k:].sum()
+    return out
+
+
+# ---- the packed tile pipeline (exact integer) -------------------------
+vp = np.zeros((BLK, W), np.int64)
+for r in range(BLK):
+    for w in range(W):
+        pos = N0 + SBW + BLK - 1 - w
+        vp[r, w] = val[codes[r], seq1ext[pos]]
+
+vp2 = np.zeros_like(vp)
+for r in range(BLK):
+    vp2[r] = np.roll(vp[r], r)  # rotate right by r == vp[r, (m - r) % W]
+
+Lbd = np.zeros((BLK, BLK), np.int64)
+for r in range(BLK):
+    for r2 in range(BLK):
+        if r >= r2 and r // L2S == r2 // L2S:
+            Lbd[r, r2] = 1
+P = Lbd @ vp2  # [BLK, W] segment-local prefix sums per lane
+
+# ---- verify every (segment, offset, kappa) ----------------------------
+bad = {}
+good = 0
+for j in range(P_SEG):
+    l2 = lens[j]
+    rend = (j + 1) * L2S - 1
+    for n in range(N0, min(N0 + SBW + BLK, LEN1 - l2)):
+        m0 = (SBW + BLK - 1 - (n - N0) + j * L2S) % W
+        m1 = (m0 - 1) % W
+        ref = direct_scores(j, n)
+        got = {0: P[rend, m0]}
+        for k in range(1, l2 + 1):
+            got[k] = P[rend, m1] + (P[j * L2S + k - 1, m0] - P[j * L2S + k - 1, m1])
+        mism = [k for k in ref if ref[k] != got[k]]
+        if mism:
+            bad.setdefault(j, []).append((n, len(mism)))
+        else:
+            good += 1
+
+print(f"segments={P_SEG} l2s={L2S} sbw={SBW} lens={lens}")
+print(f"clean (segment, offset) cells: {good}")
+for j, cells in bad.items():
+    ns = [n for n, _ in cells]
+    print(
+        f"segment {j}: {len(cells)} broken offsets; "
+        f"n ∈ [{min(ns)}, {max(ns)}] -> {ns[:12]}{'...' if len(ns) > 12 else ''}"
+    )
+if not bad:
+    print("NO seam anywhere — cyclic adjacency holds at every lane")
+if bad:
+    sys.exit(1)
+
+
+# ======================================================================
+# Part 2: full packed-kernel walk (multi-super-block, epipack argmax with
+# the offset-order-preserving key, k=0 rule, per-segment masks) vs the
+# reference tie-break semantics.  This IS the kernel blueprint.
+# ======================================================================
+
+def reference_best(c, l2, seq1, len1, val):
+    """Reference argmax: offset-major, k=0 first then k ascending,
+    strict-> update (SURVEY A.3)."""
+    s1 = np.zeros(len(seq1) + 2 * BLK + 2, np.int64)
+    s1[: len(seq1)] = seq1
+    best = (-(1 << 60), 0, 0)
+    for n in range(0, len1 - l2):
+        d0 = np.array([val[c[i], s1[i + n]] for i in range(l2)])
+        d1 = np.array([val[c[i], s1[i + n + 1]] for i in range(l2)])
+        cands = [(int(d0.sum()), 0)] + [
+            (int(d0[:k].sum() + d1[k:].sum()), k) for k in range(1, l2 + 1)
+        ]
+        for s, k in cands:
+            if s > best[0]:
+                best = (s, n, k)
+    return best
+
+
+def packed_kernel_walk(codes128, lens_seg, seq1, len1, val, l2s, sbw, nbn):
+    """Simulate the packed kernel exactly as it will be implemented."""
+    p = BLK // l2s
+    W = sbw + BLK
+    KB = 4096
+    klb = max((sbw - 1).bit_length(), 1)
+    s1ext = np.zeros(nbn * BLK + BLK + 1, np.int64)
+    s1ext[: len(seq1)] = seq1
+
+    Lbd = np.zeros((BLK, BLK), np.int64)
+    for r in range(BLK):
+        for r2 in range(BLK):
+            if r >= r2 and r // l2s == r2 // l2s:
+                Lbd[r, r2] = 1
+    ri_local = np.arange(BLK) & (l2s - 1)
+
+    best = [(-(1 << 60), 0, 0) for _ in range(p)]
+    eq = [0] * p
+    for nb in range(0, nbn, max(1, sbw // BLK)):
+        n0 = nb * BLK
+        if n0 and n0 >= len1 - min(l for l in lens_seg if l > 0):
+            break
+        # band: lane w <-> position n0 + sbw + 127 - w
+        pos = n0 + sbw + BLK - 1 - np.arange(W)
+        vp = val[codes128[:, None], s1ext[pos][None, :].astype(np.int64).clip(0)]
+        vp = val[codes128[:, None], s1ext[pos][None, :]]
+        vp2 = np.stack([np.roll(vp[r], r) for r in range(BLK)])
+        P = Lbd @ vp2
+        rollP = np.roll(P, 1, axis=1)
+        g = P - rollP
+        gpack = g * KB + ((KB - 2) - ri_local[:, None])
+        for j in range(p):
+            l2 = lens_seg[j]
+            if l2 == 0:
+                continue
+            rend = (j + 1) * l2s - 1
+            seg = gpack[j * l2s : (j + 1) * l2s, :]
+            rmax = seg.max(axis=0)  # [W]
+            kap = (KB - 1) - (rmax & (KB - 1))
+            gdec = rmax >> int(np.log2(KB))
+            endg = g[rend, :]
+            t1v = rollP[rend, :]
+            kvec = np.where(endg == gdec, 0, kap)
+            tmp = (sbw + BLK - 1 + j * l2s) - np.arange(W)
+            nvec = n0 + np.where(tmp >= W, tmp - W, tmp)
+            key = (sbw - 1) - (nvec - n0)
+            sv = t1v + gdec
+            valid = (nvec - n0 < sbw) & (nvec < len1 - l2)
+            spack = np.where(valid, sv * (1 << klb) + key, -(2**31 - 1))
+            bm = spack.max()
+            if bm == -(2**31 - 1):
+                continue
+            kstar = int(bm & ((1 << klb) - 1))
+            sstar = int(bm >> klb)
+            nstar = n0 + (sbw - 1) - kstar
+            m = int(np.argmax(spack))  # any lane achieving bm: decode k
+            # kappa of the winning lane: find lane with key == kstar & valid
+            lane = np.where(valid & (key == (bm & ((1 << klb) - 1))))[0]
+            kwin = int(kvec[lane[0]])
+            if n0 == 0:
+                eq[j] = int(t1v[np.where(nvec == 0)[0][0]] + endg[np.where(nvec == 0)[0][0]])
+            if sstar > best[j][0]:
+                best[j] = (sstar, nstar, kwin)
+    return best, eq
+
+
+fails = 0
+trials = 0
+for trial in range(60):
+    l2s_t = [8, 16, 32, 64][trial % 4]
+    p_t = BLK // l2s_t
+    sb_t = [1, 2, 3][trial % 3]
+    sbw_t = sb_t * BLK
+    nbn_t = rng.integers(sb_t, 4) * sb_t // sb_t * sb_t  # multiple of sb
+    nbn_t = max(sb_t, int(nbn_t))
+    len1_t = int(rng.integers(max(l2s_t + 2, (nbn_t - 1) * BLK + 1), nbn_t * BLK + 1))
+    seq1_t = rng.integers(1, 27, size=len1_t).astype(np.int64)
+    lens_t = [int(rng.integers(1, l2s_t + 1)) for _ in range(p_t)]
+    if trial % 7 == 0:
+        lens_t[0] = 0  # padded dead segment
+    codes_t = np.zeros(BLK, np.int64)
+    for j, l2 in enumerate(lens_t):
+        codes_t[j * l2s_t : j * l2s_t + l2] = rng.integers(1, 27, size=l2)
+    got, _eq = packed_kernel_walk(codes_t, lens_t, seq1_t, len1_t, val, l2s_t, sbw_t, nbn_t)
+    for j, l2 in enumerate(lens_t):
+        if l2 == 0 or len1_t - l2 <= 0:
+            continue
+        trials += 1
+        ref = reference_best(codes_t[j * l2s_t : j * l2s_t + l2], l2, seq1_t, len1_t, val)
+        if got[j] != ref:
+            fails += 1
+            if fails <= 5:
+                print(f"MISMATCH trial {trial} seg {j} l2s={l2s_t} sb={sb_t} "
+                      f"nbn={nbn_t} len1={len1_t} l2={l2}: got {got[j]} ref {ref}")
+print(f"part 2: {trials - fails}/{trials} segments exact")
