@@ -121,8 +121,9 @@ seam sits at offsets >= n0+sbw+128-l2s, outside the per-block window —
 cell-verified in scripts/rowpack_proto.py), every (segment, offset,
 kappa) cell is exact.  The per-lane argmax packs an offset-ORDER key
 (sbw-1-(n-n0)) instead of the raw lane index to keep the reference
-first-hit tie-break.  input4: 40.2 us gated vs r3's 75.1 (+87%
-throughput); packable-subset interleaved A/B reads packed 1.8-3.2x
+first-hit tie-break.  input4: 40-56 us gated across records vs r3's
+75.1 us (+34-87% throughput; dispatch-floor noise dominates the spread
+at this size); packable-subset interleaved A/B reads packed 1.8-3.2x
 unpacked.  i8 feed only; dispatch buckets rows into packing classes
 {8, 16, 32, 64} so a long straggler splits off instead of blocking the
 batch (ops/dispatch.py::plan_buckets / choose_rowpack).
