@@ -1,0 +1,265 @@
+"""Refit choose_superblock's cost model on the shipped (r3/r4) kernel —
+VERDICT r3 item 6.
+
+The three constants (`_ITER_FLOOR_BASE_S`, `_ITER_FLOOR_PER_SB_S`,
+`_MAC_RATE`) were r2-kernel fits from sb <= 12 sweeps; r3 changed the
+per-iteration cost structure (tail1 exact walk, wide=1 for nbi == 1)
+and widened the choice space to sb = 24.  This script:
+
+1. Sweeps sb on-device over four unpacked workload classes (interleaved
+   rounds — sequential cross-variant measurements fabricate effects on
+   this shared chip) plus a packed input4-class sweep as validation.
+2. Refits the three constants by least squares over the model's
+   predicted per-workload cost (with a per-workload additive nuisance
+   for call overhead the model deliberately excludes).
+3. Reports each workload's measured winner vs the refit model's argmin.
+
+Usage: python scripts/sb_refit.py  (TPU; ~10 min including compiles).
+"""
+
+from __future__ import annotations
+
+import itertools
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import bench  # noqa: E402
+
+
+def workloads():
+    rng = np.random.default_rng(7)
+
+    def mk(len1, lens):
+        s1 = rng.integers(1, 27, size=len1).astype(np.int32)
+        seqs = [rng.integers(1, 27, size=int(l)).astype(np.int32) for l in lens]
+        return s1, seqs
+
+    return {
+        # (seq1, seqs, sb candidates, l2s)
+        "input3-class": (*mk(1489, rng.integers(56, 1153, size=32)), (2, 3, 4, 6, 12), None),
+        "max-size": (*mk(3000, rng.integers(1200, 2000, size=64)), (2, 4, 6, 8, 12, 24), None),
+        "skew": (*mk(1489, rng.integers(1460, 1490, size=64)), (2, 3, 4, 6, 12), None),
+        "input4-class-unpacked": (*mk(2976, rng.integers(5, 83, size=30)), (4, 8, 12, 24), None),
+        "input4-class-packed": (*mk(2976, rng.integers(5, 65, size=30)), (4, 8, 12, 24), 64),
+    }
+
+
+def build_progs(name, seq1, seqs, sbs, l2s):
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from mpi_openmp_cuda_tpu.ops.dispatch import pad_batch_rows, pad_problem
+    from mpi_openmp_cuda_tpu.ops.pallas_scorer import score_chunks_pallas_body
+    from mpi_openmp_cuda_tpu.ops.values import value_table
+
+    batch = pad_problem(seq1, seqs)
+    val = value_table([3, 2, 1, 4]).astype(np.int32).reshape(-1)
+    b = batch.batch_size
+    rows, lens = pad_batch_rows(batch, b)
+    args = (
+        jnp.asarray(batch.seq1ext),
+        jnp.int32(batch.len1),
+        jnp.asarray(rows.reshape(1, b, batch.l2p)),
+        jnp.asarray(lens.reshape(1, b)),
+        jnp.asarray(val),
+    )
+
+    def make(sb, reps):
+        def f(s1, l1, rows, lens, v):
+            def step(c, i):
+                out = score_chunks_pallas_body(
+                    s1, l1, jnp.roll(rows, i, axis=1),
+                    jnp.roll(lens, i, axis=1), v, feed="i8", sb=sb, l2s=l2s,
+                )
+                return c + out.sum(), None
+
+            t, _ = lax.scan(step, jnp.int32(0), jnp.arange(reps))
+            return t
+
+        return jax.jit(f)
+
+    progs = {}
+    nbn, nbi = batch.l1p // 128, batch.l2p // 128
+    wide = 1 if nbi == 1 else 2
+    for sb in sbs:
+        # Reps scaled so the timed increment dwarfs the +-25 ms link
+        # jitter: the v1 sweep's fixed 257 reps gave ~10-45 ms
+        # increments on the tiny-wall classes, whose slopes then read
+        # pure noise (a 4.6x phantom on the packed class, overturned by
+        # a properly-amortised interleaved A/B).  The shipped cost model
+        # (right order of magnitude everywhere) sizes the amortisation.
+        rough = max(
+            model_cost(
+                0.66e-6, 0.024e-6, 160e12, nbn, nbi, batch.len1,
+                [len(s) for s in seqs], sb, wide,
+            ),
+            2e-6,
+        )
+        reps = int(min(max(0.35 / rough, 257), 16385))
+        fns = {}
+        for r in (1, reps):
+            fn = make(sb, r)
+            int(fn(*args))
+            fns[r] = fn
+        progs[sb] = lambda fns=fns: bench.min_wall_slope(
+            {r: (lambda f=f: int(f(*args))) for r, f in fns.items()}
+        )
+    return batch, progs
+
+
+def model_cost(base, per_sb, rate, nbn, nbi, len1, lens, sb, wide=None):
+    """Adapter over THE shared cost model (pallas_scorer
+    .superblock_model_cost) — the refit must fit the exact structure the
+    dispatch-time chooser evaluates, or a kernel reformulation would
+    silently leave this script fitting a stale copy."""
+    from mpi_openmp_cuda_tpu.ops.pallas_scorer import superblock_model_cost
+
+    hist = [(int(l2), 1) for l2 in lens if int(l2) > 0]
+    return superblock_model_cost(
+        nbn, nbi, len1, hist, sb, base=base, per_sb=per_sb, rate=rate
+    )
+
+
+def main() -> None:
+    rounds = int(os.environ.get("SB_ROUNDS", "3"))
+    wl = workloads()
+    built = {}
+    for name, (seq1, seqs, sbs, l2s) in wl.items():
+        built[name] = (build_progs(name, seq1, seqs, sbs, l2s), seqs, sbs, l2s)
+        print(f"built {name}", file=sys.stderr)
+
+    p0 = bench.probe_or_none()
+    meas: dict = {name: {sb: [] for sb in v[2]} for name, v in built.items()}
+    for rnd in range(rounds):
+        for name, ((batch, progs), seqs, sbs, l2s) in built.items():
+            for sb in sbs:
+                meas[name][sb].append(progs[sb]())
+        print(f"round {rnd} done", file=sys.stderr)
+    p1 = bench.probe_or_none()
+
+    med = {
+        name: {sb: float(np.median(v)) for sb, v in d.items()}
+        for name, d in meas.items()
+    }
+    for name, d in med.items():
+        line = " ".join(f"sb{sb}={w * 1e6:.1f}us" for sb, w in sorted(d.items()))
+        win = min(d, key=d.get)
+        print(f"{name}: {line}  winner sb={win}")
+    print(f"probes {p0 or float('nan'):.0f}/{p1 or float('nan'):.0f}")
+
+    # ---- refit over the UNPACKED workloads ------------------------------
+    fit_rows = []
+    for name, ((batch, progs), seqs, sbs, l2s) in built.items():
+        if l2s is not None:
+            continue
+        nbn, nbi = batch.l1p // 128, batch.l2p // 128
+        wide = 1 if nbi == 1 else 2
+        lens = [len(s) for s in seqs]
+        for sb in sbs:
+            fit_rows.append(
+                (name, sb, med[name][sb], nbn, nbi, batch.len1, lens, wide)
+            )
+
+    # Precompute the structural terms so cost(theta) is O(1) per row:
+    # cost = A x t_iter1 + B x t_iter2, t_iterN = max(floor, N*macs/rate).
+    # This decomposition is algebra on top of the shared model; the
+    # cross-check below fails loudly if the shared structure drifts.
+    from mpi_openmp_cuda_tpu.ops.pallas_scorer import (
+        _BLK,
+        _ITER_FLOOR_BASE_S,
+        _ITER_FLOOR_PER_SB_S,
+        _live_superblocks,
+        _MAC_RATE,
+    )
+
+    names = sorted({r[0] for r in fit_rows})
+    struct = []
+    for name, sb, m, nbn, nbi, len1, lens, wide in fit_rows:
+        sbw = sb * _BLK
+        macs = _BLK * _BLK * (sbw + _BLK) + 2 * _BLK * _BLK * sbw
+        A = B = 0
+        for l2 in lens:
+            if l2 <= 0:
+                continue
+            live = _live_superblocks(nbn, sb, len1, int(l2))
+            nlive = min(-(-int(l2) // _BLK), nbi)
+            if wide == 1:
+                A += live * nlive
+            else:
+                A += live * (nlive % 2)
+                B += live * (nlive // 2)
+        struct.append((name, sb, m, macs, A, B))
+        # Structure cross-check vs the SHARED model at the shipped
+        # constants: a kernel reformulation that changes
+        # superblock_model_cost without this decomposition fails here
+        # instead of silently fitting the old structure.
+        fast = A * max(
+            _ITER_FLOOR_BASE_S + sb * _ITER_FLOOR_PER_SB_S, macs / _MAC_RATE
+        ) + B * max(
+            _ITER_FLOOR_BASE_S + sb * _ITER_FLOOR_PER_SB_S,
+            2 * macs / _MAC_RATE,
+        )
+        ref = model_cost(
+            _ITER_FLOOR_BASE_S, _ITER_FLOOR_PER_SB_S, _MAC_RATE,
+            nbn, nbi, len1, lens, sb,
+        )
+        assert abs(fast - ref) <= 1e-9 + 1e-6 * ref, (name, sb, fast, ref)
+
+    best = None
+    for base, per_sb, rate in itertools.product(
+        np.linspace(0.2e-6, 1.4e-6, 25),
+        np.linspace(0.0, 0.06e-6, 13),
+        np.linspace(100e12, 400e12, 25),
+    ):
+        err = 0.0
+        for name in names:
+            rows = [r for r in struct if r[0] == name]
+            pred = np.array(
+                [
+                    A * max(base + r_sb * per_sb, macs / rate)
+                    + B * max(base + r_sb * per_sb, 2 * macs / rate)
+                    for (_, r_sb, _, macs, A, B) in rows
+                ]
+            )
+            m = np.array([r[2] for r in rows])
+            c = float(np.mean(m - pred))  # per-workload call-overhead nuisance
+            err += float(
+                np.sum((np.log(np.maximum(pred + c, 1e-9)) - np.log(m)) ** 2)
+            )
+        if best is None or err < best[0]:
+            best = (err, base, per_sb, rate)
+    err, base, per_sb, rate = best
+    print(
+        f"\nrefit: base={base * 1e6:.2f}us per_sb={per_sb * 1e6:.3f}us "
+        f"rate={rate / 1e12:.0f}e12 MAC/s (log-err {err:.3f}); shipped "
+        f"constants: base={_ITER_FLOOR_BASE_S * 1e6:.2f}us "
+        f"per_sb={_ITER_FLOOR_PER_SB_S * 1e6:.3f}us "
+        f"rate={_MAC_RATE / 1e12:.0f}e12"
+    )
+    ok = True
+    for name in names:
+        rows = [r for r in fit_rows if r[0] == name]
+        pred = {
+            r[1]: model_cost(base, per_sb, rate, r[3], r[4], r[5], r[6], r[1], r[7])
+            for r in rows
+        }
+        model_win = min(pred, key=pred.get)
+        meas_win = min((r[1] for r in rows), key=lambda sb: med[name][sb])
+        tag = "OK" if model_win == meas_win else "MISS"
+        if model_win != meas_win:
+            # a near-tie (within 10%) is acceptable: the winner is noise
+            if med[name][model_win] <= 1.10 * med[name][meas_win]:
+                tag = "OK(tie)"
+            else:
+                ok = False
+        print(f"  {name}: measured winner sb={meas_win}, refit model sb={model_win} {tag}")
+    sys.exit(0 if ok else 1)
+
+
+if __name__ == "__main__":
+    main()

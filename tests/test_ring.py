@@ -251,3 +251,37 @@ def test_ring_matches_fixture_golden():
             if line.strip()
         ]
     assert got == [(s, n, k) for (s, n, k) in want]
+
+
+@pytest.mark.slow
+def test_ring_pallas_mostly_dead_shards_kernel_path(rng, monkeypatch):
+    """VERDICT r3 item 8: the fused-KERNEL ring path on a cap-scale mesh
+    where most shards are entirely dead (len1_eff = len1 - d*bs deeply
+    negative on far shards): sp=8 over a short Seq1 leaves shards d >= 2
+    with no valid offset at all; their packed epilogue must emit the
+    _NEG sentinel (not a decoded pack sentinel) and the cross-shard
+    combine must still reproduce the oracle exactly — including the
+    equal-length capture (device 0 only) and heavy ties."""
+    import mpi_openmp_cuda_tpu.ops.pallas_scorer as ps
+
+    calls = []
+    orig = ps._pallas_best
+
+    def spy(*a, **k):
+        calls.append(1)
+        return orig(*a, **k)
+
+    monkeypatch.setattr(ps, "_pallas_best", spy)
+    # len1 = 205 -> l1p = 256, bs = 128 on the pallas ring: shard 1 is
+    # partially valid (len1_eff = 77), shards 2..7 entirely dead
+    # (len1_eff <= -51).  Low-entropy alphabet maximises cross-shard
+    # score ties so a sentinel leaking into the combine would surface.
+    seq1 = rng.integers(1, 4, size=205).astype(np.int8)
+    seqs = _rand_seqs(rng, 6, 1, 160, alpha=3) + [
+        seq1.copy(),                                 # equal length
+        rng.integers(1, 4, size=240).astype(np.int8),  # > len1: INT_MIN
+    ]
+    w = [2, 1, 1, 1]
+    got = _score_ring_backend(seq1, seqs, w, 8, 1, "pallas")
+    assert calls, "kernel path never engaged on the mostly-dead mesh"
+    assert got == [prefix_best(seq1, s, w) for s in seqs]
