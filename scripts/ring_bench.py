@@ -1,0 +1,212 @@
+"""Ring-tier throughput on the real chip (VERDICT r3 item 8, second half).
+
+The sequence-parallel ring (`parallel/ring.py`) is the framework's answer to
+the reference's hard Seq1 ceiling (`myProto.h:3` caps Seq1 at 3000; the
+reference parallelises within a sequence only inside one GPU,
+`cudaFunctions.cu:66-99`).  Multi-shard correctness runs on the 8-virtual-
+device CPU mesh (`tests/test_ring.py`); this script answers the question the
+functional tests cannot: **what does the ring tier cost on real hardware**,
+measured against the direct single-chip dispatch.
+
+One real chip is reachable from this environment, so the ring is measured at
+``sp=1`` — the full ring schedule (window assembly via ``lax.ppermute``,
+per-shard fused kernel on its ring-assembled window, candidate ``all_gather``
++ cross-shard combine) with degenerate single-participant collectives.  That
+isolates the ring *harness* cost; the sp>1 collective cost is ICI-latency
+(~O(us) per hop on a real slice) and is validated functionally, not timed,
+on the virtual CPU mesh (CPU shard_map timing says nothing about ICI).
+
+Rows produced (JSON lines on stdout, probe-bracketed like bench.py):
+
+* ``cap-size``:      input3 through ring-sp1 vs the direct dispatch — the
+                     ring tax at reference scale.
+* ``long-context``:  Seq1 = 4x BUF_SIZE_SEQ1 (12000 chars), 16 Seq2s — a
+                     regime the reference cannot represent at all; absolute
+                     eq-elements/s for the unbounded tier.
+
+Usage: ``python scripts/ring_bench.py`` (env: RING_BENCH_REPS,
+RING_BENCH_MEDIAN, RING_BENCH_ATTEMPTS mirror bench.py's knobs).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+import bench
+from bench import (
+    brute_force_elements,
+    min_wall_slope,
+    probe_or_none,
+    probe_record_fields,
+    run_attempts,
+    select_attempt,
+)
+
+
+def ring_steady_wall(rs, batch, val_flat, reps: int, medians: int = 1,
+                     backend: str = "pallas") -> float:
+    """Amortised steady-state wall for one ring dispatch of ``batch``.
+
+    Same two-point slope protocol as ``bench.steady_state_wall``: a short
+    and a long jitted loop around the EXACT compiled fn + placed arguments
+    the production ``score_async`` dispatches (``RingSharding._prepare``),
+    each rep rotating the rows along the char axis (shard-local, no extra
+    collective) so nothing hoists out of the loop."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    fn, args, _b = rs._prepare(batch, val_flat, backend=backend)
+    seq1_d, len1, rows_d, lens_d, val_d = args
+
+    def make(k):
+        def f(seq1_d, len1, rows, lens, val_d):
+            def step(c, i):
+                out = fn(seq1_d, len1, jnp.roll(rows, i, axis=1), lens, val_d)
+                return c + out.sum(), None
+
+            tot, _ = lax.scan(step, jnp.int32(0), jnp.arange(k))
+            return tot
+
+        return jax.jit(f)
+
+    fns = {}
+    for k in (1, 1 + reps):
+        fns[k] = make(k)
+        int(fns[k](*args))  # compile + force once per program
+
+    progs = {k: (lambda f=f: int(f(*args))) for k, f in fns.items()}
+    slopes = [min_wall_slope(progs) for _ in range(max(1, medians))]
+    warn = bench.slope_spread_warning(slopes, reps)
+    if warn:
+        print(warn, file=sys.stderr)
+    return float(np.median(slopes))
+
+
+def _attempted(measure, on_tpu, gate, quiet_ref, max_attempts, value_of):
+    """bench.py's probe-bracketed attempt loop around ``measure``; returns
+    (record_fields, chosen wall)."""
+    def log(att, rounds, a):
+        print(
+            f"[ring-bench] attempt {att + 1}/{rounds}: steady {a.wall:.2e}s"
+            + (f" probes {a.p0 if a.p0 is not None else float('nan'):.0f}/"
+               f"{a.p1 if a.p1 is not None else float('nan'):.0f} TFLOP/s"
+               if on_tpu else ""),
+            file=sys.stderr,
+        )
+
+    attempts = run_attempts(
+        measure, probe_or_none if on_tpu else None, gate=gate,
+        max_attempts=max_attempts, log=log,
+    )
+    chosen, gated = select_attempt(attempts, gate)
+    fields, warn = probe_record_fields(
+        chosen, gated, gate, quiet_ref, on_tpu, len(attempts),
+        value_of(chosen.wall),
+    )
+    if warn:
+        print(warn.replace("[bench]", "[ring-bench]"), file=sys.stderr)
+    return fields, chosen.wall
+
+
+def main() -> None:
+    from mpi_openmp_cuda_tpu.utils.platform import (
+        apply_platform_override,
+        enable_compilation_cache,
+    )
+
+    apply_platform_override()
+    enable_compilation_cache()
+    import jax
+
+    from mpi_openmp_cuda_tpu.ops.dispatch import pad_problem
+    from mpi_openmp_cuda_tpu.ops.values import value_table
+    from mpi_openmp_cuda_tpu.parallel.ring import RingSharding
+
+    on_tpu = jax.devices()[0].platform == "tpu"
+    quiet_ref = bench.QUIET_BF16_BY_KIND.get(
+        jax.devices()[0].device_kind
+    ) if on_tpu else None
+    gate = quiet_ref * bench.PROBE_GATE_FRACTION if quiet_ref else None
+    reps = max(1, int(os.environ.get("RING_BENCH_REPS", "256")))
+    medians = int(os.environ.get("RING_BENCH_MEDIAN", "3"))
+    max_attempts = max(1, int(os.environ.get("RING_BENCH_ATTEMPTS", "6")))
+    backend = os.environ.get("RING_BENCH_BACKEND", "pallas")
+
+    rs = RingSharding.over_devices(seq=jax.device_count(), batch=1)
+
+    # ---- row 1: cap-size, ring vs direct on the same workload ----------
+    problem, workload = bench.load_workload()
+    val_flat = value_table(problem.weights).astype(np.int32).reshape(-1)
+    batch = pad_problem(problem.seq1_codes, problem.seq2_codes)
+    elements = brute_force_elements(
+        problem.seq1_codes.size, [c.size for c in problem.seq2_codes]
+    )
+
+    fields, wall = _attempted(
+        lambda: ring_steady_wall(rs, batch, val_flat, reps, medians, backend),
+        on_tpu, gate, quiet_ref, max_attempts, lambda w: elements / w,
+    )
+    # The direct-dispatch baseline gets the SAME probe-bracketed attempt
+    # loop: a co-tenant burst during an unguarded single measurement would
+    # silently distort the published overhead ratio (r4 code review).
+    dfields, direct = _attempted(
+        lambda: bench.steady_state_wall(problem, backend, reps=reps,
+                                        medians=medians),
+        on_tpu, gate, quiet_ref, max_attempts, lambda w: elements / w,
+    )
+    rec = {
+        "metric": f"ring-tier (sp={rs.sp}) eq comparisons/s/chip, {workload}",
+        "value": round(elements / wall, 1),
+        "unit": "elements/s/chip",
+        "steady_wall_us": round(wall * 1e6, 1),
+        "direct_wall_us": round(direct * 1e6, 1),
+        "ring_overhead": round(wall / direct, 3),
+        **fields,
+        **{f"direct_{k}": v for k, v in dfields.items()},
+    }
+    print(json.dumps(rec))
+
+    # ---- row 2: long-context, 4x the reference's Seq1 ceiling ----------
+    # (env-shrinkable so the script smoke-tests on CPU in seconds)
+    llen1 = int(os.environ.get("RING_BENCH_LONG_LEN1", "12000"))
+    ln = int(os.environ.get("RING_BENCH_LONG_N", "16"))
+    l2lo, l2hi = (max(8, llen1 // 15), max(16, llen1 // 6))
+    rng = np.random.default_rng(8)
+    seq1 = rng.integers(1, 27, size=llen1).astype(np.int8)
+    lens2 = [int(x) for x in rng.integers(l2lo, l2hi, size=ln)]
+    seqs = [rng.integers(1, 27, size=l).astype(np.int8) for l in lens2]
+    lbatch = pad_problem(seq1, seqs, enforce_caps=False)
+    lelements = brute_force_elements(seq1.size, lens2)
+
+    fields, wall = _attempted(
+        lambda: ring_steady_wall(rs, lbatch, val_flat, reps, medians, backend),
+        on_tpu, gate, quiet_ref, max_attempts, lambda w: lelements / w,
+    )
+    rec = {
+        "metric": (
+            f"ring-tier (sp={rs.sp}) eq comparisons/s/chip, "
+            f"long-context Seq1={llen1}, {ln} Seq2 of {l2lo}-{l2hi}"
+        ),
+        "value": round(lelements / wall, 1),
+        "unit": "elements/s/chip",
+        "steady_wall_us": round(wall * 1e6, 1),
+        "elements": lelements,
+        **fields,
+    }
+    print(json.dumps(rec))
+    print(
+        f"[ring-bench] backend={backend} device="
+        f"{jax.devices()[0].device_kind} sp={rs.sp}",
+        file=sys.stderr,
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -85,18 +85,24 @@ def build_progs(name, seq1, seqs, sbs, l2s):
 
     progs = {}
     nbn, nbi = batch.l1p // 128, batch.l2p // 128
-    wide = 1 if nbi == 1 else 2
     for sb in sbs:
         # Reps scaled so the timed increment dwarfs the +-25 ms link
         # jitter: the v1 sweep's fixed 257 reps gave ~10-45 ms
         # increments on the tiny-wall classes, whose slopes then read
         # pure noise (a 4.6x phantom on the packed class, overturned by
-        # a properly-amortised interleaved A/B).  The shipped cost model
-        # (right order of magnitude everywhere) sizes the amortisation.
+        # a properly-amortised interleaved A/B).  The SHIPPED cost model
+        # constants (right order of magnitude everywhere) size the
+        # amortisation, so the sizing tracks any future refit.
+        from mpi_openmp_cuda_tpu.ops.pallas_scorer import (
+            _ITER_FLOOR_BASE_S,
+            _ITER_FLOOR_PER_SB_S,
+            _MAC_RATE,
+        )
+
         rough = max(
             model_cost(
-                0.66e-6, 0.024e-6, 160e12, nbn, nbi, batch.len1,
-                [len(s) for s in seqs], sb, wide,
+                _ITER_FLOOR_BASE_S, _ITER_FLOOR_PER_SB_S, _MAC_RATE,
+                nbn, nbi, batch.len1, [len(s) for s in seqs], sb,
             ),
             2e-6,
         )
@@ -112,11 +118,12 @@ def build_progs(name, seq1, seqs, sbs, l2s):
     return batch, progs
 
 
-def model_cost(base, per_sb, rate, nbn, nbi, len1, lens, sb, wide=None):
+def model_cost(base, per_sb, rate, nbn, nbi, len1, lens, sb):
     """Adapter over THE shared cost model (pallas_scorer
     .superblock_model_cost) — the refit must fit the exact structure the
     dispatch-time chooser evaluates, or a kernel reformulation would
-    silently leave this script fitting a stale copy."""
+    silently leave this script fitting a stale copy.  (The model derives
+    the 2-wide/1-wide walk from nbi itself, so no wide parameter here.)"""
     from mpi_openmp_cuda_tpu.ops.pallas_scorer import superblock_model_cost
 
     hist = [(int(l2), 1) for l2 in lens if int(l2) > 0]
@@ -245,7 +252,7 @@ def main() -> None:
     for name in names:
         rows = [r for r in fit_rows if r[0] == name]
         pred = {
-            r[1]: model_cost(base, per_sb, rate, r[3], r[4], r[5], r[6], r[1], r[7])
+            r[1]: model_cost(base, per_sb, rate, r[3], r[4], r[5], r[6], r[1])
             for r in rows
         }
         model_win = min(pred, key=pred.get)

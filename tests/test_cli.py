@@ -8,7 +8,7 @@ import sys
 
 import pytest
 
-from conftest import REFERENCE_DIR, reference_fixture
+from conftest import REFERENCE_DIR, reference_fixture, run_cli_inproc as run_inproc
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 GOLDEN = os.path.join(REPO, "tests", "golden")
@@ -41,64 +41,65 @@ def golden(name):
 
 
 @pytest.mark.parametrize("fixture", ["input1", "input2", "input5", "input6"])
-def test_fixture_stdout_exact(fixture):
+def test_fixture_stdout_exact(fixture, capsys):
     path = reference_fixture(f"{fixture}.txt")
-    proc = run_cli(stdin_path=path)
-    assert proc.stdout == golden(f"{fixture}.out")
+    out, _ = run_inproc("--input", path, capsys=capsys)
+    assert out == golden(f"{fixture}.out")
 
 
 @pytest.mark.parametrize("fixture", ["input3", "input4"])
-def test_heavy_fixture_stdout_exact(fixture):
+def test_heavy_fixture_stdout_exact(fixture, capsys):
     # Stress fixtures (6.1e9 / 2.4e8 brute-force char ops) via the O(L1*L2)
     # XLA path — still byte-exact against the goldens.
     path = reference_fixture(f"{fixture}.txt")
-    proc = run_cli(stdin_path=path)
-    assert proc.stdout == golden(f"{fixture}.out")
+    out, _ = run_inproc("--input", path, capsys=capsys)
+    assert out == golden(f"{fixture}.out")
 
 
 def test_input_flag_equivalent_to_stdin():
+    # The one full-subprocess byte-exactness check: the real
+    # `python -m mpi_openmp_cuda_tpu` entry, via both --input and stdin.
     path = reference_fixture("input5.txt")
     assert run_cli("--input", path).stdout == golden("input5.out")
+    assert run_cli(stdin_path=path).stdout == golden("input5.out")
 
 
-def test_oracle_backend_matches():
+def test_oracle_backend_matches(capsys):
     path = reference_fixture("input6.txt")
-    proc = run_cli("--backend", "oracle", stdin_path=path)
-    assert proc.stdout == golden("input6.out")
+    out, _ = run_inproc("--backend", "oracle", "--input", path, capsys=capsys)
+    assert out == golden("input6.out")
 
 
-def test_json_sidecar(tmp_path):
+def test_json_sidecar(tmp_path, capsys):
     path = reference_fixture("input5.txt")
     sidecar = str(tmp_path / "out.json")
-    proc = run_cli("--json", sidecar, stdin_path=path)
-    assert proc.stdout == golden("input5.out")
+    out, _ = run_inproc("--json", sidecar, "--input", path, capsys=capsys)
+    assert out == golden("input5.out")
     data = json.load(open(sidecar))
     assert data["results"][0] == {"index": 0, "score": 27, "n": 0, "k": 5}
     assert data["meta"]["backend"] == "xla"
 
 
-def test_profile_goes_to_stderr_not_stdout():
+def test_profile_goes_to_stderr_not_stdout(capsys):
     path = reference_fixture("input6.txt")
-    proc = run_cli("--profile", stdin_path=path)
-    assert proc.stdout == golden("input6.out")
-    assert "[profile]" in proc.stderr
+    out, err = run_inproc("--profile", "--input", path, capsys=capsys)
+    assert out == golden("input6.out")
+    assert "[profile]" in err
 
 
-def test_malformed_input_fails_cleanly(tmp_path):
+def test_malformed_input_fails_cleanly(tmp_path, capsys):
     bad = tmp_path / "bad.txt"
     bad.write_text("1 2 3\n")
-    proc = run_cli("--input", str(bad), check=False)
-    assert proc.returncode == 1
-    assert "error" in proc.stderr.lower()
-    assert proc.stdout == ""
+    out, err = run_inproc("--input", str(bad), capsys=capsys, rc_want=1)
+    assert "error" in err.lower()
+    assert out == ""
 
 
-def test_invalid_character_fails_cleanly(tmp_path):
+def test_invalid_character_fails_cleanly(tmp_path, capsys):
     bad = tmp_path / "bad.txt"
     bad.write_text("1 2 3 4\nAB9C\n1\nAB\n")
-    proc = run_cli("--input", str(bad), check=False)
-    assert proc.returncode == 1
-    assert "invalid sequence character" in proc.stderr
+    out, err = run_inproc("--input", str(bad), capsys=capsys, rc_want=1)
+    assert "invalid sequence character" in err
 
 
 def test_guarded_stdout_restores_fd1_on_broken_pipe():

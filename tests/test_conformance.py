@@ -40,28 +40,41 @@ WEIGHT_REGIMES = [
 
 
 def _problems(rng):
-    """Problems spanning the corners, in two shared shape buckets."""
+    """Problems spanning the corners, in two shared shape buckets.
+
+    Fast-tier buckets A/B both land in the (l1p, l2p) = (128, 128) shape
+    bucket: every semantic corner (equal length, overlong, empty, grid
+    size 1, ties) is length-independent, and the single shared shape keeps
+    the interpret-mode Pallas cost on the 1-core CPU test box at seconds
+    instead of minutes (VERDICT r3 item 7).  The larger super-block
+    shapes (sb=4 / sb=8) ride the slow tier as buckets C/D; the kernel's
+    multi-super-block walk itself (nbn > 1: cross-block carry, dead-block
+    skips) keeps fast-tier coverage in test_pallas_scorer (seq1 sizes
+    260-900), so this sweep's fast tier only needs the path-combinatorics,
+    not the block-walk shapes."""
     out = []
-    # Bucket A: len1 ~ 200 (l1p 256), seq2s <= 250.
-    seq1a = rng.integers(1, 27, size=200).astype(np.int8)
+    # Bucket A: len1 = 120 (l1p 128), seq2s <= 126.
+    seq1a = rng.integers(1, 27, size=120).astype(np.int8)
     out.append(
         (
             seq1a,
             [
-                rng.integers(1, 27, size=60).astype(np.int8),
+                rng.integers(1, 27, size=40).astype(np.int8),
                 seq1a.copy(),  # equal length
-                rng.integers(1, 27, size=250).astype(np.int8),  # overlong
+                rng.integers(1, 27, size=126).astype(np.int8),  # overlong
                 np.zeros(0, dtype=np.int8),  # empty
-                rng.integers(1, 27, size=199).astype(np.int8),  # grid size 1
-                rng.integers(1, 3, size=40).astype(np.int8),  # low entropy
+                rng.integers(1, 27, size=119).astype(np.int8),  # grid size 1
+                rng.integers(1, 3, size=30).astype(np.int8),  # low entropy
                 rng.integers(1, 27, size=1).astype(np.int8),
             ],
         )
     )
-    # Bucket B: low-entropy seq1 (tie storm), 5 candidates (uneven over
-    # both the 8-device dp mesh and the 2x4 mesh).
-    seq1b = rng.integers(1, 3, size=180).astype(np.int8)
-    out.append((seq1b, [rng.integers(1, 3, size=n).astype(np.int8) for n in (7, 30, 64, 120, 179)]))
+    # Bucket B: low-entropy seq1 (tie storm), 7 candidates (uneven over
+    # both the 8-device dp mesh and the 2x4 mesh); same shape bucket AND
+    # batch size as A so every jitted program (incl. the ring fns, keyed
+    # on the padded batch) is shared with bucket A.
+    seq1b = rng.integers(1, 3, size=96).astype(np.int8)
+    out.append((seq1b, [rng.integers(1, 3, size=n).astype(np.int8) for n in (7, 20, 40, 70, 95, 2, 9)]))
     # Bucket C: len1 ~ 450 -> l1p = 512 (sb=4 Pallas super-block);
     # candidate lengths straddle its skip boundaries.
     seq1c = rng.integers(1, 27, size=450).astype(np.int8)

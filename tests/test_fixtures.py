@@ -9,7 +9,7 @@ import sys
 import numpy as np
 import pytest
 
-from test_cli import run_cli
+from conftest import run_cli_inproc as run_inproc
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 FIXDIR = os.path.join(REPO, "tests", "fixtures")
@@ -33,31 +33,40 @@ def golden(name: str) -> str:
 
 
 @pytest.mark.parametrize("name", ALL_FIXTURES)
-def test_fixture_stdout_exact(name):
-    proc = run_cli(stdin_path=fixture_path(name))
-    assert proc.stdout == golden(name)
+def test_fixture_stdout_exact(name, capsys):
+    out, _ = run_inproc("--input", fixture_path(name), capsys=capsys)
+    assert out == golden(name)
 
 
 @pytest.mark.parametrize("name", ["equal_len", "overlong", "tiny"])
-def test_fixture_gather_backend(name):
-    proc = run_cli("--backend", "xla-gather", stdin_path=fixture_path(name))
-    assert proc.stdout == golden(name)
+def test_fixture_gather_backend(name, capsys):
+    out, _ = run_inproc(
+        "--backend", "xla-gather", "--input", fixture_path(name), capsys=capsys
+    )
+    assert out == golden(name)
 
 
-def test_fixture_oracle_backend():
-    proc = run_cli("--backend", "oracle", stdin_path=fixture_path("dup_and_k0"))
-    assert proc.stdout == golden("dup_and_k0")
+def test_fixture_oracle_backend(capsys):
+    out, _ = run_inproc(
+        "--backend", "oracle", "--input", fixture_path("dup_and_k0"),
+        capsys=capsys,
+    )
+    assert out == golden("dup_and_k0")
 
 
-def test_fixture_batch_mesh():
+def test_fixture_batch_mesh(capsys):
     # 8 virtual CPU devices (conftest): dp sharding over an uneven batch.
-    proc = run_cli("--mesh", "4", stdin_path=fixture_path("mixedcase"))
-    assert proc.stdout == golden("mixedcase")
+    out, _ = run_inproc(
+        "--mesh", "4", "--input", fixture_path("mixedcase"), capsys=capsys
+    )
+    assert out == golden("mixedcase")
 
 
-def test_fixture_ring_mesh():
-    proc = run_cli("--mesh", "seq:4", stdin_path=fixture_path("equal_len"))
-    assert proc.stdout == golden("equal_len")
+def test_fixture_ring_mesh(capsys):
+    out, _ = run_inproc(
+        "--mesh", "seq:4", "--input", fixture_path("equal_len"), capsys=capsys
+    )
+    assert out == golden("equal_len")
 
 
 def test_committed_fixtures_match_generator():
@@ -69,9 +78,9 @@ def test_committed_fixtures_match_generator():
         assert golden(name) == generate.golden_text(weights, seq1, seqs), name
 
 
-def test_empty_batch_prints_nothing():
-    proc = run_cli(stdin_path=fixture_path("empty_batch"))
-    assert proc.stdout == ""
+def test_empty_batch_prints_nothing(capsys):
+    out, _ = run_inproc("--input", fixture_path("empty_batch"), capsys=capsys)
+    assert out == ""
 
 
 def test_overlong_sentinel_matches_reference_b12():
@@ -82,10 +91,12 @@ def test_overlong_sentinel_matches_reference_b12():
 # -- aux-subsystem flags (SURVEY §5) --------------------------------------
 
 
-def test_selfcheck_passes_and_reports():
-    proc = run_cli("--selfcheck", stdin_path=fixture_path("mixedcase"))
-    assert proc.stdout == golden("mixedcase")
-    assert "selfcheck OK" in proc.stderr
+def test_selfcheck_passes_and_reports(capsys):
+    out, err = run_inproc(
+        "--selfcheck", "--input", fixture_path("mixedcase"), capsys=capsys
+    )
+    assert out == golden("mixedcase")
+    assert "selfcheck OK" in err
 
 
 def test_selfcheck_catches_corruption():
@@ -169,10 +180,12 @@ def test_retries_does_not_mask_value_errors(monkeypatch, capsys):
     assert calls["n"] == 1  # not retried
 
 
-def test_trace_writes_profile_data(tmp_path):
+def test_trace_writes_profile_data(tmp_path, capsys):
     tracedir = str(tmp_path / "trace")
-    proc = run_cli("--trace", tracedir, stdin_path=fixture_path("tiny"))
-    assert proc.stdout == golden("tiny")
+    out, _ = run_inproc(
+        "--trace", tracedir, "--input", fixture_path("tiny"), capsys=capsys
+    )
+    assert out == golden("tiny")
     found = [
         os.path.join(r, f) for r, _, fs in os.walk(tracedir) for f in fs
     ]

@@ -369,7 +369,18 @@ def test_effective_backend_routing():
 # ---------------------------------------------------------------------------
 
 
-@pytest.mark.parametrize("l2s", [8, 16, 32, 64])
+@pytest.mark.parametrize(
+    "l2s",
+    [
+        8,
+        # The interior classes ride the slow tier (one ~10 s interpret
+        # compile each on the 1-core box); 8 (deepest packing, p=16) and
+        # 64 (the production input4 class) bound the packed-walk shapes.
+        pytest.param(16, marks=pytest.mark.slow),
+        pytest.param(32, marks=pytest.mark.slow),
+        64,
+    ],
+)
 def test_rowpack_matches_oracle_each_class(l2s):
     """Every packing class, all pairs <= l2s: the dispatch routes to the
     packed kernel (asserted via choose_rowpack) and stays oracle-exact,

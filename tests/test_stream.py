@@ -9,9 +9,9 @@ import io
 import numpy as np
 import pytest
 
-from conftest import reference_fixture
+from conftest import reference_fixture, run_cli_inproc as run_inproc
 
-from test_cli import golden, run_cli
+from test_cli import golden
 
 from mpi_openmp_cuda_tpu.io.parse import (
     InputFormatError,
@@ -23,20 +23,20 @@ from mpi_openmp_cuda_tpu.ops.oracle import score_batch_oracle
 
 
 @pytest.mark.parametrize("chunk", [1, 3, 64])
-def test_stream_fixture_byte_exact(chunk):
+def test_stream_fixture_byte_exact(chunk, capsys):
     path = reference_fixture("input1.txt")  # N=10: uneven for chunk=3
-    proc = run_cli("--stream", str(chunk), stdin_path=path)
-    assert proc.stdout == golden("input1.out")
+    out, _ = run_inproc("--stream", str(chunk), "--input", path, capsys=capsys)
+    assert out == golden("input1.out")
 
 
-def test_stream_with_mesh_and_json(tmp_path):
+def test_stream_with_mesh_and_json(tmp_path, capsys):
     path = reference_fixture("input6.txt")
     sidecar = tmp_path / "out.json"
-    proc = run_cli(
+    out, _ = run_inproc(
         "--stream", "2", "--mesh", "4", "--json", str(sidecar),
-        stdin_path=path,
+        "--input", path, capsys=capsys,
     )
-    assert proc.stdout == golden("input6.out")
+    assert out == golden("input6.out")
     import json
 
     payload = json.loads(sidecar.read_text())
@@ -49,24 +49,30 @@ def test_stream_with_mesh_and_json(tmp_path):
         assert row["score"] == int(text[2].rstrip(","))
 
 
-def test_stream_rejects_selfcheck(tmp_path):
+def test_stream_rejects_selfcheck(tmp_path, capsys):
     path = reference_fixture("input5.txt")
-    proc = run_cli("--stream", "2", "--selfcheck", stdin_path=path, check=False)
-    assert proc.returncode != 0
-    assert "cannot be combined with --stream" in proc.stderr
+    _, err = run_inproc(
+        "--stream", "2", "--selfcheck", "--input", path, capsys=capsys,
+        rc_want=1,
+    )
+    assert "cannot be combined with --stream" in err
 
 
-def test_stream_journal_resume(tmp_path):
+def test_stream_journal_resume(tmp_path, capsys):
     path = reference_fixture("input1.txt")
     j = str(tmp_path / "j.jsonl")
-    proc = run_cli("--stream", "3", "--journal", j, stdin_path=path)
-    assert proc.stdout == golden("input1.out")
+    out, _ = run_inproc(
+        "--stream", "3", "--journal", j, "--input", path, capsys=capsys
+    )
+    assert out == golden("input1.out")
     full = open(j).read().splitlines()
     assert len(full) == 1 + 10  # header + one record per sequence
 
     # Rerun: everything resumes from the journal, no new records.
-    proc = run_cli("--stream", "3", "--journal", j, stdin_path=path)
-    assert proc.stdout == golden("input1.out")
+    out, _ = run_inproc(
+        "--stream", "3", "--journal", j, "--input", path, capsys=capsys
+    )
+    assert out == golden("input1.out")
     assert len(open(j).read().splitlines()) == 1 + 10
 
     # Truncate to header + 4 records: the rerun rescores only the rest,
@@ -74,16 +80,20 @@ def test_stream_journal_resume(tmp_path):
     # are per-sequence with global indices, chunk-size independent).
     with open(j, "w") as f:
         f.write("\n".join(full[:5]) + "\n")
-    proc = run_cli("--stream", "4", "--journal", j, stdin_path=path)
-    assert proc.stdout == golden("input1.out")
+    out, _ = run_inproc(
+        "--stream", "4", "--journal", j, "--input", path, capsys=capsys
+    )
+    assert out == golden("input1.out")
     assert len(open(j).read().splitlines()) == 1 + 10
 
 
-def test_stream_journal_rejects_changed_input(tmp_path):
+def test_stream_journal_rejects_changed_input(tmp_path, capsys):
     src = reference_fixture("input6.txt")
     j = str(tmp_path / "j.jsonl")
-    proc = run_cli("--stream", "2", "--journal", j, stdin_path=src)
-    assert proc.stdout == golden("input6.out")
+    out, _ = run_inproc(
+        "--stream", "2", "--journal", j, "--input", src, capsys=capsys
+    )
+    assert out == golden("input6.out")
 
     # Same header shape (weights/Seq1/N) but a mutated sequence: the
     # per-record hash must catch it.
@@ -91,31 +101,35 @@ def test_stream_journal_rejects_changed_input(tmp_path):
     text[7] = text[7][:-1] + ("A" if text[7][-1] != "A" else "B")
     mutated = tmp_path / "mutated.txt"
     mutated.write_text(" ".join(text) + "\n")
-    proc = run_cli(
-        "--stream", "2", "--journal", j, "--input", str(mutated), check=False
+    _, err = run_inproc(
+        "--stream", "2", "--journal", j, "--input", str(mutated),
+        capsys=capsys, rc_want=1,
     )
-    assert proc.returncode != 0
-    assert "does not match the input" in proc.stderr
+    assert "does not match the input" in err
     # Different Seq1 entirely: header fingerprint mismatch.
     text[4] = text[4][::-1] + "Q"
     mutated.write_text(" ".join(text) + "\n")
-    proc = run_cli(
-        "--stream", "2", "--journal", j, "--input", str(mutated), check=False
+    _, err = run_inproc(
+        "--stream", "2", "--journal", j, "--input", str(mutated),
+        capsys=capsys, rc_want=1,
     )
-    assert proc.returncode != 0
-    assert "different problem" in proc.stderr
+    assert "different problem" in err
 
 
-def test_stream_journal_and_batch_journal_are_mutually_foreign(tmp_path):
+def test_stream_journal_and_batch_journal_are_mutually_foreign(tmp_path, capsys):
     path = reference_fixture("input6.txt")
     jb = str(tmp_path / "batch.jsonl")
     js = str(tmp_path / "stream.jsonl")
-    run_cli("--journal", jb, stdin_path=path)
-    run_cli("--stream", "2", "--journal", js, stdin_path=path)
-    proc = run_cli("--stream", "2", "--journal", jb, stdin_path=path, check=False)
-    assert proc.returncode != 0 and "stream-journal" in proc.stderr
-    proc = run_cli("--journal", js, stdin_path=path, check=False)
-    assert proc.returncode != 0
+    run_inproc("--journal", jb, "--input", path, capsys=capsys)
+    run_inproc("--stream", "2", "--journal", js, "--input", path, capsys=capsys)
+    _, err = run_inproc(
+        "--stream", "2", "--journal", jb, "--input", path, capsys=capsys,
+        rc_want=1,
+    )
+    assert "stream-journal" in err
+    _, err = run_inproc(
+        "--journal", js, "--input", path, capsys=capsys, rc_want=1
+    )
 
 
 def test_stream_header_then_chunks_matches_parse_problem():
@@ -135,15 +149,16 @@ def test_stream_header_then_chunks_matches_parse_problem():
         assert np.array_equal(a, b)
 
 
-def test_stream_truncated_input_emits_nothing(tmp_path):
+def test_stream_truncated_input_emits_nothing(tmp_path, capsys):
     # Fail-stop: a stream that dies mid-batch must not leave partial
     # results on stdout (same contract as the non-streaming path).
     bad = tmp_path / "trunc.txt"
     bad.write_text("10 2 3 4\nABCDEFGH\n5\nAB\nCD\n")
-    proc = run_cli("--stream", "2", "--input", str(bad), check=False)
-    assert proc.returncode != 0
-    assert proc.stdout == ""
-    assert "ended at 2" in proc.stderr
+    out, err = run_inproc(
+        "--stream", "2", "--input", str(bad), capsys=capsys, rc_want=1
+    )
+    assert out == ""
+    assert "ended at 2" in err
 
 
 def test_stream_truncated_batch_raises():
