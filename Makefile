@@ -53,8 +53,16 @@ runOn2:
 test:
 	$(PYTHON) -m pytest tests/ -q
 
+# Full coverage in TWO pytest processes: the fast tier, then the
+# slow-marked tests alone.  A single combined process segfaults jaxlib's
+# XLA:CPU compiler reproducibly (3/3 runs, same test, with and without
+# the persistent compile cache) once ~190 tests of program churn precede
+# one particular interpret-mode compile; each tier alone passes every
+# time.  The union of the two selections is exactly `--runslow` in one
+# process — tests are independent, nothing is lost by the split.
 test-all:
-	$(PYTHON) -m pytest tests/ -q --runslow
+	$(PYTHON) -m pytest tests/ -q
+	$(PYTHON) -m pytest tests/ -q --runslow -m slow
 
 # Everything a round-end check runs: FULL suite (slow tier included),
 # driver hooks, native goldens.  `final` is an ordered prerequisite of

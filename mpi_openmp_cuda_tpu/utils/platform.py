@@ -51,8 +51,36 @@ def enable_compilation_cache() -> None:
     if loc is not None and loc.strip().lower() in ("off", "0", ""):
         return
     if loc is None:
+        # Partition the default location by platform configuration.  One
+        # shared directory is NOT safe: entries written by a TPU-plugin
+        # process and read by a JAX_PLATFORMS=cpu process (or written
+        # under a different virtual-device-count XLA_FLAGS) deserialize
+        # XLA:CPU executables compiled for a different machine
+        # configuration — observed as "Compile machine features ...
+        # doesn't match" warnings and, reproducibly, a segfault inside
+        # compilation_cache.get_executable_and_time during the test
+        # suite.  Writers and readers must share the tag exactly.
+        tag = os.environ.get("JAX_PLATFORMS", "").replace(",", "-")
+        if not tag:
+            # No explicit platform choice: a TPU-plugin process and a
+            # CPU-fallback process must still land in different
+            # directories (the backend itself cannot be queried here —
+            # that would initialize it, which has to stay AFTER
+            # jax.distributed.initialize on multi-host).  Plugin
+            # presence is the best init-free proxy.
+            import importlib.util
+
+            tag = (
+                "tpu-plugin"
+                if importlib.util.find_spec("libtpu") is not None
+                else "default"
+            )
+        flags = os.environ.get("XLA_FLAGS", "")
+        for tok in flags.split():
+            if "xla_force_host_platform_device_count" in tok:
+                tag += "-hd" + tok.split("=")[-1]
         loc = os.path.join(
-            os.path.expanduser("~"), ".cache", "mpi_openmp_cuda_tpu", "jax"
+            os.path.expanduser("~"), ".cache", "mpi_openmp_cuda_tpu", "jax", tag
         )
     try:
         os.makedirs(loc, exist_ok=True)

@@ -20,3 +20,64 @@ def test_lazy_exports_resolve():
         pkg.not_an_export
     # PEP 562 companion __dir__: lazy names visible to introspection.
     assert {"AlignmentScorer", "BatchSharding", "RingSharding"} <= set(dir(pkg))
+
+
+def test_compile_cache_dir_partitioned_by_platform_config(monkeypatch, tmp_path):
+    """The default persistent-cache location must differ per platform
+    configuration: one shared directory let a JAX_PLATFORMS=cpu process
+    deserialize XLA:CPU executables written by a TPU-plugin process (a
+    different compile-machine configuration), which segfaulted inside
+    compilation_cache.get_executable_and_time mid-suite.  Writers and
+    readers must share the (platforms, virtual-device-count) tag."""
+    import jax
+
+    from mpi_openmp_cuda_tpu.utils import platform as plat
+
+    # enable_compilation_cache mkdirs the location: keep the real HOME
+    # cache untouched by the test's probe calls.
+    monkeypatch.setenv("HOME", str(tmp_path))
+    seen = []
+    monkeypatch.setattr(
+        jax.config, "update", lambda k, v: seen.append((k, v))
+    )
+
+    def loc_for(platforms, flags):
+        monkeypatch.setattr(plat.enable_compilation_cache, "_done", False)
+        if platforms is None:
+            monkeypatch.delenv("JAX_PLATFORMS", raising=False)
+        else:
+            monkeypatch.setenv("JAX_PLATFORMS", platforms)
+        monkeypatch.setenv("XLA_FLAGS", flags)
+        monkeypatch.delenv("TPU_SEQALIGN_COMPILE_CACHE", raising=False)
+        seen.clear()
+        plat.enable_compilation_cache()
+        return dict(seen)["jax_compilation_cache_dir"]
+
+    cpu8 = loc_for("cpu", "--xla_force_host_platform_device_count=8")
+    cpu = loc_for("cpu", "")
+    # Unset JAX_PLATFORMS: the tag falls back to TPU-plugin presence
+    # (init-free proxy for the backend that will be selected).
+    import importlib.util as _ilu
+
+    monkeypatch.setattr(_ilu, "find_spec", lambda name: None)
+    bare = loc_for(None, "")
+    monkeypatch.setattr(_ilu, "find_spec", lambda name: object())
+    plugin = loc_for(None, "")
+    assert cpu8.endswith("cpu-hd8") and cpu.endswith("cpu")
+    assert bare.endswith("default") and plugin.endswith("tpu-plugin")
+    assert len({cpu8, cpu, bare, plugin}) == 4
+
+    # An explicit override is used verbatim (no tag suffix), and "off"
+    # disables the cache entirely.
+    explicit = str(tmp_path / "explicit-cache")
+    monkeypatch.setattr(plat.enable_compilation_cache, "_done", False)
+    monkeypatch.setenv("TPU_SEQALIGN_COMPILE_CACHE", explicit)
+    seen.clear()
+    plat.enable_compilation_cache()
+    assert dict(seen)["jax_compilation_cache_dir"] == explicit
+
+    monkeypatch.setattr(plat.enable_compilation_cache, "_done", False)
+    monkeypatch.setenv("TPU_SEQALIGN_COMPILE_CACHE", "off")
+    seen.clear()
+    plat.enable_compilation_cache()
+    assert not seen

@@ -159,17 +159,32 @@ def _score_ring_backend(seq1, seqs, weights, sp, dp, backend, **pad_kw):
     return [tuple(int(x) for x in row) for row in out]
 
 
-def test_ring_pallas_matches_oracle(rng):
-    """The fused kernel per ring shard (sp and dp x sp meshes) must be
-    bit-exact vs the oracle, including equal-length / overlong / empty."""
+def _ring_pallas_corner_problem(rng):
     seq1 = rng.integers(1, 27, size=300).astype(np.int8)
     seqs = _rand_seqs(rng, 5, 1, 250) + [
         seq1.copy(),  # equal length: device 0's k0 capture
         rng.integers(1, 27, size=350).astype(np.int8),  # > len1: INT_MIN
         np.zeros(0, dtype=np.int8),
     ]
+    return seq1, seqs
+
+
+def test_ring_pallas_matches_oracle(rng):
+    """The fused kernel per ring shard must be bit-exact vs the oracle,
+    including equal-length / overlong / empty."""
+    seq1, seqs = _ring_pallas_corner_problem(rng)
     want = _oracle(seq1, seqs)
     assert _score_ring_backend(seq1, seqs, WEIGHTS, 4, 1, "pallas") == want
+
+
+@pytest.mark.slow
+def test_ring_pallas_2d_mesh_matches_oracle(rng):
+    """The dp x sp composition with the kernel on the same corner batch.
+    Slow tier (a second full interpret compile): the fast tier keeps
+    kernel-on-2-D-mesh coverage via test_conformance's ring2x4-pallas
+    path."""
+    seq1, seqs = _ring_pallas_corner_problem(rng)
+    want = _oracle(seq1, seqs)
     assert _score_ring_backend(seq1, seqs, WEIGHTS, 4, 2, "pallas") == want
 
 
