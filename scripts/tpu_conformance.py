@@ -188,7 +188,7 @@ def main() -> int:
 # reps, median of 3) and is scaled up by at most bench's empirical
 # WALL_INFLATION_BOUND — the r3 linear quiet/probe scale-up could
 # inflate a real regression past the floor (VERDICT r3 weakness 2).
-# Gated quiet-window measurements read 3.8-4.1e13 with the r3/r4 kernel;
+# Gated quiet-window measurements read 3.6-4.1e13 with the r3/r4 kernel;
 # 3.2e13 catches a ~20% regression through the bound's slack.
 INPUT3_FLOOR_ELEMS_PER_SEC = 3.2e13
 

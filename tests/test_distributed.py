@@ -423,3 +423,33 @@ def test_two_process_parse_failure_aborts_worker_instead_of_hanging():
     assert out0 == ""
     assert rc1 == 1, f"worker should abort, got rc={rc1}:\n{err1}"
     assert "abort" in err1.lower() or "coordinator failed" in err1
+
+
+@pytest.mark.slow
+def test_two_process_ring_mesh_golden():
+    """Seq1 ring-sharded ACROSS the two processes (--mesh seq:2): the
+    sequence-parallel tier composes with jax.distributed — the window
+    ppermutes and the candidate all_gather cross the process boundary
+    (DCN in a real multi-host job) — and the coordinator reproduces the
+    golden byte-exact (SURVEY §2.4 SP/CP at multi-host scale)."""
+    (rc0, out0, err0), (rc1, out1, err1) = _launch_pair(
+        "--mesh", "seq:2", stdin_path=fixture_path("equal_len")
+    )
+    assert rc0 == 0, err0
+    assert rc1 == 0, f"worker failed rc={rc1}:\n{err1}"
+    assert out0 == golden("equal_len")
+    assert out1 == ""  # worker prints nothing (main.c ROOT semantics)
+
+
+@pytest.mark.slow
+def test_two_process_2d_mesh_golden():
+    """dp x sp (--mesh 2x2) on a 4-device global mesh spanning two
+    processes: batch scatter and Seq1 ring compose across hosts."""
+    (rc0, out0, err0), (rc1, out1, err1) = _launch_pair(
+        "--mesh", "2x2", stdin_path=fixture_path("mixedcase"),
+        devices_per_proc=2,
+    )
+    assert rc0 == 0, err0
+    assert rc1 == 0, f"worker failed rc={rc1}:\n{err1}"
+    assert out0 == golden("mixedcase")
+    assert out1 == ""
