@@ -64,6 +64,16 @@ are EXPERIMENTS — a winner gets promoted into the production kernel):
              misaligned slice source.  SEMANTICS-PRESERVING — rejected
              r3 (does not reproduce across interleaved passes:
              +2.8/-5.7%; the realignment costs what the area saves).
+
+Scope note (r4): this harness ablates the UNPACKED kernel (`_kernel`),
+which is unchanged in r4 and still the production program for every
+bucket with rows > 64 chars (input3, max-size).  The r4 row-packed
+kernel (`_kernel_packed`) is a separate program for the tiny-Seq2
+classes; its win is established by interleaved packed-vs-unpacked A/Bs
+at the dispatch level (packed 1.8-3.2x on the packable input4 subset,
+BASELINE.md r4 row) rather than by per-stage ablation here — its stages
+are the same rotate/prefix/pack walk with a block-diagonal ltri, so the
+per-stage cost structure above transfers.
 """
 
 from __future__ import annotations

@@ -453,3 +453,41 @@ def test_two_process_2d_mesh_golden():
     assert rc1 == 0, f"worker failed rc={rc1}:\n{err1}"
     assert out0 == golden("mixedcase")
     assert out1 == ""
+
+
+@pytest.mark.slow
+def test_two_process_ring_long_context_beyond_cap(tmp_path):
+    """Long context ACROSS hosts: Seq1 > BUF_SIZE_SEQ1=3000 through
+    --mesh seq:2 on a 2-process job — each process holds HALF of Seq1
+    (per-device memory O(L1/S + L2)), the cap lift composes with
+    jax.distributed, and the coordinator's output matches the host
+    oracle.  This is the multi-host long-context capability end-to-end
+    (SURVEY §5 long-context row), not just the virtual-mesh version."""
+    import numpy as np
+
+    from mpi_openmp_cuda_tpu.models.encoding import decode
+    from mpi_openmp_cuda_tpu.ops.oracle import prefix_best
+
+    rng = np.random.default_rng(42)
+    seq1 = rng.integers(1, 27, size=3600).astype(np.int8)
+    seqs = [
+        rng.integers(1, 27, size=n).astype(np.int8) for n in (80, 700, 3599)
+    ]
+    inp = tmp_path / "long.txt"
+    inp.write_text(
+        "10 2 3 4\n" + decode(seq1) + f"\n{len(seqs)}\n"
+        + "\n".join(decode(s) for s in seqs) + "\n"
+    )
+    (rc0, out0, err0), (rc1, out1, err1) = _launch_pair(
+        "--mesh", "seq:2", stdin_path=str(inp)
+    )
+    assert rc0 == 0, err0
+    assert rc1 == 0, f"worker failed rc={rc1}:\n{err1}"
+    want = "".join(
+        f"#{i}: score: {s}, n: {n}, k: {k}\n"
+        for i, (s, n, k) in enumerate(
+            prefix_best(seq1, s2, [10, 2, 3, 4]) for s2 in seqs
+        )
+    )
+    assert out0 == want
+    assert out1 == ""
