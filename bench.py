@@ -573,6 +573,36 @@ def probe_record_fields(
     return rec, warn
 
 
+def probe_gate():
+    """``(on_tpu, quiet_ref, gate)`` for the current default device — the
+    shared preamble of every probe-gated harness (this file's ``main`` and
+    ``scripts/ring_bench.py``)."""
+    import jax
+
+    on_tpu = jax.devices()[0].platform == "tpu"
+    quiet_ref = (
+        QUIET_BF16_BY_KIND.get(jax.devices()[0].device_kind) if on_tpu else None
+    )
+    gate = quiet_ref * PROBE_GATE_FRACTION if quiet_ref else None
+    return on_tpu, quiet_ref, gate
+
+
+def attempt_logger(on_tpu: bool, prefix: str = "[bench]"):
+    """Stderr logger for ``run_attempts(log=...)``, shared across the
+    probe-gated harnesses so their records read identically."""
+
+    def log(att, rounds, a):
+        print(
+            f"{prefix} attempt {att + 1}/{rounds}: steady {a.wall:.2e}s"
+            + (f" probes {a.p0 if a.p0 is not None else float('nan'):.0f}/"
+               f"{a.p1 if a.p1 is not None else float('nan'):.0f} TFLOP/s"
+               if on_tpu else ""),
+            file=sys.stderr,
+        )
+
+    return log
+
+
 def main() -> None:
     # Respect an explicit JAX_PLATFORMS choice (TPU site hooks clobber it):
     # a CPU-forced bench (the pytest contract test) must actually run CPU.
@@ -627,27 +657,14 @@ def main() -> None:
     reps = max(1, int(os.environ.get("BENCH_AMORT_REPS", "1024")))
     medians = int(os.environ.get("BENCH_MEDIAN", "3"))
     max_attempts = max(1, int(os.environ.get("BENCH_ATTEMPTS", "12")))
-    on_tpu = jax.devices()[0].platform == "tpu"
-    quiet_ref = QUIET_BF16_BY_KIND.get(
-        jax.devices()[0].device_kind
-    ) if on_tpu else None
-    gate = quiet_ref * PROBE_GATE_FRACTION if quiet_ref else None
-
-    def log(att, rounds, a):
-        print(
-            f"[bench] attempt {att + 1}/{rounds}: steady {a.wall:.2e}s"
-            + (f" probes {a.p0 if a.p0 is not None else float('nan'):.0f}/"
-               f"{a.p1 if a.p1 is not None else float('nan'):.0f} TFLOP/s"
-               if on_tpu else ""),
-            file=sys.stderr,
-        )
+    on_tpu, quiet_ref, gate = probe_gate()
 
     attempts = run_attempts(
         lambda: steady_state_wall(problem, backend, reps=reps, medians=medians),
         probe_or_none if on_tpu else None,
         gate=gate,
         max_attempts=max_attempts,
-        log=log,
+        log=attempt_logger(on_tpu),
     )
     chosen, was_gated = select_attempt(attempts, gate)
     wall, probe_min = chosen.wall, chosen.pmin

@@ -92,18 +92,10 @@ def ring_steady_wall(rs, batch, val_flat, reps: int, medians: int = 1,
 def _attempted(measure, on_tpu, gate, quiet_ref, max_attempts, value_of):
     """bench.py's probe-bracketed attempt loop around ``measure``; returns
     (record_fields, chosen wall)."""
-    def log(att, rounds, a):
-        print(
-            f"[ring-bench] attempt {att + 1}/{rounds}: steady {a.wall:.2e}s"
-            + (f" probes {a.p0 if a.p0 is not None else float('nan'):.0f}/"
-               f"{a.p1 if a.p1 is not None else float('nan'):.0f} TFLOP/s"
-               if on_tpu else ""),
-            file=sys.stderr,
-        )
-
     attempts = run_attempts(
         measure, probe_or_none if on_tpu else None, gate=gate,
-        max_attempts=max_attempts, log=log,
+        max_attempts=max_attempts,
+        log=bench.attempt_logger(on_tpu, prefix="[ring-bench]"),
     )
     chosen, gated = select_attempt(attempts, gate)
     fields, warn = probe_record_fields(
@@ -129,11 +121,7 @@ def main() -> None:
     from mpi_openmp_cuda_tpu.ops.values import value_table
     from mpi_openmp_cuda_tpu.parallel.ring import RingSharding
 
-    on_tpu = jax.devices()[0].platform == "tpu"
-    quiet_ref = bench.QUIET_BF16_BY_KIND.get(
-        jax.devices()[0].device_kind
-    ) if on_tpu else None
-    gate = quiet_ref * bench.PROBE_GATE_FRACTION if quiet_ref else None
+    on_tpu, quiet_ref, gate = bench.probe_gate()
     reps = max(1, int(os.environ.get("RING_BENCH_REPS", "256")))
     medians = int(os.environ.get("RING_BENCH_MEDIAN", "3"))
     max_attempts = max(1, int(os.environ.get("RING_BENCH_ATTEMPTS", "6")))
