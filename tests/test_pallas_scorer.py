@@ -503,3 +503,36 @@ def test_rowpack_accounting_matches_walk():
     el = kernel_vpu_pass_elems(len1, lens, nbn * 128, 128, "i8", sb=sb, l2s=l2s)
     assert set(el) == {"rotate", "cast", "fma"}
     assert el["rotate"] == t * 2 * (sbw + 128) * 128
+
+
+def test_plan_buckets_contract():
+    """plan_buckets is shared by the production dispatch AND the bench's
+    production_schedule/FLOP accounting — a silent regression corrupts
+    both.  Pin its contract: every index appears exactly once, keys are
+    L2P shape buckets (plus sub-128 packing classes when packable),
+    sub-min_rows straggler groups merge into the NEXT wider key, and the
+    widest key is never merged away."""
+    from mpi_openmp_cuda_tpu.ops.dispatch import plan_buckets
+
+    # Shape bucketing, not packable: keys are 128-multiples.
+    g = plan_buckets([5, 64, 129, 200, 1999], packable=False, min_rows=1)
+    assert sorted(g) == [128, 256, 2048]
+    assert sorted(i for idxs in g.values() for i in idxs) == [0, 1, 2, 3, 4]
+
+    # Packable: sub-64 rows key to packing classes {8, 16, 32, 64}.
+    g = plan_buckets([5, 9, 33, 64, 65], packable=True, min_rows=1)
+    assert sorted(g) == [8, 16, 64, 128]
+    assert g[8] == [0] and g[16] == [1] and g[64] == [2, 3] and g[128] == [4]
+
+    # Straggler merge: a lone class-8 row rides up into the class-16
+    # group; the merged group keeps every index.
+    g = plan_buckets([5, 9, 10, 11], packable=True, min_rows=2)
+    assert sorted(g) == [16]
+    assert sorted(g[16]) == [0, 1, 2, 3]
+
+    # The widest key survives even below min_rows (nothing wider to
+    # merge into), and zero-length rows still get a bucket.
+    g = plan_buckets([1999], packable=False, min_rows=4)
+    assert g == {2048: [0]}
+    g = plan_buckets([0, 50], packable=False, min_rows=1)
+    assert sorted(i for idxs in g.values() for i in idxs) == [0, 1]
