@@ -177,20 +177,15 @@ def production_schedule(problem, backend: str):
     return val, sched
 
 
-def steady_state_wall(problem, backend: str, reps: int, medians: int = 1) -> float:
-    """Per-run device wall-clock with host round-trip latency amortised.
+def steady_state_progs(problem, backend: str, reps: int) -> dict:
+    """Compile + warm the two amortised-loop programs for
+    ``steady_state_wall``'s slope protocol; returns the ``progs`` dict
+    (rep count -> forcing thunk) for ``steady_slope_median``.
 
-    Remote-tunnelled TPU setups add a fixed ~10-100 ms host<->device
-    round-trip per fetch that is an artifact of the link, not the
-    framework.  Standard fix: run the scorer ``reps`` times inside one
-    jitted computation (each rep permutes the batch within chunks via roll,
-    so nothing can be hoisted out of the loop; results are
-    permutation-invariant) and fetch once; the slope between a short and a
-    long loop is the true per-run time.  ``reps`` must be large enough
-    that the device-time increment dwarfs the link's ±25 ms jitter (at
-    the default 1024 reps the increment is ~10x the jitter); each wall is
-    the MIN of several timed calls (link noise is one-sided), and
-    ``medians`` repeats the whole slope measurement, returning the median.
+    Split out from the measurement so probe-gated harnesses compile ONCE
+    before their attempt loop: with compilation inside each attempt, the
+    bracketing probes certify a window that is mostly compile time, not
+    the timed slope (r4 ADVICE).
     """
     import jax
     import jax.numpy as jnp
@@ -230,12 +225,41 @@ def steady_state_wall(problem, backend: str, reps: int, medians: int = 1) -> flo
         fns[k] = make(k)
         int(fns[k](*call_args))  # warm/compile + force, once per program
 
-    progs = {k: (lambda f=f: int(f(*call_args))) for k, f in fns.items()}
+    return {k: (lambda f=f: int(f(*call_args))) for k, f in fns.items()}
+
+
+def steady_slope_median(progs: dict, reps: int, medians: int = 1) -> float:
+    """``medians`` repeats of the two-point slope over pre-compiled
+    ``progs``; the timed body a probe-gated attempt should bracket."""
     slopes = [min_wall_slope(progs) for _ in range(max(1, medians))]
     warn = slope_spread_warning(slopes, reps)
     if warn:
         print(warn, file=sys.stderr)
     return float(np.median(slopes))
+
+
+def steady_state_wall(problem, backend: str, reps: int, medians: int = 1) -> float:
+    """Per-run device wall-clock with host round-trip latency amortised.
+
+    Remote-tunnelled TPU setups add a fixed ~10-100 ms host<->device
+    round-trip per fetch that is an artifact of the link, not the
+    framework.  Standard fix: run the scorer ``reps`` times inside one
+    jitted computation (each rep permutes the batch within chunks via roll,
+    so nothing can be hoisted out of the loop; results are
+    permutation-invariant) and fetch once; the slope between a short and a
+    long loop is the true per-run time.  ``reps`` must be large enough
+    that the device-time increment dwarfs the link's ±25 ms jitter (at
+    the default 1024 reps the increment is ~10x the jitter); each wall is
+    the MIN of several timed calls (link noise is one-sided), and
+    ``medians`` repeats the whole slope measurement, returning the median.
+
+    Convenience wrapper (compile + measure in one call) for ungated
+    consumers; probe-gated attempt loops call ``steady_state_progs`` once
+    and then measure ``steady_slope_median`` per attempt.
+    """
+    return steady_slope_median(
+        steady_state_progs(problem, backend, reps), reps, medians
+    )
 
 
 def slope_spread_warning(slopes, reps: int) -> str | None:
@@ -659,8 +683,11 @@ def main() -> None:
     max_attempts = max(1, int(os.environ.get("BENCH_ATTEMPTS", "12")))
     on_tpu, quiet_ref, gate = probe_gate()
 
+    # Compile ONCE, outside the attempt loop: the probes must bracket only
+    # the timed slope measurement, not a recompile per attempt (r4 ADVICE).
+    progs = steady_state_progs(problem, backend, reps=reps)
     attempts = run_attempts(
-        lambda: steady_state_wall(problem, backend, reps=reps, medians=medians),
+        lambda: steady_slope_median(progs, reps, medians),
         probe_or_none if on_tpu else None,
         gate=gate,
         max_attempts=max_attempts,

@@ -33,7 +33,9 @@ ring schedule itself taxes the fused kernel ~1.04-1.14x at reference
 scale (input3 through ring-sp1 vs direct, two gated session pairs), and
 the unbounded tier sustains 1.14e14 eq-comparisons/s/chip at Seq1 = 4x
 the reference's cap and 3.83e14 at 8x with Seq2 at 2x its cap
-(BASELINE.md r4 ring row).
+(BASELINE.md r4 ring row; the eq metric is the reference's
+(L1-L2)*L2^2 cost model while the ring does O(L1*L2) real work, so the
+past-cap numbers partly measure that blow-up — walls 3.28/7.12 ms).
 """
 
 from __future__ import annotations

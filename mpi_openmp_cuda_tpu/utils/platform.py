@@ -28,6 +28,27 @@ def apply_platform_override() -> None:
         jax.config.update("jax_platforms", envp)
 
 
+def _platform_tag() -> str:
+    """The cache-partition tag for this process's platform configuration:
+    ``JAX_PLATFORMS`` (or, unset, an init-free TPU-plugin-presence proxy —
+    querying the backend here would initialize it, which must stay AFTER
+    ``jax.distributed.initialize`` on multi-host) plus any virtual
+    host-device count from ``XLA_FLAGS``."""
+    tag = os.environ.get("JAX_PLATFORMS", "").replace(",", "-")
+    if not tag:
+        import importlib.util
+
+        tag = (
+            "tpu-plugin"
+            if importlib.util.find_spec("libtpu") is not None
+            else "default"
+        )
+    for tok in os.environ.get("XLA_FLAGS", "").split():
+        if "xla_force_host_platform_device_count" in tok:
+            tag += "-hd" + tok.split("=")[-1]
+    return tag
+
+
 def enable_compilation_cache() -> None:
     """Point JAX's persistent compilation cache at a stable directory.
 
@@ -39,10 +60,15 @@ def enable_compilation_cache() -> None:
     skips straight to execution (VERDICT r3 item 4).
 
     ``TPU_SEQALIGN_COMPILE_CACHE`` overrides the location; ``off`` (or
-    ``0``) disables.  Failures are non-fatal: a read-only home directory
-    degrades to the in-memory cache, never to an error.  Idempotent and
-    once-per-process: the native bridge calls this on every scoring
-    batch, which must not repeat the mkdir/config writes on a hot path.
+    ``0``) disables.  Explicit locations get the same per-platform-config
+    subdirectory as the default (see ``_platform_tag``): an override names
+    where the cache lives, never permission to share one directory across
+    platform configurations — that sharing is exactly the cross-config
+    deserialization crash the partitioning exists to prevent.  Failures
+    are non-fatal: a read-only home directory degrades to the in-memory
+    cache, never to an error.  Idempotent and once-per-process: the
+    native bridge calls this on every scoring batch, which must not
+    repeat the mkdir/config writes on a hot path.
     """
     if getattr(enable_compilation_cache, "_done", False):
         return
@@ -51,37 +77,20 @@ def enable_compilation_cache() -> None:
     if loc is not None and loc.strip().lower() in ("off", "0", ""):
         return
     if loc is None:
-        # Partition the default location by platform configuration.  One
-        # shared directory is NOT safe: entries written by a TPU-plugin
-        # process and read by a JAX_PLATFORMS=cpu process (or written
-        # under a different virtual-device-count XLA_FLAGS) deserialize
-        # XLA:CPU executables compiled for a different machine
-        # configuration — observed as "Compile machine features ...
-        # doesn't match" warnings and, reproducibly, a segfault inside
-        # compilation_cache.get_executable_and_time during the test
-        # suite.  Writers and readers must share the tag exactly.
-        tag = os.environ.get("JAX_PLATFORMS", "").replace(",", "-")
-        if not tag:
-            # No explicit platform choice: a TPU-plugin process and a
-            # CPU-fallback process must still land in different
-            # directories (the backend itself cannot be queried here —
-            # that would initialize it, which has to stay AFTER
-            # jax.distributed.initialize on multi-host).  Plugin
-            # presence is the best init-free proxy.
-            import importlib.util
-
-            tag = (
-                "tpu-plugin"
-                if importlib.util.find_spec("libtpu") is not None
-                else "default"
-            )
-        flags = os.environ.get("XLA_FLAGS", "")
-        for tok in flags.split():
-            if "xla_force_host_platform_device_count" in tok:
-                tag += "-hd" + tok.split("=")[-1]
         loc = os.path.join(
-            os.path.expanduser("~"), ".cache", "mpi_openmp_cuda_tpu", "jax", tag
+            os.path.expanduser("~"), ".cache", "mpi_openmp_cuda_tpu", "jax"
         )
+    # Partition the location by platform configuration.  One shared
+    # directory is NOT safe: entries written by a TPU-plugin process and
+    # read by a JAX_PLATFORMS=cpu process (or written under a different
+    # virtual-device-count XLA_FLAGS) deserialize XLA:CPU executables
+    # compiled for a different machine configuration — observed as
+    # "Compile machine features ... doesn't match" warnings and,
+    # reproducibly, a segfault inside
+    # compilation_cache.get_executable_and_time during the test suite.
+    # Writers and readers must share the tag exactly, so explicit
+    # override paths are partitioned too.
+    loc = os.path.join(loc, _platform_tag())
     try:
         os.makedirs(loc, exist_ok=True)
         import jax

@@ -45,7 +45,11 @@ apply_platform_override()
 # Production entry points keep the cache (platform.py partitions its
 # directory per platform config so TPU-process and CPU-process
 # executables never cross-load).
-os.environ.setdefault("TPU_SEQALIGN_COMPILE_CACHE", "off")
+# Hard-set (not setdefault): a developer with the var exported to a real
+# directory must not silently run the suite with the cache enabled — the
+# exact configuration the incident note above says segfaulted in cache
+# reads (r4 ADVICE).
+os.environ["TPU_SEQALIGN_COMPILE_CACHE"] = "off"
 enable_compilation_cache()
 
 import numpy as np  # noqa: E402

@@ -1,6 +1,8 @@
 """Public package surface: the lazily-exported front door and the README
 library example (which once shipped a wrong expected value)."""
 
+import os
+
 import mpi_openmp_cuda_tpu as pkg
 import pytest
 
@@ -67,14 +69,21 @@ def test_compile_cache_dir_partitioned_by_platform_config(monkeypatch, tmp_path)
     assert bare.endswith("default") and plugin.endswith("tpu-plugin")
     assert len({cpu8, cpu, bare, plugin}) == 4
 
-    # An explicit override is used verbatim (no tag suffix), and "off"
-    # disables the cache entirely.
+    # An explicit override is partitioned by the same platform-config tag
+    # as the default (r4 ADVICE: a TPU process and a JAX_PLATFORMS=cpu
+    # process pointed at one explicit directory would reintroduce the
+    # cross-config deserialization segfault), and "off" disables the
+    # cache entirely.
     explicit = str(tmp_path / "explicit-cache")
-    monkeypatch.setattr(plat.enable_compilation_cache, "_done", False)
     monkeypatch.setenv("TPU_SEQALIGN_COMPILE_CACHE", explicit)
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    monkeypatch.setenv("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+    monkeypatch.setattr(plat.enable_compilation_cache, "_done", False)
     seen.clear()
     plat.enable_compilation_cache()
-    assert dict(seen)["jax_compilation_cache_dir"] == explicit
+    assert dict(seen)["jax_compilation_cache_dir"] == os.path.join(
+        explicit, "cpu-hd8"
+    )
 
     monkeypatch.setattr(plat.enable_compilation_cache, "_done", False)
     monkeypatch.setenv("TPU_SEQALIGN_COMPILE_CACHE", "off")
