@@ -228,9 +228,12 @@ def steady_state_progs(problem, backend: str, reps: int) -> dict:
     return {k: (lambda f=f: int(f(*call_args))) for k, f in fns.items()}
 
 
-def steady_slope_median(progs: dict, reps: int, medians: int = 1) -> float:
+def steady_slope_median(progs: dict, medians: int = 1) -> float:
     """``medians`` repeats of the two-point slope over pre-compiled
-    ``progs``; the timed body a probe-gated attempt should bracket."""
+    ``progs``; the timed body a probe-gated attempt should bracket.
+    The rep count feeding the interference gate is derived from the
+    progs keys themselves — hand-pairing it went wrong silently."""
+    reps = max(progs) - min(progs)
     slopes = [min_wall_slope(progs) for _ in range(max(1, medians))]
     warn = slope_spread_warning(slopes, reps)
     if warn:
@@ -258,7 +261,7 @@ def steady_state_wall(problem, backend: str, reps: int, medians: int = 1) -> flo
     and then measure ``steady_slope_median`` per attempt.
     """
     return steady_slope_median(
-        steady_state_progs(problem, backend, reps), reps, medians
+        steady_state_progs(problem, backend, reps), medians
     )
 
 
@@ -687,7 +690,7 @@ def main() -> None:
     # the timed slope measurement, not a recompile per attempt (r4 ADVICE).
     progs = steady_state_progs(problem, backend, reps=reps)
     attempts = run_attempts(
-        lambda: steady_slope_median(progs, reps, medians),
+        lambda: steady_slope_median(progs, medians),
         probe_or_none if on_tpu else None,
         gate=gate,
         max_attempts=max_attempts,

@@ -114,6 +114,23 @@ class BatchSharding:
     ) -> ShardedPending:
         """``score`` without forcing the gather: returns a
         :class:`ShardedPending` immediately after the shard_map dispatch."""
+        fn, args, b = self._prepare(
+            batch, val_flat, backend=backend, chunk_budget=chunk_budget
+        )
+        return ShardedPending(fn(*args), b)
+
+    def _prepare(
+        self,
+        batch: PaddedBatch,
+        val_flat: np.ndarray,
+        backend: str = "xla",
+        chunk_budget: int = DEFAULT_CHUNK_BUDGET,
+    ):
+        """Resolve the compiled sharded program and its device-placed
+        arguments without dispatching: ``(fn, args, batch_size)`` — the
+        same split as ``RingSharding._prepare``, shared by ``score_async``
+        and the compiled-collective-structure tests (which lower exactly
+        the production program)."""
         import jax.numpy as jnp
 
         from ..ops.dispatch import choose_pallas_formulation, xla_formulation_mode
@@ -169,10 +186,8 @@ class BatchSharding:
         )
         len1_d = jnp.int32(batch.len1)
 
-        out = _sharded_fn(self.mesh, cb, mode)(
-            seq1_d, len1_d, rows_d, lens_d, val_d
-        )
-        return ShardedPending(out, b)
+        fn = _sharded_fn(self.mesh, cb, mode)
+        return fn, (seq1_d, len1_d, rows_d, lens_d, val_d), b
 
 
 @functools.lru_cache(maxsize=64)

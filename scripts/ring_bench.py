@@ -137,7 +137,7 @@ def main() -> None:
 
     ring_progs = ring_steady_progs(rs, batch, val_flat, reps, backend)
     fields, wall = _attempted(
-        lambda: bench.steady_slope_median(ring_progs, reps, medians),
+        lambda: bench.steady_slope_median(ring_progs, medians),
         on_tpu, gate, quiet_ref, max_attempts, lambda w: elements / w,
     )
     # The direct-dispatch baseline gets the SAME probe-bracketed attempt
@@ -145,7 +145,7 @@ def main() -> None:
     # silently distort the published overhead ratio (r4 code review).
     direct_progs = bench.steady_state_progs(problem, backend, reps=reps)
     dfields, direct = _attempted(
-        lambda: bench.steady_slope_median(direct_progs, reps, medians),
+        lambda: bench.steady_slope_median(direct_progs, medians),
         on_tpu, gate, quiet_ref, max_attempts, lambda w: elements / w,
     )
     rec = {
@@ -179,7 +179,7 @@ def main() -> None:
 
     long_progs = ring_steady_progs(rs, lbatch, val_flat, reps, backend)
     fields, wall = _attempted(
-        lambda: bench.steady_slope_median(long_progs, reps, medians),
+        lambda: bench.steady_slope_median(long_progs, medians),
         on_tpu, gate, quiet_ref, max_attempts, lambda w: lelements / w,
     )
     rec = {

@@ -71,6 +71,27 @@ def rng() -> np.random.Generator:
     return np.random.default_rng(0)
 
 
+def collective_ops(hlo_text: str) -> list[tuple[str, int]]:
+    """``(op, result_elements)`` for every cross-device collective in an
+    optimized-HLO dump — the statically-auditable collective set of a
+    compiled SPMD program, the TPU analogue of reading the MPI calls off
+    ``/root/reference/main.c:149-197``.  Matches both sync ops and their
+    ``-start`` async halves (``-done`` carries no second collective).
+    Used by the collective-structure tests (VERDICT r4 item 1)."""
+    import re
+
+    ops = []
+    for m in re.finditer(
+        r"=\s*(\(?\s*[a-z0-9]+\[([\d,]*)\])[^=]*?\s"
+        r"(all-gather|all-reduce|collective-permute|all-to-all|"
+        r"reduce-scatter|collective-broadcast)(-start)?\(",
+        hlo_text,
+    ):
+        dims = [int(d) for d in m.group(2).split(",") if d]
+        ops.append((m.group(3), int(np.prod(dims)) if dims else 1))
+    return ops
+
+
 def run_cli_inproc(*args, capsys, rc_want=0):
     """In-process ``cli.run`` returning captured ``(stdout, stderr)``.
 

@@ -196,6 +196,30 @@ def test_cli_mesh_flag_byte_exact():
         assert proc.stdout == f.read()
 
 
+@pytest.mark.parametrize("backend", ["xla", "xla-gather"])
+def test_batch_program_compiles_to_zero_collectives(backend):
+    """The dp tier's compiled SPMD program must contain NO cross-device
+    collectives at all (VERDICT r4 item 1): the scatter/broadcast are
+    layout annotations on the inputs, each shard computes independently,
+    and the output STAYS batch-sharded (the gather is the deferred host
+    fetch, not a device collective).  An XLA/shard_map regression that
+    resharded mid-program (e.g. all-gathering the replicated-in-spirit
+    rows) would pass every results test; this is the static audit —
+    reference contrast: MPI_Scatter/Bcast/Gather are explicit calls in
+    main.c:149-197."""
+    from conftest import collective_ops
+
+    rng = np.random.default_rng(7)
+    seq1 = rng.integers(1, 27, size=70).astype(np.int8)
+    seqs = [rng.integers(1, 27, size=n).astype(np.int8) for n in (40, 9, 33, 21, 5)]
+    batch = pad_problem(seq1, seqs)
+    val_flat = value_table(W).astype(np.int32).reshape(-1)
+    sharding = BatchSharding.over_devices(8)
+    fn, args, _b = sharding._prepare(batch, val_flat, backend=backend)
+    hlo = fn.lower(*args).compile().as_text()
+    assert collective_ops(hlo) == []
+
+
 def test_distributed_single_process_noop():
     from mpi_openmp_cuda_tpu.parallel.distributed import (
         broadcast_from_coordinator,

@@ -273,6 +273,71 @@ def test_ring_matches_fixture_golden():
     assert got == [(s, n, k) for (s, n, k) in want]
 
 
+def _ring_compiled_collectives(seq1, seqs, sp, dp, backend, weights=WEIGHTS):
+    """Lower + compile the EXACT production ring program (shared
+    ``_prepare``) and return (collective op list, batch, bl)."""
+    from conftest import collective_ops
+
+    batch = pad_problem(seq1, seqs, enforce_caps=False)
+    val_flat = value_table(weights).astype(np.int32).reshape(-1)
+    rs = RingSharding.over_devices(seq=sp, batch=dp)
+    fn, args, _b = rs._prepare(batch, val_flat, backend=backend)
+    bl = args[2].shape[0] // dp  # per-device padded rows
+    hlo = fn.lower(*args).compile().as_text()
+    return collective_ops(hlo), batch, bl
+
+
+def _assert_ring_structure(ops, batch, bl, sp, dp, pallas):
+    """The compiled-collective-structure contract (VERDICT r4 item 1):
+    exactly R neighbour block exchanges plus ONE tiny candidate
+    all-gather — never an all-gather/all-reduce of a Seq1-sized operand,
+    which is what guards the ring's O(Bs + L2) per-device memory claim
+    against a silent XLA/shard_map rewrite that results-only tests
+    cannot see.  The reference's equivalent contract is the statically
+    visible MPI collective set (main.c:149-197)."""
+    from mpi_openmp_cuda_tpu.parallel.ring import ring_plan
+
+    bs, r_steps = ring_plan(batch.l1p, batch.l2p, sp, pallas=pallas)
+    permutes = [e for op, e in ops if op == "collective-permute"]
+    assert len(permutes) == r_steps, (ops, bs, r_steps)
+    # Each exchange moves exactly one neighbour block, not the sequence.
+    assert all(e == bs for e in permutes), (permutes, bs)
+    gathers = [e for op, e in ops if op == "all-gather"]
+    assert gathers == [sp * bl * 4], (gathers, sp, bl)
+    # Nothing else — no all-reduce / all-to-all / reduce-scatter, and no
+    # collective whose result is Seq1-sized (the banned full gather).
+    assert len(ops) == r_steps + 1, ops
+    assert all(e < batch.l1p for _, e in ops), ops
+
+
+def test_ring_compiled_collective_structure(rng):
+    """Seq1 = 2048 over sp=8 (Bs=256), L2P=384 -> R=2: the optimized HLO
+    must contain exactly 2 block-sized collective-permutes and one
+    [sp, bl, 4] candidate all-gather."""
+    seq1 = rng.integers(1, 27, size=2048).astype(np.int8)
+    seqs = [rng.integers(1, 27, size=n).astype(np.int8) for n in (300, 150, 270, 80)]
+    ops, batch, bl = _ring_compiled_collectives(seq1, seqs, 8, 1, "xla")
+    _assert_ring_structure(ops, batch, bl, sp=8, dp=1, pallas=False)
+
+
+def test_ring_compiled_collective_structure_2d_mesh(rng):
+    """dp x sp composition: the dp axis adds NO collectives (rows are
+    independent); the seq-axis structure is unchanged."""
+    seq1 = rng.integers(1, 27, size=1024).astype(np.int8)
+    seqs = [rng.integers(1, 27, size=n).astype(np.int8) for n in (500, 80, 200)]
+    ops, batch, bl = _ring_compiled_collectives(seq1, seqs, 4, 2, "xla")
+    _assert_ring_structure(ops, batch, bl, sp=4, dp=2, pallas=False)
+
+
+def test_ring_pallas_compiled_collective_structure(rng):
+    """The fused-kernel formulation keeps the identical collective set:
+    the kernel only replaces the per-shard compute body."""
+    seq1 = rng.integers(1, 27, size=333).astype(np.int8)
+    seqs = [rng.integers(1, 27, size=n).astype(np.int8) for n in (150, 170, 190)]
+    ops, batch, bl = _ring_compiled_collectives(seq1, seqs, 4, 1, "pallas")
+    _assert_ring_structure(ops, batch, bl, sp=4, dp=1, pallas=True)
+
+
 @pytest.mark.slow
 def test_ring_pallas_mostly_dead_shards_kernel_path(rng, monkeypatch):
     """VERDICT r3 item 8: the fused-KERNEL ring path on a cap-scale mesh
