@@ -177,6 +177,81 @@ def production_schedule(problem, backend: str):
     return val, sched
 
 
+def kernel_floor_counts(problem, backend: str, buckets: bool = True):
+    """``(mxu_flops, vpu_pass_elems, feed)`` for one dispatch of
+    ``problem`` — ``feed`` is None when any part would fall off the fused
+    kernel (wide weights / unaligned buckets), in which case the counts
+    describe work that never runs and must not be recorded.
+
+    ``buckets=True`` walks the SAME production bucket schedule the steady
+    measurement times (``production_schedule``), chunk by chunk with each
+    bucket's own sb and row-packing decision — including the chunk-padding
+    rows, whose all-padding packed tiles still execute super-block 0.
+    ``buckets=False`` counts the UNBUCKETED whole-batch program instead —
+    the single-program accounting BASELINE.md's floor-closure analysis is
+    stated in ("Schedule-level vs single-program": the bucket split's
+    counted pass elements are lower because narrow buckets trade dead-lane
+    work for per-call overhead the pass-element model deliberately does
+    not price, while the measured walls are equal to within noise — the
+    bucket-merge A/B).  Emitting both makes the official record
+    self-explanatory on the floor claim (VERDICT r4 item 6).
+    """
+    from mpi_openmp_cuda_tpu.ops.dispatch import (
+        DEFAULT_CHUNK_BUDGET,
+        choose_chunk,
+        choose_pallas_formulation,
+        choose_rowpack,
+        effective_backend,
+        pad_batch_rows,
+        pad_problem,
+        round_up,
+    )
+    from mpi_openmp_cuda_tpu.ops.pallas_scorer import (
+        choose_superblock,
+        kernel_mxu_flops,
+        kernel_vpu_pass_elems,
+    )
+    from mpi_openmp_cuda_tpu.ops.values import value_table
+
+    val_flat = value_table(problem.weights).reshape(-1)
+    if buckets:
+        _, sched = production_schedule(problem, backend)
+        parts = [(p["batch"], np.asarray(p["lens"])) for p in sched]
+    else:
+        batch = pad_problem(problem.seq1_codes, problem.seq2_codes)
+        cb = choose_chunk(
+            batch, DEFAULT_CHUNK_BUDGET,
+            backend=effective_backend(backend, val_flat),
+        )
+        bp = round_up(batch.batch_size, cb)
+        _, lens = pad_batch_rows(batch, bp)
+        parts = [(batch, lens.reshape(bp // cb, cb))]
+
+    flops = 0
+    vpu_elems = 0
+    feed = None
+    for sub, lens_chunks in parts:
+        fm = choose_pallas_formulation(val_flat, (sub.l1p, sub.l2p))
+        if fm[0] != "pallas":
+            return flops, vpu_elems, None
+        feed = fm[1]
+        sb = choose_superblock(
+            sub.l1p // 128, sub.l2p // 128, sub.len1, sub.len2, feed
+        )
+        l2s = choose_rowpack(feed, sub.l2p, sub.len2)
+        for chunk_lens in lens_chunks:
+            flops += kernel_mxu_flops(
+                sub.len1, chunk_lens, sub.l1p, sub.l2p, feed, sb=sb, l2s=l2s
+            )
+            vpu_elems += sum(
+                kernel_vpu_pass_elems(
+                    sub.len1, chunk_lens, sub.l1p, sub.l2p, feed,
+                    sb=sb, l2s=l2s,
+                ).values()
+            )
+    return flops, vpu_elems, feed
+
+
 def steady_state_progs(problem, backend: str, reps: int) -> dict:
     """Compile + warm the two amortised-loop programs for
     ``steady_state_wall``'s slope protocol; returns the ``progs`` dict
@@ -736,54 +811,7 @@ def main() -> None:
     # STEADY_CLAMP_FLOOR): an MFU computed there measures the link, not
     # the kernel, and reads as nonsense (>>1).
     if backend == "pallas" and wall > 50e-6:
-        from mpi_openmp_cuda_tpu.ops.dispatch import (
-            choose_pallas_formulation,
-            choose_rowpack,
-        )
-        from mpi_openmp_cuda_tpu.ops.pallas_scorer import (
-            choose_superblock,
-            kernel_mxu_flops,
-            kernel_vpu_pass_elems,
-        )
-        from mpi_openmp_cuda_tpu.ops.values import value_table
-
-        val_flat = value_table(problem.weights).reshape(-1)
-        # The FLOP/VPU accounting walks the SAME schedule the steady
-        # measurement timed (production_schedule), chunk by chunk with
-        # each bucket's own sb and row-packing decision — including the
-        # chunk-padding rows, whose all-padding packed tiles still
-        # execute super-block 0.
-        _, sched = production_schedule(problem, backend)
-        flops = 0
-        vpu_elems = 0
-        all_kernel = True
-        for part in sched:
-            sub = part["batch"]
-            # Same routing the dispatch layer applies: wide weights or
-            # unaligned buckets fall back to non-kernel bodies, where
-            # this FLOP model would describe work that never ran.
-            fm = choose_pallas_formulation(val_flat, (sub.l1p, sub.l2p))
-            if fm[0] != "pallas":
-                all_kernel = False
-                break
-            feed = fm[1]
-            sb = choose_superblock(
-                sub.l1p // 128, sub.l2p // 128, sub.len1, sub.len2, feed
-            )
-            l2s = choose_rowpack(feed, sub.l2p, sub.len2)
-            for chunk_lens in np.asarray(part["lens"]):
-                flops += kernel_mxu_flops(
-                    sub.len1, chunk_lens, sub.l1p, sub.l2p, feed,
-                    sb=sb, l2s=l2s,
-                )
-                vpu_elems += sum(
-                    kernel_vpu_pass_elems(
-                        sub.len1, chunk_lens, sub.l1p, sub.l2p, feed,
-                        sb=sb, l2s=l2s,
-                    ).values()
-                )
-        if not all_kernel:
-            feed = None
+        flops, vpu_elems, feed = kernel_floor_counts(problem, backend)
         if feed is not None:
             real_tflops = flops / wall / 1e12
             record["real_tflops"] = round(real_tflops, 1)
@@ -814,6 +842,29 @@ def main() -> None:
                     record["vpu_probe_arith_gelems"] = round(vrate / 1e9, 1)
                     record["vpu_floor_us"] = round(floor_s * 1e6, 1)
                     record["wall_vs_vpu_floor"] = round(wall / floor_s, 2)
+                    # Two floor variants, labelled (VERDICT r4 item 6 —
+                    # the r4 record's bare schedule-level 2.3x read as a
+                    # different story than BASELINE.md's per-program
+                    # 1.4x/1.10x closure): "schedule" counts the
+                    # production bucket split's pass elements; "single
+                    # program" counts the unbucketed whole-batch program
+                    # the ablations target.  The schedule's extra ratio
+                    # is per-call overhead x buckets and narrow-bucket
+                    # iteration floors — costs the pass-element model
+                    # deliberately excludes — while measured walls are
+                    # A/B-equal between the two dispatches.
+                    record["vpu_floor_kind"] = "schedule"
+                    _, sp_elems, sp_feed = kernel_floor_counts(
+                        problem, backend, buckets=False
+                    )
+                    if sp_feed == feed and sp_elems:
+                        sp_floor = sp_elems / (VPU_COISSUE * vrate)
+                        record["vpu_floor_us_single_program"] = round(
+                            sp_floor * 1e6, 1
+                        )
+                        record["wall_vs_vpu_floor_single_program"] = round(
+                            wall / sp_floor, 2
+                        )
 
     probe = ""
     if real_tflops is not None and probe_min is not None:
