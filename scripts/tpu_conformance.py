@@ -13,7 +13,12 @@ the real chip after ANY kernel or numerics change:
 
 Exit 0 = every (backend, weight-regime) pair matches the host oracle
 bit-exactly on shapes that exercise all three feeds, the offset-block
-skip boundaries, equal-length, overlong, and tie-heavy cases.
+skip boundaries, equal-length, overlong, and tie-heavy cases — plus a
+SEEDED RANDOM sweep per (feed / packing class / ring window) regime
+whose shapes are fresh each day (``sweep_cases``; seed printed, override
+with TPU_CONFORMANCE_SEED, width with TPU_CONFORMANCE_SWEEP_N), so
+shape-dependent Mosaic divergence the fixed cases sit beside gets a new
+chance to surface every round.
 """
 
 from __future__ import annotations
@@ -110,6 +115,98 @@ def pretile_boundary_cases():
     ], [10, 2, 3, 4]
 
 
+def sweep_cases(seed: int, n: int, ring_sp: int = 1):
+    """Seeded RANDOM problems per regime axis (VERDICT r4 item 7): the
+    fixed cases above cannot see shape-dependent Mosaic codegen
+    divergence, and interpret-mode tests cannot see Mosaic at all — so
+    each round exercises ``n`` fresh seeded problems per axis value on
+    the real chip.  Axes and their valid combinations:
+
+    * MXU feed (i8 / bf16 / f32) through the local fused kernel on
+      random shape buckets;
+    * row-packing class (l2s in {8, 16, 32, 64}) — i8 local path only
+      (the packed kernel's eligibility);
+    * ring window count R through the kernel-per-shard ring tier: at
+      sp=1 (one visible chip) R = 1 and R = 2 are reachable (R = 2 when
+      L2P == Bs); deeper windows are CPU-mesh-tested (tests/test_ring.py).
+
+    Yields ``(tag, scorer_key, seq1, seqs, weights)`` with scorer_key
+    'pallas' (local) or 'ring'.  The seed is printed by main() so any
+    failure reproduces exactly."""
+    rng = np.random.default_rng(seed)
+
+    def rand_seq(k):
+        return rng.integers(1, 27, size=int(k)).astype(np.int8)
+
+    for feed, w in (
+        ("i8", [10, 2, 3, 4]), ("bf16", [128, 2, 3, 4]), ("f32", [300, 7, 1, 2])
+    ):
+        for i in range(n):
+            len1 = int(rng.integers(150, 2800))
+            seqs = [
+                rand_seq(x)
+                for x in rng.integers(1, len1 + 2, size=int(rng.integers(2, 7)))
+            ]
+            yield f"sweep feed={feed} #{i}", "pallas", rand_seq(len1), seqs, w
+
+    for lo, l2s in ((1, 8), (9, 16), (17, 32), (33, 64)):
+        for i in range(n):
+            len1 = int(rng.integers(100, 2900))
+            seqs = [
+                rand_seq(x)
+                for x in rng.integers(lo, l2s + 1, size=int(rng.integers(3, 9)))
+            ]
+            yield (
+                f"sweep pack l2s<={l2s} #{i}", "pallas",
+                rand_seq(len1), seqs, [10, 2, 3, 4],
+            )
+
+    from mpi_openmp_cuda_tpu.ops.dispatch import round_up
+    from mpi_openmp_cuda_tpu.parallel.ring import ring_plan
+
+    for deep, (frac_lo, frac_hi) in ((False, (0.1, 0.5)), (True, (0.6, 0.9))):
+        for i in range(n):
+            len1 = int(rng.integers(300, 2500))
+            l1p = round_up(len1, 128)
+            lens2 = [
+                max(1, int(x * len1))
+                for x in rng.uniform(frac_lo, frac_hi, size=3)
+            ]
+            if deep:
+                # Pin one row into (l1p-128, len1] so L2P == L1P >= Bs
+                # and the window needs extra ring steps (R=2 at the
+                # one-chip sp=1; R ~ sp+1 on wider meshes; still-deeper
+                # windows are CPU-mesh-tested).
+                lens2[0] = int(
+                    rng.integers(max(1, l1p - 127), len1 + 1)
+                )
+            _, r = ring_plan(
+                l1p, round_up(max(lens2), 128), ring_sp, pallas=True
+            )
+            yield (
+                f"sweep ring R={r} #{i}", "ring",
+                rand_seq(len1), [rand_seq(x) for x in lens2], [10, 2, 3, 4],
+            )
+
+
+def _check(scorer, seq1, seqs, weights, tag) -> int:
+    """Score vs the host oracle; prints OK/FAIL, returns failure count."""
+    got = [
+        tuple(int(x) for x in r) for r in scorer.score_codes(seq1, seqs, weights)
+    ]
+    want = score_batch_oracle(seq1, seqs, weights)
+    if got == want:
+        print(f"OK   {tag}", file=sys.stderr)
+        return 0
+    bad = [i for i, (g, w) in enumerate(zip(got, want)) if g != w]
+    print(
+        f"FAIL {tag}: rows {bad}: "
+        f"got={[got[i] for i in bad]} want={[want[i] for i in bad]}",
+        file=sys.stderr,
+    )
+    return 1
+
+
 def main() -> int:
     # Respect an explicit JAX_PLATFORMS choice (TPU site hooks can clobber
     # it): a CPU-forced run must hit the platform gate below, not silently
@@ -133,41 +230,35 @@ def main() -> int:
         return 1
     failures = 0
     scorers = {b: AlignmentScorer(b) for b in BACKENDS}
-    scorers.update(_sharded_scorers())
+    sharded = _sharded_scorers()
+    scorers.update(sharded)
     for backend, scorer in scorers.items():
         for weights in WEIGHT_REGIMES:
             for pi, (seq1, seqs) in enumerate(problems()):
-                got = [
-                    tuple(int(x) for x in r)
-                    for r in scorer.score_codes(seq1, seqs, weights)
-                ]
-                want = score_batch_oracle(seq1, seqs, weights)
-                tag = f"{backend} w={weights[0]} problem={pi}"
-                if got == want:
-                    print(f"OK   {tag}", file=sys.stderr)
-                else:
-                    failures += 1
-                    bad = [i for i, (g, w) in enumerate(zip(got, want)) if g != w]
-                    print(
-                        f"FAIL {tag}: rows {bad}: "
-                        f"got={[got[i] for i in bad]} want={[want[i] for i in bad]}",
-                        file=sys.stderr,
-                    )
+                failures += _check(
+                    scorer, seq1, seqs, weights,
+                    f"{backend} w={weights[0]} problem={pi}",
+                )
     for seq1, seqs, weights in pretile_boundary_cases():
-        got = [
-            tuple(int(x) for x in r)
-            for r in scorers["pallas"].score_codes(seq1, seqs, weights)
-        ]
-        want = score_batch_oracle(seq1, seqs, weights)
-        tag = (
+        failures += _check(
+            scorers["pallas"], seq1, seqs, weights,
             f"pallas len1={seq1.size} w={weights[0]} "
-            "(pretile / super-block boundary)"
+            "(pretile / super-block boundary)",
         )
-        if got == want:
-            print(f"OK   {tag}", file=sys.stderr)
-        else:
-            failures += 1
-            print(f"FAIL {tag}: got={got} want={want}", file=sys.stderr)
+    # Seeded randomized sweep: fresh shapes per day (reproducible from the
+    # printed seed), overridable via TPU_CONFORMANCE_SEED / _SWEEP_N.
+    import time
+
+    seed = int(os.environ.get("TPU_CONFORMANCE_SEED", str(int(time.time() // 86400))))
+    sweep_n = int(os.environ.get("TPU_CONFORMANCE_SWEEP_N", "1"))
+    print(f"random sweep: seed={seed} n={sweep_n}", file=sys.stderr)
+    ring_key = next(k for k in sharded if "ring" in k)
+    ring_sp = scorers[ring_key].sharding.sp
+    for tag, key, seq1, seqs, weights in sweep_cases(seed, sweep_n, ring_sp):
+        failures += _check(
+            scorers[ring_key if key == "ring" else key],
+            seq1, seqs, weights, f"{tag} [seed={seed}]",
+        )
     if failures:
         print(f"tpu_conformance: {failures} FAILURES", file=sys.stderr)
         return 1
