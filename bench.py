@@ -57,12 +57,33 @@ def brute_force_elements(len1: int, lens2: list[int]) -> int:
 
 def load_workload():
     """input3.txt if the reference tree is mounted, else an equivalent
-    synthetic workload (same sizes, random uppercase sequences)."""
+    synthetic workload (same sizes, random uppercase sequences).
+
+    ``BENCH_WEIGHTS`` (e.g. ``300,7,1,2``) overrides the workload's
+    weights so the full gated protocol can measure non-default MXU feed
+    regimes — weights are runtime data in the reference (main.c:76), so
+    no feed may stay a perf blind spot (VERDICT r4 weakness 2)."""
+
+    def override(problem, name):
+        w = os.environ.get("BENCH_WEIGHTS")
+        if w:
+            # Same validation the stdin contract applies (4 tokens,
+            # int32 range): the override must not reintroduce the opaque
+            # downstream-overflow path parse.py exists to reject.
+            from mpi_openmp_cuda_tpu.io.parse import _parse_header_tokens
+
+            toks = w.replace(",", " ").split()
+            if len(toks) != 4:
+                raise ValueError(f"BENCH_WEIGHTS needs 4 weights, got {toks}")
+            problem.weights, _, _ = _parse_header_tokens(toks + ["A", "0"])
+            name += f"+w={','.join(str(x) for x in problem.weights)}"
+        return problem, name
+
     from mpi_openmp_cuda_tpu.io.parse import load_problem
 
     path = os.environ.get("BENCH_INPUT", "/root/reference/input3.txt")
     if os.path.exists(path):
-        return load_problem(path), os.path.basename(path)
+        return override(load_problem(path), os.path.basename(path))
     rng = np.random.default_rng(3)
     from mpi_openmp_cuda_tpu.io.parse import Problem
     from mpi_openmp_cuda_tpu.models.encoding import decode, encode_normalized
@@ -77,7 +98,7 @@ def load_workload():
         seq1_codes=encode_normalized(seq1),
         seq2_codes=[encode_normalized(s) for s in seqs],
     )
-    return problem, "synthetic-input3-class"
+    return override(problem, "synthetic-input3-class")
 
 
 def pick_backend() -> str:
@@ -618,6 +639,55 @@ def select_attempt(attempts, gate) -> tuple[Attempt, bool]:
         return max(probed, key=lambda a: a.pmin), False
     by_wall = sorted(attempts, key=lambda a: a.wall)
     return by_wall[(len(by_wall) - 1) // 2], False
+
+
+def interleaved_gated_rounds(
+    measure, on_tpu: bool, gate, max_attempts: int, log_prefix: str,
+    sleep=time.sleep,
+):
+    """Probe-bracketed attempt loop for INTERLEAVED multi-variant
+    measurements (the A/B harnesses: every variant measured inside one
+    bracketed window so cross-variant ratios survive co-tenant drift).
+    ``measure()`` returns an arbitrary result (e.g. per-variant median
+    walls).  Retries with exponential backoff until a quiet window or
+    ``max_attempts``; returns ``(result, Attempt, gated)`` applying
+    ``select_attempt``'s policy: the gated attempt if one landed, else
+    the closest-to-quiet attempt (max bracketing-probe minimum) — never
+    blindly the last attempt, which may sit in a noisier window than one
+    already measured.  Shared by scripts/f32_bench.py, ring_pack_ab.py,
+    stream_bench.py (r5 code review: three hand-rolled copies had
+    drifted off this selection policy)."""
+    attempts: list[tuple] = []
+    rounds = max_attempts if gate is not None else 1
+    for att in range(rounds):
+        p0 = probe_or_none() if on_tpu else None
+        res = measure()
+        p1 = probe_or_none() if on_tpu else None
+        a = Attempt(0.0, p0, p1)
+        attempts.append((res, a))
+        if gate is None or (a.pmin is not None and a.pmin >= gate):
+            break
+        if p0 is None and p1 is None:
+            break
+        if att < rounds - 1:
+            print(
+                f"{log_prefix} attempt {att + 1}/{rounds}: probes "
+                f"{p0 if p0 is not None else float('nan'):.0f}/"
+                f"{p1 if p1 is not None else float('nan'):.0f} below gate "
+                f"{gate:.0f}; retrying",
+                file=sys.stderr,
+            )
+            sleep(min(5.0 * 2.0**att, 60.0))
+    gated_pool = [
+        t for t in attempts
+        if gate is not None and t[1].pmin is not None and t[1].pmin >= gate
+    ]
+    if gated_pool:
+        return (*gated_pool[0], True)
+    probed = [t for t in attempts if t[1].pmin is not None]
+    if probed:
+        return (*max(probed, key=lambda t: t[1].pmin), gate is None)
+    return (*attempts[-1], gate is None)
 
 
 # Empirical wall-inflation bound for ungated records, fitted over the

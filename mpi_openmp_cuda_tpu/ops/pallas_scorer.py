@@ -202,6 +202,23 @@ _ITER_FLOOR_BASE_S = 0.70e-6
 _ITER_FLOOR_PER_SB_S = 0.040e-6
 _MAC_RATE = 112e12  # MACs/s, mixed one-hot i8 + int8 prefix stages
 
+# f32-feed constants (r5: scripts/f32_bench.py, probe-gated interleaved
+# sb sweeps over three workload classes on the real chip — VERDICT r4
+# item 4; the old chooser PUNTED to the static policy for f32, which a
+# skew-class sweep measured at 2.63x over the per-batch best).  Grid fit
+# with a per-class call-overhead nuisance under the f32 WALK (wide1=True
+# — the f32 kernel has no 2-wide interleave, so the model prices every
+# tile's iteration individually), log-err 0.041 (the i8 refit's was
+# 0.025): the f32 kernel pays ~5.6x the i8 per-tile MAC time and a much
+# heavier iteration floor (f32 one-hot + f32 prefix surfaces).  The fit
+# reproduces the measured winners on max-size (sb=12) and skew (sb=2)
+# exactly and lands within 9% of best on input3-class (picks sb=3 at
+# 543.7 us vs best sb=6 at 497.8 us — inside the same <=10% wall-tie
+# band the i8 refit accepted).
+_ITER_FLOOR_BASE_F32_S = 1.00e-6
+_ITER_FLOOR_PER_SB_F32_S = 0.32e-6
+_MAC_RATE_F32 = 20e12
+
 
 def _live_superblocks(nbn: int, sb: int, len1: int, l2: int) -> int:
     """Number of offset super-blocks the kernel executes for one pair:
@@ -227,8 +244,8 @@ def choose_superblock(nbn: int, nbi: int, len1: int, lens, feed: str) -> int:
     Narrow super-blocks skip dead blocks per pair but pay the iteration
     floor more often.  Minimise the measured cost model over nbn's
     divisors; concrete ``lens`` required (dispatch-time decision)."""
-    if feed == "f32":
-        return _superblock(nbn)  # wide=1 path: model not calibrated
+    # bf16 shares the i8 constants (same int-side VPU surfaces, MAC time
+    # still floor-dominated at these widths); f32 has its own r5-fit set.
     # Bounded cache key (ADVICE r3): the cost model consumes lens only
     # through ceil(l2/128) (live char-blocks) and len1 - l2 at sb*128
     # granularity (live super-blocks), so a histogram of lens rounded UP
@@ -244,7 +261,7 @@ def choose_superblock(nbn: int, nbi: int, len1: int, lens, feed: str) -> int:
         l2r = -(-l2 // _BLK) * _BLK
         hist[l2r] = hist.get(l2r, 0) + 1
     return _choose_superblock_cached(
-        nbn, nbi, len1, tuple(sorted(hist.items()))
+        nbn, nbi, len1, tuple(sorted(hist.items())), feed == "f32"
     )
 
 
@@ -258,6 +275,7 @@ def superblock_model_cost(
     base: float = None,
     per_sb: float = None,
     rate: float = None,
+    wide1: bool = False,
 ) -> float:
     """THE super-block cost model for one batch at width ``sb`` —
     the single structural source shared by the dispatch-time chooser and
@@ -276,8 +294,11 @@ def superblock_model_cost(
     t_iter2 = max(floor, 2 * tile_macs / rate)
     t_iter1 = max(floor, tile_macs / rate)
     # Mirrors the kernel's r3 walk: 2-wide even part + a 1-wide tail for
-    # odd tile counts (wide=1 throughout for single-char-block buckets).
-    wide = 1 if nbi == 1 else 2
+    # odd tile counts; wide=1 throughout for single-char-block buckets
+    # AND for the f32 feed (`wide1` — the kernel's own gate is
+    # `feed == "f32" or nbi == 1`, and the model must match the walk it
+    # prices or the next refit silently fits the wrong structure).
+    wide = 1 if wide1 or nbi == 1 else 2
     cost = 0.0
     for l2, count in lens_hist:
         nbi_live = min(-(-int(l2) // _BLK), nbi)
@@ -291,8 +312,18 @@ def superblock_model_cost(
 
 @functools.lru_cache(maxsize=256)
 def _choose_superblock_cached(
-    nbn: int, nbi: int, len1: int, lens_hist: tuple
+    nbn: int, nbi: int, len1: int, lens_hist: tuple, f32: bool = False
 ) -> int:
+    kw = (
+        dict(
+            base=_ITER_FLOOR_BASE_F32_S,
+            per_sb=_ITER_FLOOR_PER_SB_F32_S,
+            rate=_MAC_RATE_F32,
+            wide1=True,
+        )
+        if f32
+        else {}
+    )
     best_sb, best_cost = None, None
     # Every divisor of nbn in [2, 24], widest first (ties go wide).  The
     # r3 bound extension 16 -> 24 lets tiny-Seq2 batches against the
@@ -306,7 +337,7 @@ def _choose_superblock_cached(
     # nbn-wide band and falls back to the static policy.
     candidates = [sb for sb in range(min(nbn, 24), 1, -1) if nbn % sb == 0]
     for sb in candidates:
-        cost = superblock_model_cost(nbn, nbi, len1, lens_hist, sb)
+        cost = superblock_model_cost(nbn, nbi, len1, lens_hist, sb, **kw)
         if best_cost is None or cost < best_cost:
             best_sb, best_cost = sb, cost
     return best_sb if best_sb is not None else _superblock(nbn)

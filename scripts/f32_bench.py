@@ -1,0 +1,190 @@
+"""f32-feed measurement: sb sweep + i8->f32 cliff factor (VERDICT r4
+weakness 2 / item 4).
+
+Weights are runtime data in the reference (main.c:76), but every
+BASELINE perf row was i8-feed: a >128-weight workload silently ran an
+UNMEASURED configuration — static superblock policy
+(``choose_superblock`` punts for f32: "model not calibrated"), no row
+packing, no 2-wide interleave.  This script measures that configuration
+on the real chip, interleaved so the comparisons survive co-tenancy:
+
+* an interleaved sb sweep of the f32 kernel on the input3-class
+  whole-batch program (candidates = nbn divisors) — quantifies how far
+  the static ``_superblock`` choice sits from the per-batch best, i.e.
+  whether the f32 chooser punt needs calibration or a measured
+  rejection;
+* the i8 program (production adaptive sb, same shapes, fixture weights)
+  in the SAME interleaved rounds — the i8->f32 cliff factor on
+  identical work.
+
+Probe-bracketed like bench.py (quiet window = both probes >= gate);
+retries with backoff until gated or attempts exhausted.  Output: one
+JSON line with per-sb walls, the static/best gap, and the cliff.
+
+Usage: ``python scripts/f32_bench.py`` (F32_BENCH_ROUNDS /
+F32_BENCH_ATTEMPTS mirror the other harnesses' knobs).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+import bench
+
+F32_WEIGHTS = [300, 7, 1, 2]
+
+
+def build_prog(problem, weights, feed, sb):
+    """Compiled+warmed two-point progs for the whole-batch single program
+    at (feed, sb) — same protocol as scripts/sb_refit.py."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from mpi_openmp_cuda_tpu.ops.dispatch import pad_batch_rows, pad_problem
+    from mpi_openmp_cuda_tpu.ops.pallas_scorer import score_chunks_pallas_body
+    from mpi_openmp_cuda_tpu.ops.values import value_table
+
+    batch = pad_problem(problem.seq1_codes, problem.seq2_codes)
+    val = value_table(weights).astype(np.int32).reshape(-1)
+    b = batch.batch_size
+    rows, lens = pad_batch_rows(batch, b)
+    args = (
+        jnp.asarray(batch.seq1ext),
+        jnp.int32(batch.len1),
+        jnp.asarray(rows.reshape(1, b, batch.l2p)),
+        jnp.asarray(lens.reshape(1, b)),
+        jnp.asarray(val),
+    )
+
+    def make(reps):
+        def f(s1, l1, rows, lens, v):
+            def step(c, i):
+                out = score_chunks_pallas_body(
+                    s1, l1, jnp.roll(rows, i, axis=1),
+                    jnp.roll(lens, i, axis=1), v, feed=feed, sb=sb, l2s=None,
+                )
+                return c + out.sum(), None
+
+            t, _ = lax.scan(step, jnp.int32(0), jnp.arange(reps))
+            return t
+
+        return jax.jit(f)
+
+    reps = int(os.environ.get("F32_BENCH_REPS", "1024"))
+    fns = {}
+    for r in (1, 1 + reps):
+        fn = make(r)
+        int(fn(*args))
+        fns[r] = fn
+    return {r: (lambda f=f: int(f(*args))) for r, f in fns.items()}, batch
+
+
+def main() -> None:
+    from mpi_openmp_cuda_tpu.utils.platform import (
+        apply_platform_override,
+        enable_compilation_cache,
+    )
+
+    apply_platform_override()
+    enable_compilation_cache()
+    import jax
+
+    from mpi_openmp_cuda_tpu.ops.pallas_scorer import (
+        _superblock,
+        choose_superblock,
+    )
+
+    problem, workload = bench.load_workload()
+    cls = os.environ.get("F32_BENCH_CLASS", "input3")
+    if cls != "input3":
+        # Synthetic classes mirroring scripts/sb_refit.py's sweep set, so
+        # the f32 rate constant is fit across length mixes, not one shape.
+        rng = np.random.default_rng(7)
+        shapes = {
+            "max-size": (3000, rng.integers(1200, 2000, size=64)),
+            "skew": (1489, rng.integers(1460, 1490, size=64)),
+        }[cls]
+        from types import SimpleNamespace
+
+        problem = SimpleNamespace(
+            seq1_codes=rng.integers(1, 27, size=shapes[0]).astype(np.int8),
+            seq2_codes=[
+                rng.integers(1, 27, size=int(l)).astype(np.int8)
+                for l in shapes[1]
+            ],
+            weights=problem.weights,
+        )
+        workload = f"synthetic-{cls}"
+    on_tpu, quiet_ref, gate = bench.probe_gate()
+    rounds = int(os.environ.get("F32_BENCH_ROUNDS", "3"))
+    max_attempts = int(os.environ.get("F32_BENCH_ATTEMPTS", "6"))
+
+    from mpi_openmp_cuda_tpu.ops.dispatch import pad_problem
+
+    # Variants: f32 at every divisor sb plus the static choice (always
+    # included, so prime/odd nbn — where the divisor set can be empty —
+    # still measures at least the static program), plus the production
+    # i8 program.
+    variants: dict[str, dict] = {}
+    nbatch = pad_problem(problem.seq1_codes, problem.seq2_codes)
+    nbn = nbatch.l1p // 128
+    static_sb = _superblock(nbn)
+    sbs = sorted(
+        {sb for sb in (2, 3, 4, 6, 8, 12, 24) if nbn % sb == 0} | {static_sb}
+    )
+    for sb in sbs:
+        variants[f"f32-sb{sb}"], _ = build_prog(
+            problem, F32_WEIGHTS, "f32", sb
+        )
+    i8_sb = choose_superblock(
+        nbn, nbatch.l2p // 128, nbatch.len1, nbatch.len2, "i8"
+    )
+    variants[f"i8-sb{i8_sb}"], _ = build_prog(
+        problem, problem.weights, "i8", i8_sb
+    )
+
+    def measure():
+        walls: dict[str, list] = {k: [] for k in variants}
+        for _ in range(rounds):
+            for k, progs in variants.items():
+                walls[k].append(bench.min_wall_slope(progs))
+        return {k: float(np.median(v)) for k, v in walls.items()}
+
+    med, a, gated = bench.interleaved_gated_rounds(
+        measure, on_tpu, gate, max_attempts, "[f32-bench]"
+    )
+
+    f32_walls = {k: med[k] for k in med if k.startswith("f32")}
+    best_key = min(f32_walls, key=f32_walls.get)
+    static_key = f"f32-sb{static_sb}"
+    rec = {
+        "metric": f"f32-feed sb sweep + i8 cliff, {workload} whole-batch",
+        "walls_us": {k: round(v * 1e6, 1) for k, v in med.items()},
+        "f32_static_sb": static_sb,
+        "f32_best_sb": int(best_key.split("sb")[1]),
+        "f32_static_over_best": round(
+            med[static_key] / med[best_key], 3
+        ),
+        "i8_to_f32_cliff": round(med[static_key] / med[f"i8-sb{i8_sb}"], 2),
+        "rounds": rounds,
+        "probe_gated": bool(gated),
+    }
+    if a.pmin is not None:
+        rec["mxu_probe_bf16_tflops"] = round(a.pmin, 1)
+    print(json.dumps(rec))
+    print(
+        f"[f32-bench] device={jax.devices()[0].device_kind} "
+        f"nbn={nbn} sbs={sbs} i8_sb={i8_sb}",
+        file=sys.stderr,
+    )
+
+
+if __name__ == "__main__":
+    main()

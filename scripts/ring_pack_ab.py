@@ -1,0 +1,122 @@
+"""Ring-tier row-packing A/B (VERDICT r4 weakness 5 / item 9).
+
+The ring path excludes every dispatch-level optimisation by design: no
+length bucketing (its window schedule depends on L2P) and no row packing
+(``packable`` requires ``sharding is None``), so a tiny-Seq2 batch
+through ``--mesh seq:N`` pays full unpacked 128-lane tiles — the exact
+regime where row packing won +34-87% locally (input4-class, r4).  That
+restriction was asserted, not measured.  This script measures it: the
+SAME input4-class workload through
+
+* the ring tier at sp=1 (production ``RingSharding._prepare`` program,
+  fused kernel per shard, unpacked), and
+* the local production dispatch (``bench.steady_state_progs`` — the
+  bucket schedule with packing classes),
+
+interleaved inside probe-bracketed rounds.  The output ratio either
+justifies the exclusion with a number or motivates packing classes in
+the ring program.
+
+Usage: ``python scripts/ring_pack_ab.py`` (RING_PACK_REPS / _ROUNDS /
+_ATTEMPTS knobs).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+import bench
+
+
+def main() -> None:
+    from mpi_openmp_cuda_tpu.utils.platform import (
+        apply_platform_override,
+        enable_compilation_cache,
+    )
+
+    apply_platform_override()
+    enable_compilation_cache()
+    import jax
+
+    from mpi_openmp_cuda_tpu.io.parse import Problem
+    from mpi_openmp_cuda_tpu.models.encoding import decode, encode_normalized
+    from mpi_openmp_cuda_tpu.ops.dispatch import pad_problem
+    from mpi_openmp_cuda_tpu.ops.values import value_table
+    from mpi_openmp_cuda_tpu.parallel.ring import RingSharding
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from ring_bench import ring_steady_progs
+
+    # input4-class: caps-length Seq1, 30 tiny Seq2s (5..64 chars) — every
+    # row fits the l2s=64 packing class on the local path.
+    rng = np.random.default_rng(4)
+    seq1 = decode(rng.integers(1, 27, size=2976))
+    seqs = [
+        decode(rng.integers(1, 27, size=int(l)))
+        for l in rng.integers(5, 65, size=30)
+    ]
+    problem = Problem(
+        weights=[2, 2, 1, 10],
+        seq1=seq1,
+        seq2=seqs,
+        seq1_codes=encode_normalized(seq1),
+        seq2_codes=[encode_normalized(s) for s in seqs],
+    )
+    elements = bench.brute_force_elements(
+        problem.seq1_codes.size, [c.size for c in problem.seq2_codes]
+    )
+
+    reps = int(os.environ.get("RING_PACK_REPS", "1024"))
+    rounds = int(os.environ.get("RING_PACK_ROUNDS", "3"))
+    max_attempts = int(os.environ.get("RING_PACK_ATTEMPTS", "6"))
+    on_tpu, quiet_ref, gate = bench.probe_gate()
+
+    rs = RingSharding.over_devices(seq=jax.device_count(), batch=1)
+    batch = pad_problem(problem.seq1_codes, problem.seq2_codes)
+    val_flat = value_table(problem.weights).astype(np.int32).reshape(-1)
+
+    progs = {
+        "ring-sp1-unpacked": ring_steady_progs(
+            rs, batch, val_flat, reps, "pallas"
+        ),
+        "local-packed": bench.steady_state_progs(problem, "pallas", reps),
+    }
+
+    def measure():
+        walls = {k: [] for k in progs}
+        for _ in range(rounds):
+            for k, p in progs.items():
+                walls[k].append(bench.min_wall_slope(p))
+        return {k: float(np.median(v)) for k, v in walls.items()}
+
+    med, a, gated = bench.interleaved_gated_rounds(
+        measure, on_tpu, gate, max_attempts, "[ring-pack-ab]"
+    )
+
+    rec = {
+        "metric": "ring-vs-packed A/B, input4-class (30 Seq2 of 5-64)",
+        "walls_us": {k: round(v * 1e6, 1) for k, v in med.items()},
+        "ring_over_packed": round(
+            med["ring-sp1-unpacked"] / med["local-packed"], 2
+        ),
+        "elements": elements,
+        "rounds": rounds,
+        "probe_gated": bool(gated),
+    }
+    if a.pmin is not None:
+        rec["mxu_probe_bf16_tflops"] = round(a.pmin, 1)
+    print(json.dumps(rec))
+    print(
+        f"[ring-pack-ab] device={jax.devices()[0].device_kind}",
+        file=sys.stderr,
+    )
+
+
+if __name__ == "__main__":
+    main()

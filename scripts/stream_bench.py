@@ -1,0 +1,153 @@
+"""Streaming-pipeline e2e measurement (VERDICT r4 weakness 3 / item 5).
+
+``--stream`` exists to overlap host parse with device compute
+(``io/cli.py::_run_streaming``: chunk i computes while the host parses
+and submits chunk i+1), and ``--journal`` adds per-sequence resume on
+top.  Both are correctness-tested; this script puts NUMBERS behind the
+pipelining claim on the real chip: end-to-end wall of the same workload
+through batch mode, ``--stream``, and ``--stream --journal``.
+
+Workload: the input3 problem with its Seq2 list replicated K times
+(default 8 -> 256 sequences, ~1.5 MB of input text) — input3-scale
+shapes, but enough total text that the host parse is a real pipeline
+stage rather than noise.  All modes run IN-PROCESS (one jax import,
+shared jit caches, stdout captured), interleaved round-robin inside
+probe-bracketed rounds so the mode ratios survive co-tenant drift; the
+journal file is recreated per rep so no rep resumes from a previous
+one's results.
+
+Output: one JSON line with per-mode median e2e walls, the
+batch->stream overlap gain, and the journal overhead factor.
+
+Usage: ``python scripts/stream_bench.py`` (STREAM_BENCH_REPLICAS /
+_ROUNDS / _ATTEMPTS knobs).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import io
+import json
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+import bench
+
+
+def build_input(replicas: int) -> tuple[str, int]:
+    """input3 with its Seq2 list replicated; returns (path, num_seqs)."""
+    src = os.environ.get("BENCH_INPUT", "/root/reference/input3.txt")
+    if os.path.exists(src):
+        toks = open(src).read().split()
+        weights, seq1, n = toks[:4], toks[4], int(toks[5])
+        seqs = toks[6 : 6 + n]
+    else:  # synthetic fallback, same sizes as bench.load_workload
+        rng = np.random.default_rng(3)
+        from mpi_openmp_cuda_tpu.models.encoding import decode
+
+        weights = ["2", "2", "1", "10"]
+        seq1 = decode(rng.integers(1, 27, size=1489))
+        seqs = [
+            decode(rng.integers(1, 27, size=int(l)))
+            for l in rng.integers(56, 1153, size=32)
+        ]
+    seqs = seqs * replicas
+    fd, path = tempfile.mkstemp(suffix=".txt", prefix="stream_bench_")
+    with os.fdopen(fd, "w") as fh:
+        fh.write(" ".join(weights) + "\n" + seq1 + "\n")
+        fh.write(f"{len(seqs)}\n" + "\n".join(seqs) + "\n")
+    return path, len(seqs)
+
+
+def run_mode(args) -> str:
+    """One in-process CLI run, stdout captured and returned."""
+    from mpi_openmp_cuda_tpu.io import cli
+
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc = cli.run(args)
+    if rc != 0:
+        raise RuntimeError(f"cli.run({args}) -> rc {rc}")
+    return buf.getvalue()
+
+
+def main() -> None:
+    from mpi_openmp_cuda_tpu.utils.platform import (
+        apply_platform_override,
+        enable_compilation_cache,
+    )
+
+    apply_platform_override()
+    enable_compilation_cache()
+    import jax
+
+    replicas = int(os.environ.get("STREAM_BENCH_REPLICAS", "8"))
+    rounds = int(os.environ.get("STREAM_BENCH_ROUNDS", "5"))
+    max_attempts = int(os.environ.get("STREAM_BENCH_ATTEMPTS", "6"))
+    on_tpu, quiet_ref, gate = bench.probe_gate()
+
+    path, n_seqs = build_input(replicas)
+    jdir = tempfile.mkdtemp(prefix="stream_bench_j_")
+
+    def mode_args(mode):
+        if mode == "batch":
+            return ["--input", path]
+        if mode == "stream":
+            return ["--input", path, "--stream"]
+        # Fresh journal path per rep: resume must never short-circuit
+        # the work being timed.
+        jp = os.path.join(jdir, f"j{time.monotonic_ns()}.jsonl")
+        return ["--input", path, "--stream", "--journal", jp]
+
+    modes = ("batch", "stream", "stream+journal")
+    # Warm every mode once (compiles shared thereafter); also capture the
+    # reference output for the cross-mode byte-identity check.
+    golden = run_mode(mode_args("batch"))
+    for m in modes[1:]:
+        out = run_mode(mode_args(m))
+        assert out == golden, f"mode {m} output diverges from batch"
+
+    def measure():
+        walls = {m: [] for m in modes}
+        for _ in range(rounds):
+            for m in modes:
+                margs = mode_args(m)
+                t0 = time.perf_counter()
+                run_mode(margs)
+                walls[m].append(time.perf_counter() - t0)
+        return {m: float(np.median(w)) for m, w in walls.items()}
+
+    med, a, gated = bench.interleaved_gated_rounds(
+        measure, on_tpu, gate, max_attempts, "[stream-bench]"
+    )
+
+    rec = {
+        "metric": (
+            f"streaming e2e, input3-class x{replicas} "
+            f"({n_seqs} sequences)"
+        ),
+        "e2e_s": {m: round(v, 4) for m, v in med.items()},
+        "stream_vs_batch": round(med["stream"] / med["batch"], 3),
+        "journal_vs_stream": round(med["stream+journal"] / med["stream"], 3),
+        "rounds": rounds,
+        "probe_gated": bool(gated),
+    }
+    if a.pmin is not None:
+        rec["mxu_probe_bf16_tflops"] = round(a.pmin, 1)
+    print(json.dumps(rec))
+    print(
+        f"[stream-bench] device={jax.devices()[0].device_kind} "
+        f"input={path} ({os.path.getsize(path)} bytes)",
+        file=sys.stderr,
+    )
+    os.unlink(path)
+
+
+if __name__ == "__main__":
+    main()

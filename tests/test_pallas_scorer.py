@@ -261,7 +261,8 @@ def test_choose_superblock_regimes():
     near-tie) per regime — constants refit on the r3/r4 kernel by
     scripts/sb_refit.py's interleaved v2 sweep (VERDICT r3 item 6):
     wide blocks for wide valid-offset ranges, narrow blocks for
-    near-Seq1-length batches, static policy on the f32 (wide=1) feed."""
+    near-Seq1-length batches; the f32 (wide=1) feed runs the same model
+    with its own r5-fit constants (scripts/f32_bench.py)."""
     from mpi_openmp_cuda_tpu.ops.pallas_scorer import (
         _superblock,
         choose_superblock,
@@ -284,8 +285,16 @@ def test_choose_superblock_regimes():
     skew = [1480] * 64
     assert choose_superblock(12, 12, 1489, skew, "i8") in (2, 3)
     assert choose_superblock(4, 4, 450, [445] * 8, "i8") == 2
-    # f32 keeps the static policy (wide=1 loop, model not calibrated).
-    assert choose_superblock(12, 12, 1489, skew, "f32") == _superblock(12)
+    # f32 runs the adaptive model with its own r5-fit constants
+    # (scripts/f32_bench.py gated sweeps; the old static punt measured
+    # 2.63x over best on the skew class): skew picks the measured winner
+    # sb=2, max-size keeps sb=12 (measured winner), and the input3-class
+    # mix lands in the measured 3..6 shallow bowl (sb=6 best at 497.8 us,
+    # sb=3/4 within 10%; the real input3 histogram picks 3, this
+    # synthetic mix 6 — both inside the bowl).
+    assert choose_superblock(12, 12, 1489, skew, "f32") == 2
+    assert choose_superblock(24, 16, 3000, maxsize, "f32") == 12
+    assert choose_superblock(12, 9, 1489, wide_mix, "f32") in (3, 4, 6)
     # A prime nbn picks itself (no divisor in [2, 16]) rather than
     # falling to sb=1, the slowest measured shape — including primes
     # above 16 (real Seq1 buckets 17/19/23).
