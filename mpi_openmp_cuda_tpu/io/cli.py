@@ -10,6 +10,7 @@ contract without breaking it (SURVEY §5 config tier).
 from __future__ import annotations
 
 import argparse
+import os
 import sys
 
 from ..ops.dispatch import AlignmentScorer
@@ -119,11 +120,16 @@ def build_arg_parser() -> argparse.ArgumentParser:
         metavar="CHUNK",
         help="pipelined mode: parse and score CHUNK sequences at a time, "
         "overlapping host parsing with asynchronous device compute; live "
-        "host memory is bounded by CHUNK plus one buffered output line "
-        "per result; byte-identical output, flushed after the whole "
-        "stream succeeds (fail-stop: no partial results); under "
-        "--distributed the coordinator broadcasts each chunk so every "
-        "host's memory stays bounded",
+        "host memory is bounded by (window+1) x CHUNK sequences plus one "
+        "buffered output line per result (window: in-flight chunks with "
+        "prefetched device->host copies, TPU_SEQALIGN_STREAM_DEPTH, "
+        "default 4 single-process / fixed 1 multi-host); byte-identical "
+        "output, flushed after the whole stream succeeds (fail-stop: no "
+        "partial results); under --distributed the coordinator "
+        "broadcasts each chunk so every host's memory stays bounded; on "
+        "a TUNNELLED device each chunk still pays a ~tens-of-ms launch "
+        "round trip, so prefer CHUNK large enough that chunks are few "
+        "unless memory-bound (measured: scripts/stream_bench.py)",
     )
     return p
 
@@ -471,13 +477,36 @@ def _run_streaming(
                 stack.enter_context(device_trace(args.trace))
                 if journal is not None:
                     stack.enter_context(journal)
-                pending = None
+                # In-flight window.  Multi-host: EXACTLY one chunk, the
+                # schedule _run_streaming_worker mirrors collective-for-
+                # collective.  Single-process: a deeper window (default
+                # 4, env-tunable) — on a tunnelled TPU each result fetch
+                # costs a ~0.1 s link round trip, and with one chunk in
+                # flight those round trips serialise the whole pipeline
+                # (measured 6.3x over batch mode at 8 chunks, r5);
+                # prefetch() starts every chunk's device->host copy at
+                # dispatch, and the window gives the copies time to land
+                # before _finish needs them.  Host memory stays bounded:
+                # window+1 chunks of codes plus the output lines.
+                import collections
+
+                depth_env = os.environ.get("TPU_SEQALIGN_STREAM_DEPTH", "4")
+                try:
+                    window = 1 if multi else max(1, int(depth_env))
+                except ValueError:
+                    raise ValueError(
+                        "TPU_SEQALIGN_STREAM_DEPTH must be an integer, "
+                        f"got {depth_env!r}"
+                    ) from None
+                pendings = collections.deque()
                 end_sent = False
                 for start, codes in header.iter_chunks(args.stream):
                     cur = _submit(start, codes)
-                    if pending is not None:
-                        _finish(*pending)
-                    pending = cur
+                    if cur[0] is not None:
+                        cur[0].prefetch()
+                    pendings.append(cur)
+                    if len(pendings) > window:
+                        _finish(*pendings.popleft())
                 if multi:
                     # End sentinel BEFORE the final materialise: the
                     # pipelined worker mirrors this exactly (it learns
@@ -486,8 +515,8 @@ def _run_streaming(
                     # identical on every host — see _run_streaming_worker.
                     dist.broadcast_chunk(None, end=True)
                     end_sent = True
-                if pending is not None:
-                    _finish(*pending)
+                while pendings:
+                    _finish(*pendings.popleft())
             except BaseException:
                 if multi and not end_sent:
                     # Any coordinator-side failure (parse, journal

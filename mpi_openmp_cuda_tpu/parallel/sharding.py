@@ -69,6 +69,19 @@ class ShardedPending:
     out: object
     count: int
 
+    def prefetch(self) -> None:
+        """Non-blocking device->host copy start (see
+        ``PendingResult.prefetch``).  Single-process only: the multi-host
+        ``result()`` is a collective gather whose schedule every host
+        must reach identically — prefetching locally would not change
+        it, and the tunnel-latency problem it solves is single-host."""
+        import jax
+
+        if jax.process_count() == 1:
+            f = getattr(self.out, "copy_to_host_async", None)
+            if f is not None:
+                f()
+
     def result(self) -> np.ndarray:
         return _fetch_global(self.out)[: self.count]
 

@@ -93,17 +93,20 @@ def main() -> None:
     on_tpu, quiet_ref, gate = bench.probe_gate()
 
     path, n_seqs = build_input(replicas)
+    # One input3-sized batch per chunk: chunk i computes while chunk i+1
+    # parses — the pipeline grain the mode exists for.
+    chunk = os.environ.get("STREAM_BENCH_CHUNK", "32")
     jdir = tempfile.mkdtemp(prefix="stream_bench_j_")
 
     def mode_args(mode):
         if mode == "batch":
             return ["--input", path]
         if mode == "stream":
-            return ["--input", path, "--stream"]
+            return ["--input", path, "--stream", chunk]
         # Fresh journal path per rep: resume must never short-circuit
         # the work being timed.
         jp = os.path.join(jdir, f"j{time.monotonic_ns()}.jsonl")
-        return ["--input", path, "--stream", "--journal", jp]
+        return ["--input", path, "--stream", chunk, "--journal", jp]
 
     modes = ("batch", "stream", "stream+journal")
     # Warm every mode once (compiles shared thereafter); also capture the
