@@ -517,6 +517,13 @@ class AlignmentScorer:
         # every host runs the identical per-bucket collective schedule.
         # The ring path keeps one program (its window schedule depends on
         # L2P, and a per-bucket ring would rebuild windows per bucket).
+        # That exclusion is MEASURED, not just asserted (r5,
+        # scripts/ring_pack_ab.py gated A/B): an input4-class tiny-Seq2
+        # batch through ring-sp1 pays 1.71x the local packed path
+        # (77.0 vs 45.0 us) — real but under the 2-3x that would justify
+        # packing classes inside the ring program, at walls that are
+        # half dispatch floor, in a regime (long-context AND all-tiny
+        # Seq2) the ring rarely serves.  Recorded as headroom, not debt.
         bucketable = self.sharding is None or getattr(
             self.sharding, "bucketed", False
         )
