@@ -5,6 +5,14 @@ anywhere, so multi-chip sharding tests run on any machine — the fake-backend
 idiom the reference's "run real MPI on two machines" test story lacks
 (SURVEY §4).  Real-TPU runs go through bench.py / __graft_entry__.py, which
 do not import this file.
+
+Tier budgets (measured walls + the reclaim history live at the Makefile
+`test:` target): default tier < 300 s with >= 10% headroom (r5: 238-249 s),
+slow tier ~12 min (r5: 11:21) — both compile-cold on the quiet
+1-core box.  The scarce resource is interpret-mode Pallas compiles
+(~10-20 s per compiled shape bucket): before adding a test that
+compiles a NEW bucket, check whether an existing test's shapes can be
+shared (see the r5 notes in test_ring.py / test_pallas_scorer.py).
 """
 
 from __future__ import annotations
