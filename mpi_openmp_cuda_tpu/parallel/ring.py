@@ -29,8 +29,9 @@ Seq1 — dp x sp.  Yields the same (score, n, k) triples, bit-exact, as the
 single-device paths; property-tested against the host oracle.
 
 Measured cost (``scripts/ring_bench.py``, TPU v5 lite, probe-gated): the
-ring schedule itself taxes the fused kernel ~1.04-1.14x at reference
-scale (input3 through ring-sp1 vs direct, two gated session pairs), and
+ring schedule itself taxes the fused kernel ~1.00-1.14x at reference
+scale (input3 through ring-sp1 vs direct, three gated session pairs
+across r4-r5; the r5 pair read 0.993 - statistically equal), and
 the unbounded tier sustains 1.14e14 eq-comparisons/s/chip at Seq1 = 4x
 the reference's cap and 3.83e14 at 8x with Seq2 at 2x its cap
 (BASELINE.md r4 ring row; the eq metric is the reference's
