@@ -686,8 +686,10 @@ def interleaved_gated_rounds(
         return (*gated_pool[0], True)
     probed = [t for t in attempts if t[1].pmin is not None]
     if probed:
-        return (*max(probed, key=lambda t: t[1].pmin), gate is None)
-    return (*attempts[-1], gate is None)
+        return (*max(probed, key=lambda t: t[1].pmin), False)
+    # gate None (off-TPU) lands here: ungated, matching select_attempt —
+    # callers emit probe_gated only when a probe actually ran (pmin).
+    return (*attempts[-1], False)
 
 
 # Empirical wall-inflation bound for ungated records, fitted over the

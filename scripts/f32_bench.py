@@ -174,9 +174,11 @@ def main() -> None:
         ),
         "i8_to_f32_cliff": round(med[static_key] / med[f"i8-sb{i8_sb}"], 2),
         "rounds": rounds,
-        "probe_gated": bool(gated),
     }
     if a.pmin is not None:
+        # probe_gated only when a probe actually ran (off-TPU records
+        # must not claim a gate that never existed — r5 code review).
+        rec["probe_gated"] = bool(gated)
         rec["mxu_probe_bf16_tflops"] = round(a.pmin, 1)
     print(json.dumps(rec))
     print(

@@ -139,9 +139,11 @@ def main() -> None:
         "stream_vs_batch": round(med["stream"] / med["batch"], 3),
         "journal_vs_stream": round(med["stream+journal"] / med["stream"], 3),
         "rounds": rounds,
-        "probe_gated": bool(gated),
     }
     if a.pmin is not None:
+        # probe_gated only when a probe actually ran (off-TPU records
+        # must not claim a gate that never existed — r5 code review).
+        rec["probe_gated"] = bool(gated)
         rec["mxu_probe_bf16_tflops"] = round(a.pmin, 1)
     print(json.dumps(rec))
     print(

@@ -250,6 +250,47 @@ def test_ungated_record_brackets_quiet_band_no_linear_estimate():
     assert "lower bound" not in warn
 
 
+def test_interleaved_gated_rounds_branches(monkeypatch):
+    """The shared multi-variant attempt loop (scripts/f32_bench.py,
+    ring_pack_ab.py, stream_bench.py) must follow select_attempt's
+    policy: gated attempt if one lands, else CLOSEST-TO-QUIET — never
+    blindly the last attempt (r5 code review: three hand-rolled copies
+    had drifted to last-attempt)."""
+    sleeps = []
+
+    def run(probe_vals, measures, on_tpu=True, gate=GATE, max_attempts=6):
+        probe = Seq(probe_vals)
+        monkeypatch.setattr(bench, "probe_or_none", lambda feed="bf16": probe())
+        meas = Seq(list(measures))
+        sleeps.clear()
+        return bench.interleaved_gated_rounds(
+            meas, on_tpu, gate, max_attempts, "[t]", sleep=sleeps.append
+        )
+
+    # Gated on the first attempt: one measure, no sleeps.
+    res, a, gated = run([200.0, 199.0], [{"x": 1.0}])
+    assert gated and res == {"x": 1.0} and a.pmin == 199.0 and not sleeps
+
+    # Never gated: the CLOSEST-TO-QUIET attempt's result is returned
+    # (first attempt, pmin 170), not the last (pmin 150).
+    res, a, gated = run(
+        [170.0, 175.0, 160.0, 150.0], [{"x": "quietest"}, {"x": "later"}],
+        max_attempts=2,
+    )
+    assert not gated and res == {"x": "quietest"} and a.pmin == 170.0
+    assert len(sleeps) == 1  # backoff between the two attempts
+
+    # Both bracketing probes dead: bail after one attempt, ungated.
+    res, a, gated = run([None, None], [{"x": 1}])
+    assert not gated and a.pmin is None and not sleeps
+
+    # Off-TPU: single attempt, UNGATED (select_attempt's convention —
+    # callers emit probe_gated only when a probe actually ran, so an
+    # off-TPU record never claims a gate that never existed).
+    res, a, gated = run([], [{"x": 9}], on_tpu=False, gate=None)
+    assert not gated and res == {"x": 9} and a.p0 is None
+
+
 def test_kernel_floor_counts_schedule_vs_single_program():
     """The two labelled floor variants in the record (VERDICT r4 item 6):
     the production bucket schedule counts FEWER pass elements than the
