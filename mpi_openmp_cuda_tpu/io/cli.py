@@ -291,12 +291,17 @@ def _run_streaming(
     args, timer: PhaseTimer, dist=None, coordinator=True, out_stream=None
 ) -> int:
     """The --stream pipeline: parse and score CHUNK sequences at a time
-    with one chunk in flight on the device.
+    with a window of chunks in flight on the device (single-process
+    default 4, TPU_SEQALIGN_STREAM_DEPTH; multi-host exactly 1 — the
+    worker mirrors that schedule collective-for-collective).
 
-    While chunk i computes (JAX dispatch is asynchronous), the host parses
-    and submits chunk i+1, then materialises chunk i — the host-IO /
-    device-compute overlap tier (SURVEY §2.4 PP row).  Host memory is
-    bounded by the chunk size (plus one ~30-byte line per result).
+    While earlier chunks compute (JAX dispatch is asynchronous, and each
+    pending's device->host copy is prefetched at dispatch), the host
+    parses and submits later chunks, materialising the oldest only once
+    the window is full — the host-IO / device-compute overlap tier
+    (SURVEY §2.4 PP row; r5 measurement + the tunnelled-link rationale
+    in BASELINE.md "Streaming e2e measured").  Host memory is bounded by
+    (window+1) chunks (plus one ~30-byte line per result).
     Formatted output is buffered and flushed only after the whole stream
     succeeds, preserving the fail-stop contract: a truncated or invalid
     batch emits nothing on stdout, exactly like the non-streaming path.

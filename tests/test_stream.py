@@ -1,5 +1,5 @@
 """Streaming pipeline tests (--stream): chunked parse -> async score ->
-print with one chunk in flight.  Output must be byte-identical to the
+print with a prefetched in-flight window.  Output must be byte-identical to the
 non-streaming path for every chunk size, including chunk sizes that do not
 divide N and chunks larger than N (SURVEY §2.4 PP row: the host-IO /
 device-compute overlap tier)."""
