@@ -18,7 +18,7 @@ PY_CFLAGS  := $(shell $(PYCONFIG) --includes)
 PY_LDFLAGS := $(shell $(PYCONFIG) --ldflags --embed)
 INPUT      ?= /root/reference/input5.txt
 
-.PHONY: build run run2 runOn2 test bench bench-table check clean
+.PHONY: build run run2 runOn2 test chaos bench bench-table check clean
 
 build: final
 
@@ -64,6 +64,19 @@ runOn2:
 # Timings are meaningless if ANYTHING else runs on the box (a 103 s
 # suite has read 439 s under concurrent load).
 test:
+	$(PYTHON) -m pytest tests/ -q
+
+# Chaos tier: the fast suite under an ambient deterministic fault spec
+# (resilience/faults.py).  Every CLI run absorbs two transient
+# chunk-scoring faults and one journal-append fault inside the
+# SEQALIGN_FAULT_RETRIES floor, so the goldens must stay byte-identical;
+# tests that assert exact attempt counts or fail-stop at rc 1 carry the
+# no_chaos marker and are skipped (conftest).  The near-zero backoff
+# base keeps the injected retries from inflating the tier wall.
+chaos:
+	JAX_PLATFORMS=cpu \
+	SEQALIGN_FAULTS="chunk_scoring:fail=2;journal_append:fail=1" \
+	SEQALIGN_FAULT_RETRIES=3 SEQALIGN_BACKOFF_BASE=0.01 \
 	$(PYTHON) -m pytest tests/ -q
 
 # Full coverage in TWO pytest processes: the fast tier, then the

@@ -14,6 +14,14 @@ import os
 import sys
 
 from ..ops.dispatch import AlignmentScorer
+from ..resilience.degrade import (
+    BackendDegrader,
+    MaterialisedRows,
+    run_degrading,
+    verify_rows_against_oracle,
+)
+from ..resilience.faults import activate_faults, deactivate_faults
+from ..resilience.policy import RetryPolicy
 from ..utils.profiling import PhaseTimer, device_trace
 from .parse import load_problem
 from .printer import guarded_stdout, print_results, write_json_sidecar
@@ -114,6 +122,27 @@ def build_arg_parser() -> argparse.ArgumentParser:
         "lone-host retry still ends in the coordination-timeout teardown",
     )
     p.add_argument(
+        "--faults",
+        default=None,
+        metavar="SPEC",
+        help="deterministic fault injection for chaos testing: "
+        "'site:fail=N[,after=M][,kind=transient|fatal]' entries joined "
+        "with ';' (e.g. 'chunk_scoring:fail=2;journal_append:fail=1'); "
+        "the SEQALIGN_FAULTS env var supplies a spec when this flag is "
+        "absent (with a retry floor from SEQALIGN_FAULT_RETRIES so a "
+        "chaos suite run keeps its goldens); see "
+        "mpi_openmp_cuda_tpu/resilience/faults.py for the site list",
+    )
+    p.add_argument(
+        "--degrade",
+        action="store_true",
+        help="on retry-budget exhaustion, fall down the backend chain "
+        "pallas -> xla -> xla-gather instead of failing, logging the "
+        "fallback and re-verifying the first degraded chunk against the "
+        "host oracle (single-process only: under --distributed the "
+        "backend choice is the SPMD program itself)",
+    )
+    p.add_argument(
         "--stream",
         type=_positive_int,
         default=None,
@@ -138,53 +167,47 @@ class FeatureUnavailableError(RuntimeError):
     pass
 
 
-def _retrying(fn, retries: int, describe: str, used: list[int] | None = None):
-    """Run ``fn()`` with driver-level retries on transient failure.
+def _build_policy(args) -> tuple[RetryPolicy, str | None]:
+    """Resolve the run's RetryPolicy and fault spec.
 
-    The single source of the transient-vs-programming classification:
-    (ValueError, TypeError) are shape/programming errors and always
-    propagate; anything else is retried up to ``retries`` times.
-
-    ``used`` (a mutable one-element list) shares one attempt budget across
-    several _retrying calls: streaming mode passes the same counter to a
-    chunk's dispatch and materialise stages so the chunk gets N retries
-    total, matching the batch path's N+1-attempt contract.
+    Retry classification, the shared-budget contract and the lockstep
+    backoff all live in resilience.policy (this CLI's old ``_retrying`` /
+    ``_materialise_retrying`` helpers, unified).  The fault spec comes
+    from ``--faults``, else the SEQALIGN_FAULTS env var; only the
+    env-sourced spec gets the SEQALIGN_FAULT_RETRIES retry floor — an
+    explicit ``--faults`` keeps exactly ``--retries`` so over-budget
+    resilience tests stay deterministic even under a chaos-suite env.
     """
-    used = [0] if used is None else used
-    while True:
-        try:
-            return fn()
-        except (ValueError, TypeError):
-            raise
-        except Exception as e:
-            used[0] += 1
-            if used[0] > retries:
-                raise
-            print(
-                f"mpi_openmp_cuda_tpu: {describe} attempt {used[0]} "
-                f"failed ({e}); retrying",
-                file=sys.stderr,
-            )
+    retries = args.retries
+    fault_spec = args.faults
+    if fault_spec is None:
+        fault_spec = os.environ.get("SEQALIGN_FAULTS") or None
+        if fault_spec:
+            floor_env = os.environ.get("SEQALIGN_FAULT_RETRIES", "0") or "0"
+            try:
+                floor = int(floor_env)
+            except ValueError:
+                raise ValueError(
+                    "SEQALIGN_FAULT_RETRIES must be an integer, "
+                    f"got {floor_env!r}"
+                ) from None
+            retries = max(retries, floor)
+    return RetryPolicy(retries=retries), fault_spec
 
 
-def _materialise_retrying(promise, rescore, retries: int, budget):
-    """Materialise an async chunk dispatch under the shared retry budget.
-
-    The first attempt materialises ``promise``; every retry calls
-    ``rescore()`` (a synchronous rescoring of the same chunk).  The
-    coordinator's _finish and the worker stream loop BOTH go through this
-    helper so a job-wide transient failure sees every host take the same
-    attempt sequence and re-enter the same sharded collectives in
-    lockstep — two diverging copies of this pattern would turn such a
-    failure into a coordination-timeout teardown (ADVICE r3)."""
-    first = [promise]
-
-    def attempt():
-        if first:
-            return first.pop().result()
-        return rescore()
-
-    return _retrying(attempt, retries, "chunk scoring", used=budget)
+def _make_degrader(args, scorer) -> BackendDegrader:
+    """The run's degradation-chain state (a pass-through unless
+    ``--degrade``); replacement scorers keep the original's sharding and
+    chunk budget — only the backend changes."""
+    return BackendDegrader(
+        scorer,
+        lambda b: AlignmentScorer(
+            backend=b,
+            chunk_budget=scorer.chunk_budget,
+            sharding=scorer.sharding,
+        ),
+        enabled=bool(args.degrade),
+    )
 
 
 def _feature_import(what: str, importer):
@@ -225,7 +248,7 @@ def _make_scorer(args, distributed_active: bool) -> AlignmentScorer:
     return AlignmentScorer(backend=args.backend, sharding=sharding)
 
 
-def _run_streaming_worker(args, timer: PhaseTimer, dist) -> int:
+def _run_streaming_worker(args, timer: PhaseTimer, dist, policy) -> int:
     """Worker-side --stream --distributed loop: receive the broadcast
     stream header, then score every broadcast chunk inside the same
     collective schedule as the coordinator, until the end sentinel.
@@ -254,10 +277,10 @@ def _run_streaming_worker(args, timer: PhaseTimer, dist) -> int:
         # the --retries help (ADVICE r2).
         def _worker_finish(pending):
             promise, codes, budget = pending
-            _materialise_retrying(
+            policy.materialise(
                 promise,
                 lambda: scorer.score_codes(seq1_codes, codes, weights),
-                args.retries,
+                "chunk scoring",
                 budget,
             )
 
@@ -268,14 +291,13 @@ def _run_streaming_worker(args, timer: PhaseTimer, dist) -> int:
                 break
             cur = None
             if codes:
-                budget = [0]
-                promise = _retrying(
+                budget = policy.new_budget()
+                promise = policy.run(
                     lambda: scorer.score_codes_async(
                         seq1_codes, codes, weights
                     ),
-                    args.retries,
                     "chunk dispatch",
-                    used=budget,
+                    budget=budget,
                 )
                 cur = (promise, codes, budget)
             if pending is not None:
@@ -288,7 +310,12 @@ def _run_streaming_worker(args, timer: PhaseTimer, dist) -> int:
 
 
 def _run_streaming(
-    args, timer: PhaseTimer, dist=None, coordinator=True, out_stream=None
+    args,
+    timer: PhaseTimer,
+    policy: RetryPolicy,
+    dist=None,
+    coordinator=True,
+    out_stream=None,
 ) -> int:
     """The --stream pipeline: parse and score CHUNK sequences at a time
     with a window of chunks in flight on the device (single-process
@@ -328,10 +355,12 @@ def _run_streaming(
 
     multi = dist is not None and dist.process_count() > 1
     if multi and not coordinator:
-        return _run_streaming_worker(args, timer, dist)
+        return _run_streaming_worker(args, timer, dist, policy)
 
     with timer.phase("setup"):
-        scorer = _make_scorer(args, dist is not None)
+        # All scoring below goes through deg.scorer: a mid-stream
+        # degradation replaces the scorer for every later chunk too.
+        deg = _make_degrader(args, _make_scorer(args, dist is not None))
 
     all_results = [] if args.json else None
     lines = io.StringIO()
@@ -387,6 +416,60 @@ def _run_streaming(
                     dist.broadcast_chunk(None, failed=True)
                 raise
 
+        def _chunk_verify(codes_sub):
+            """Oracle re-verification closure for the first degraded chunk
+            (None when --degrade is off: run_degrading skips the check)."""
+            if not deg.enabled:
+                return None
+            return lambda rows: verify_rows_against_oracle(
+                header.seq1_codes, codes_sub, header.weights, rows
+            )
+
+        def _dispatch(codes_sub, budget):
+            """Async-dispatch a (journal-reduced) chunk under the shared
+            budget; on budget exhaustion with --degrade, fall down the
+            backend chain with a synchronous rescore — MaterialisedRows
+            keeps the promise contract for _finish."""
+            return run_degrading(
+                policy,
+                deg,
+                lambda: deg.scorer.score_codes_async(
+                    header.seq1_codes, codes_sub, header.weights
+                ),
+                lambda sc: sc.score_codes(
+                    header.seq1_codes, codes_sub, header.weights
+                ),
+                "chunk dispatch",
+                budget=budget,
+                verify=_chunk_verify(codes_sub),
+                wrap=MaterialisedRows,
+            )
+
+        def _materialise(promise, codes_sub, budget):
+            """Materialise under the chunk's shared budget (first attempt
+            forces the promise, retries rescore synchronously), degrading
+            past exhaustion like _dispatch."""
+            first = [promise]
+
+            def attempt():
+                if first:
+                    return first.pop().result()
+                return deg.scorer.score_codes(
+                    header.seq1_codes, codes_sub, header.weights
+                )
+
+            return run_degrading(
+                policy,
+                deg,
+                attempt,
+                lambda sc: sc.score_codes(
+                    header.seq1_codes, codes_sub, header.weights
+                ),
+                "chunk scoring",
+                budget=budget,
+                verify=_chunk_verify(codes_sub),
+            )
+
         def _submit(start, codes):
             """Dispatch a chunk; returns (promise, start, codes, pend, rows,
             hashes, budget).  pend is None without a journal (whole chunk
@@ -394,20 +477,13 @@ def _run_streaming(
             and rows pre-holds the journalled results.  budget is the
             chunk's shared retry counter: dispatch and materialise together
             get args.retries retries, like the batch path."""
-            budget = [0]
+            budget = policy.new_budget()
             if journal is None:
                 if multi:
                     # Workers must see the identical chunk before the
                     # sharded dispatch's collectives.
                     dist.broadcast_chunk(codes)
-                promise = _retrying(
-                    lambda: scorer.score_codes_async(
-                        header.seq1_codes, codes, header.weights
-                    ),
-                    args.retries,
-                    "chunk dispatch",
-                    used=budget,
-                )
+                promise = _dispatch(codes, budget)
                 return (promise, start, codes, None, None, None, budget)
             hashes = [seq_hash(c) for c in codes]
             pend = []
@@ -431,31 +507,14 @@ def _run_streaming(
                 # lockstep (they skip scoring an empty chunk, as here).
                 dist.broadcast_chunk([codes[j] for j in pend])
             if pend:
-                promise = _retrying(
-                    lambda: scorer.score_codes_async(
-                        header.seq1_codes,
-                        [codes[j] for j in pend],
-                        header.weights,
-                    ),
-                    args.retries,
-                    "chunk dispatch",
-                    used=budget,
-                )
+                promise = _dispatch([codes[j] for j in pend], budget)
             return (promise, start, codes, pend, rows, hashes, budget)
 
         def _finish(promise, start, codes, pend, rows, hashes, budget):
             res = None
             if promise is not None:
-
-                def rescore():
-                    sub = codes if pend is None else [codes[j] for j in pend]
-                    return scorer.score_codes(
-                        header.seq1_codes, sub, header.weights
-                    )
-
-                res = _materialise_retrying(
-                    promise, rescore, args.retries, budget
-                )
+                sub = codes if pend is None else [codes[j] for j in pend]
+                res = _materialise(promise, sub, budget)
             if pend is None:
                 out = res
             else:
@@ -463,10 +522,19 @@ def _run_streaming(
                 if res is not None:
                     for j, row in zip(pend, res):
                         out[j] = row
-                    journal.append(
-                        [start + j for j in pend],
-                        [hashes[j] for j in pend],
-                        res,
+                    # Retrying an append is safe: an injected fault fires
+                    # before the first byte, and a partially-flushed real
+                    # failure at worst duplicates records (same key, same
+                    # values — the resume reader keeps the last).  The
+                    # append gets its own fresh budget so journal IO
+                    # faults cannot eat a chunk's scoring budget.
+                    policy.run(
+                        lambda: journal.append(
+                            [start + j for j in pend],
+                            [hashes[j] for j in pend],
+                            res,
+                        ),
+                        "journal append",
                     )
             print_results(out, out=lines, start=start)
             if all_results is not None:
@@ -508,7 +576,15 @@ def _run_streaming(
                 for start, codes in header.iter_chunks(args.stream):
                     cur = _submit(start, codes)
                     if cur[0] is not None:
-                        cur[0].prefetch()
+                        try:
+                            cur[0].prefetch()
+                        except Exception:
+                            # Prefetch is purely a latency optimisation:
+                            # a device->host copy that cannot start here
+                            # resurfaces at result(), inside the chunk's
+                            # shared retry budget, instead of killing the
+                            # pipeline from an advisory call.
+                            pass
                     pendings.append(cur)
                     if len(pendings) > window:
                         _finish(*pendings.popleft())
@@ -536,7 +612,7 @@ def _run_streaming(
     (out_stream or sys.stdout).write(lines.getvalue())
     if args.json:
         write_json_sidecar(
-            all_results, args.json, meta={"backend": scorer.backend}
+            all_results, args.json, meta={"backend": deg.scorer.backend}
         )
     timer.report()
     return 0
@@ -571,6 +647,12 @@ def run(argv: list[str] | None = None) -> int:
          "the fully-materialised problem"),
     )):
         return 1
+    if args.degrade and _reject_combos("--degrade", (
+        ("--distributed", args.distributed, "the backend choice is the "
+         "SPMD program itself; a lone host degrading its backend "
+         "desynchronises the collective schedules"),
+    )):
+        return 1
 
     guard = None
     out_stream = None  # None -> sys.stdout
@@ -587,6 +669,11 @@ def run(argv: list[str] | None = None) -> int:
                 raise
 
     try:
+        # Arm the run's retry policy and (optional) fault registry first:
+        # a malformed --faults/env spec or retry floor fails fast through
+        # the normal error path below, before any expensive phase.
+        policy, fault_spec = _build_policy(args)
+        activate_faults(fault_spec)
         coordinator = True
         dist = None
         if args.distributed:
@@ -611,6 +698,7 @@ def run(argv: list[str] | None = None) -> int:
             code = _run_streaming(
                 args,
                 timer,
+                policy,
                 dist=dist,
                 coordinator=coordinator,
                 out_stream=out_stream,
@@ -633,7 +721,9 @@ def run(argv: list[str] | None = None) -> int:
             if args.distributed:
                 problem = dist.broadcast_problem(problem)
         with timer.phase("setup"):
-            scorer = _make_scorer(args, args.distributed)
+            # Scoring goes through deg.scorer so a --degrade fallback
+            # replaces the backend for the retry that follows it.
+            deg = _make_degrader(args, _make_scorer(args, args.distributed))
         journal, done = None, None
         if args.journal:
 
@@ -661,19 +751,34 @@ def run(argv: list[str] | None = None) -> int:
                         int(i): None for i in dist.broadcast_index_set(None)
                     }
 
-        def _score_once():
+        def _score_once(sc):
             if journal is not None:
                 # Workers run the identical reduced schedule without
                 # touching any journal file (record=False).
                 return journal.score_with_resume(
-                    scorer, problem, done=done, record=coordinator
+                    sc, problem, done=done, record=coordinator
                 )
-            return scorer.score_codes(
+            return sc.score_codes(
                 problem.seq1_codes, problem.seq2_codes, problem.weights
             )
 
+        def _batch_verify(rows):
+            # First degraded result only: resumed journal rows hold the
+            # pre-fault backend's (correct) values, so a whole-batch
+            # prefix check stays valid under --journal too.
+            verify_rows_against_oracle(
+                problem.seq1_codes, problem.seq2_codes, problem.weights, rows
+            )
+
         with timer.phase("score"), device_trace(args.trace):
-            results = _retrying(_score_once, args.retries, "scoring")
+            results = run_degrading(
+                policy,
+                deg,
+                lambda: _score_once(deg.scorer),
+                _score_once,
+                "scoring",
+                verify=_batch_verify if deg.enabled else None,
+            )
         # Coordinator-only: one host's oracle re-verification suffices,
         # and under --journal workers hold schedule placeholders (zeros)
         # for resumed rows, not results.
@@ -698,7 +803,9 @@ def run(argv: list[str] | None = None) -> int:
                 print_results(results, out=out_stream)
                 if args.json:
                     write_json_sidecar(
-                        results, args.json, meta={"backend": scorer.backend}
+                        results,
+                        args.json,
+                        meta={"backend": deg.scorer.backend},
                     )
         timer.report()
         # Close the guard while still inside the try: the final flush of
@@ -713,7 +820,9 @@ def run(argv: list[str] | None = None) -> int:
         return 1
     finally:
         # Error paths: restore fd 1 without letting a secondary flush
-        # failure mask the original exception.
+        # failure mask the original exception.  Faults are armed per run:
+        # disarm so library callers after a CLI run see no ambient faults.
+        deactivate_faults()
         _close_guard(suppress=True)
 
 

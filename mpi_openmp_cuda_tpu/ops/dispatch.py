@@ -18,6 +18,7 @@ from dataclasses import dataclass
 import numpy as np
 
 from ..models.encoding import encode_normalized, pad_to
+from ..resilience.faults import fire as _fault
 from ..utils.constants import ALPHABET_SIZE, BUF_SIZE_SEQ1, BUF_SIZE_SEQ2
 from .oracle import score_batch_oracle
 from .values import value_table
@@ -345,11 +346,13 @@ class PendingResult:
         pipeline prefetches every in-flight chunk right after dispatch so
         those round trips overlap compute and each other (r5 stream
         measurement: per-chunk fetches serialised the whole pipeline)."""
+        _fault("device_transfer")
         f = getattr(self.raw, "copy_to_host_async", None)
         if f is not None:
             f()
 
     def result(self) -> np.ndarray:
+        _fault("chunk_scoring")
         return np.asarray(self.raw).reshape(-1, 3)[: self.count]
 
 
@@ -371,6 +374,7 @@ class BucketedPending:
     def result(self) -> np.ndarray:
         import jax
 
+        _fault("chunk_scoring")
         out = np.zeros((self.count, 3), dtype=np.int32)
         # Batch the device_get across the local parts AND (single-process)
         # sharded parts — one host round trip for the whole batch.
@@ -468,6 +472,7 @@ class AlignmentScorer:
         Multi-length-bucket batches return a :class:`BucketedPending`
         (same ``.result()`` contract, input order restored).
         """
+        _fault("chunk_dispatch")
         if not seq2_codes:
             return PendingResult(np.zeros((0, 3), dtype=np.int32), 0)
         if self.backend == "oracle":

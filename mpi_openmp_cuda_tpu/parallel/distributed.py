@@ -14,6 +14,8 @@ from __future__ import annotations
 
 import os
 
+from ..resilience.faults import fire as _fault
+
 
 def initialize_distributed(
     coordinator_address: str | None = None,
@@ -80,6 +82,7 @@ def broadcast_problem(problem, *, failed: bool = False):
     import jax
     import numpy as np
 
+    _fault("broadcast_problem")
     if jax.process_count() == 1:
         return problem
     from jax.experimental import multihost_utils
@@ -171,6 +174,7 @@ def broadcast_index_set(indices=None, *, failed: bool = False):
     import jax
     import numpy as np
 
+    _fault("broadcast_index_set")
     if jax.process_count() == 1:
         return np.asarray(
             [] if indices is None else indices, dtype=np.int32
@@ -203,6 +207,7 @@ def broadcast_stream_meta(meta=None, *, failed: bool = False):
     import jax
     import numpy as np
 
+    _fault("broadcast_stream_meta")
     if jax.process_count() == 1:
         return meta
     if failed:
@@ -242,6 +247,7 @@ def broadcast_chunk(codes=None, *, end: bool = False, failed: bool = False):
     import jax
     import numpy as np
 
+    _fault("broadcast_chunk")
     if jax.process_count() == 1:
         return None if (end or failed) else codes
     if failed:

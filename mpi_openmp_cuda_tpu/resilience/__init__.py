@@ -1,0 +1,49 @@
+"""Resilience runtime: retry policy, fault injection, degradation.
+
+The reference program's failure model is pure fail-stop
+(``cudaFunctions.cu:15-33``, SURVEY §5).  A production service absorbing
+transient device/link faults needs the opposite default for *transient*
+errors, and — critically — needs every retry/abort/resume path to be
+reproducibly testable.  This package is the single policy layer the
+scattered per-call-site handling migrated into:
+
+* :mod:`.policy` — :class:`~.policy.RetryPolicy`: one attempt budget,
+  exponential backoff with deterministic seeded jitter, and the
+  transient-vs-fatal error classification (previously duplicated in
+  ``io/cli.py``'s ``_retrying`` / ``_materialise_retrying``).
+* :mod:`.faults` — deterministic fault injection: named sites at chunk
+  dispatch/materialise, device transfer, journal append, and each
+  coordinator broadcast fire injected errors on a counted schedule
+  driven by a spec string (``SEQALIGN_FAULTS`` / ``--faults``), so chaos
+  runs are exact reproducible tests instead of a hope.
+* :mod:`.degrade` — graceful degradation: when a backend exhausts its
+  retry budget on the same chunk, fall down the backend chain
+  (pallas -> xla -> xla-gather) with a logged warning, re-verifying the
+  first degraded chunk against the host oracle (``--degrade``).
+
+Everything here is pure stdlib + numpy-free at import time, so the
+instrumented modules (``ops``, ``io``, ``utils``, ``parallel``) can
+import the ``fire`` hook without cost or cycles.
+"""
+
+from .faults import (
+    FaultRegistry,
+    InjectedFatalFaultError,
+    InjectedFaultError,
+    activate_faults,
+    deactivate_faults,
+    fire,
+)
+from .policy import FATAL_ERROR_TYPES, RetryExhaustedError, RetryPolicy
+
+__all__ = [
+    "FATAL_ERROR_TYPES",
+    "FaultRegistry",
+    "InjectedFatalFaultError",
+    "InjectedFaultError",
+    "RetryExhaustedError",
+    "RetryPolicy",
+    "activate_faults",
+    "deactivate_faults",
+    "fire",
+]

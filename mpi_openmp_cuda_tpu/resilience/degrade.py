@@ -1,0 +1,152 @@
+"""Backend degradation chain: survive a persistently-broken backend.
+
+Retry absorbs *transient* faults; a backend that fails the same chunk
+past its whole budget is effectively broken (a wedged kernel runtime, a
+poisoned compile cache, a sick device).  Under ``--degrade`` that no
+longer kills the run: the driver falls down the backend chain
+
+    pallas -> xla -> xla-gather
+
+rescoring the failed chunk (and serving every later chunk) on the next
+backend, with a logged warning.  The first successfully degraded chunk
+is re-verified against the host oracle (``ops/oracle.py``) before its
+rows are trusted — a backend that *silently corrupts* instead of
+failing must not be degraded onto; a mismatch raises
+:class:`DegradedBackendMismatchError` (a ValueError: fatal, never
+retried).
+
+Degradation is a **single-process** feature: under ``--distributed``
+the backend choice IS the SPMD program, and a lone host degrading would
+desynchronise the collective schedules (a hang, not an error) — the CLI
+statically rejects ``--degrade --distributed``, the same stance as
+``resolve_auto_backend``'s multi-host pallas-import failure.
+"""
+
+from __future__ import annotations
+
+import sys
+
+from .policy import RetryExhaustedError, RetryPolicy
+
+# The fallback order.  'xla' is the MXU matmul formulation (with its own
+# exactness fallback); 'xla-gather' forces the always-exact int32 gather
+# formulation — the most conservative accelerated path, so the chain
+# ends there (the host oracle stays a *verifier*, not a serving tier).
+DEGRADE_CHAIN = {"pallas": "xla", "xla": "xla-gather"}
+
+# Sequences of the first degraded chunk re-verified against the oracle
+# (a sample bounds the host-side cost on huge chunks).
+VERIFY_CAP = 32
+
+
+class DegradedBackendMismatchError(ValueError):
+    """A degraded backend disagreed with the host oracle (fatal)."""
+
+
+class MaterialisedRows:
+    """Pending-compatible wrapper for rows a degraded backend already
+    scored synchronously (so the streaming pipeline's promise contract
+    survives a dispatch-stage degradation)."""
+
+    def __init__(self, rows):
+        self._rows = rows
+
+    def prefetch(self) -> None:
+        pass
+
+    def result(self):
+        return self._rows
+
+
+class BackendDegrader:
+    """Chain state for one run: the live scorer + how far it has fallen.
+
+    ``make_scorer(backend)`` builds the replacement scorer (same
+    sharding/chunk budget as the original); ``enabled=False`` turns the
+    whole object into a pass-through so call sites stay uniform.
+    """
+
+    def __init__(self, scorer, make_scorer, *, enabled: bool = False, log=None):
+        self.scorer = scorer
+        self._make = make_scorer
+        self.enabled = enabled
+        self.verified = False  # first degraded chunk oracle-checked yet?
+        self._log = log or (lambda msg: print(msg, file=sys.stderr))
+
+    def step(self) -> str | None:
+        """Fall one link down the chain; returns the new backend name, or
+        None when the chain is exhausted (caller re-raises)."""
+        nxt = DEGRADE_CHAIN.get(self.scorer.backend)
+        if nxt is None:
+            return None
+        self._log(
+            f"mpi_openmp_cuda_tpu: warning: backend {self.scorer.backend!r} "
+            f"exhausted its retry budget; degrading to {nxt!r} (the first "
+            "degraded chunk is re-verified against the host oracle)"
+        )
+        self.scorer = self._make(nxt)
+        return nxt
+
+
+def verify_rows_against_oracle(seq1_codes, seq2_codes, weights, rows) -> None:
+    """Compare up to :data:`VERIFY_CAP` rows against ``ops/oracle.py``;
+    raise :class:`DegradedBackendMismatchError` on any divergence."""
+    from ..ops.oracle import score_batch_oracle
+
+    k = min(len(seq2_codes), VERIFY_CAP)
+    if k == 0:
+        return
+    want = score_batch_oracle(seq1_codes, list(seq2_codes)[:k], weights)
+    got = [tuple(int(x) for x in row) for row in list(rows)[:k]]
+    if got != [tuple(int(x) for x in w) for w in want]:
+        raise DegradedBackendMismatchError(
+            "degraded backend disagrees with the host oracle on the first "
+            f"{k} sequences of the degraded chunk; refusing to continue"
+        )
+
+
+def run_degrading(
+    policy: RetryPolicy,
+    degrader: BackendDegrader | None,
+    attempt,
+    rescore,
+    describe: str,
+    *,
+    budget=None,
+    verify=None,
+    wrap=None,
+):
+    """``policy.run(attempt)``, falling down the degradation chain on
+    transient budget exhaustion.
+
+    ``rescore(scorer)`` rescores the same work on a (degraded) scorer
+    under a FRESH budget per chain link.  ``verify(rows)`` runs once on
+    the first degraded result (oracle re-verification); ``wrap(rows)``
+    adapts a degraded synchronous result to the caller's return contract
+    (the streaming dispatch stage wraps rows in
+    :class:`MaterialisedRows`).  With ``degrader`` disabled/None this is
+    exactly ``policy.run``.
+    """
+    try:
+        return policy.run(attempt, describe, budget=budget)
+    except RetryExhaustedError as exhausted:
+        if degrader is None or not degrader.enabled:
+            raise
+        last = exhausted
+        while True:
+            backend = degrader.step()
+            if backend is None:
+                raise last
+            try:
+                rows = policy.run(
+                    lambda: rescore(degrader.scorer),
+                    f"{describe} [degraded:{backend}]",
+                    budget=policy.new_budget(),
+                )
+            except RetryExhaustedError as e:
+                last = e
+                continue
+            if verify is not None and not degrader.verified:
+                verify(rows)
+                degrader.verified = True
+            return wrap(rows) if wrap is not None else rows

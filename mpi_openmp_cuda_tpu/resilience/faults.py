@@ -1,0 +1,187 @@
+"""Deterministic fault injection (chaos testing as a first-class tier).
+
+The repo's retry/abort/resume machinery predates this module but was
+only testable by monkeypatching scorer internals per test.  Here every
+resilience-relevant code path is *instrumented*: it calls
+:func:`fire(site)` with a stable site name, and an activated registry
+decides — from a counted, fully deterministic schedule — whether that
+invocation raises an injected error.  A chaos run is then an exact
+reproducible test: same spec + same input => same faults at the same
+points, every time, on every host (the counters depend only on the
+program's own call sequence, which the lockstep SPMD schedule already
+keeps identical across hosts).
+
+Spec grammar (``SEQALIGN_FAULTS`` env var or ``--faults``)::
+
+    spec    ::= entry (';' entry)*
+    entry   ::= site ':' kv (',' kv)*
+    kv      ::= 'fail=' N        # inject N consecutive faults
+              | 'after=' M      # ... starting at invocation M (default 0)
+              | 'kind=' transient|fatal
+
+    SEQALIGN_FAULTS="chunk_scoring:fail=2;journal_append:fail=1"
+
+``kind=transient`` (default) raises :class:`InjectedFaultError`
+(retried by :class:`~.policy.RetryPolicy`); ``kind=fatal`` raises
+:class:`InjectedFatalFaultError`, a ValueError — the policy's fatal
+class — so the never-retry contract is testable too.
+
+The registry is **armed per run**: the CLI activates it at entry and
+deactivates in a finally, so library callers and unit tests that drive
+the scorer directly never see ambient faults.  When inactive,
+:func:`fire` is a single attribute check.
+
+Instrumented sites:
+
+========================  ====================================================
+``chunk_dispatch``        ``AlignmentScorer.score_codes_async`` entry
+``chunk_scoring``         result materialisation (``PendingResult.result`` /
+                          ``BucketedPending.result``)
+``device_transfer``       the prefetched device->host copy
+                          (``PendingResult.prefetch``)
+``journal_append``        every journal record write (``utils/journal.py``)
+``broadcast_problem``     each coordinator broadcast
+``broadcast_chunk``       (``parallel/distributed.py``)
+``broadcast_index_set``
+``broadcast_stream_meta``
+========================  ====================================================
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+KNOWN_SITES = frozenset(
+    {
+        "chunk_dispatch",
+        "chunk_scoring",
+        "device_transfer",
+        "journal_append",
+        "broadcast_problem",
+        "broadcast_chunk",
+        "broadcast_index_set",
+        "broadcast_stream_meta",
+    }
+)
+
+
+class InjectedFaultError(RuntimeError):
+    """A deterministic injected *transient* fault (retried by policy)."""
+
+
+class InjectedFatalFaultError(ValueError):
+    """A deterministic injected *fatal* fault (never retried — ValueError
+    is the policy's fatal classification)."""
+
+
+@dataclass(frozen=True)
+class SiteFaults:
+    """One site's schedule: invocations [after, after+fail) raise."""
+
+    fail: int
+    after: int = 0
+    kind: str = "transient"
+
+
+def parse_spec(spec: str) -> dict[str, SiteFaults]:
+    """Parse the ``site:fail=N[,after=M][,kind=K]`` grammar; fail fast on
+    unknown sites/keys so a typo'd chaos spec cannot silently test
+    nothing."""
+    sites: dict[str, SiteFaults] = {}
+    for entry in spec.split(";"):
+        entry = entry.strip()
+        if not entry:
+            continue
+        site, sep, body = entry.partition(":")
+        site = site.strip()
+        if not sep or not body.strip():
+            raise ValueError(
+                f"bad --faults entry {entry!r}: want site:fail=N[,after=M]"
+                "[,kind=transient|fatal]"
+            )
+        if site not in KNOWN_SITES:
+            raise ValueError(
+                f"bad --faults site {site!r}: known sites are "
+                f"{', '.join(sorted(KNOWN_SITES))}"
+            )
+        kv = {}
+        for part in body.split(","):
+            key, sep, val = part.partition("=")
+            key = key.strip()
+            val = val.strip()
+            if not sep or key not in ("fail", "after", "kind"):
+                raise ValueError(
+                    f"bad --faults key {part.strip()!r} for site {site!r}: "
+                    "want fail=N, after=M, or kind=transient|fatal"
+                )
+            if key == "kind":
+                if val not in ("transient", "fatal"):
+                    raise ValueError(
+                        f"bad --faults kind {val!r}: want transient or fatal"
+                    )
+                kv[key] = val
+            else:
+                try:
+                    n = int(val)
+                except ValueError:
+                    raise ValueError(
+                        f"bad --faults value {val!r} for {site}:{key}"
+                    ) from None
+                if n < 0:
+                    raise ValueError(f"--faults {site}:{key} must be >= 0")
+                kv[key] = n
+        if "fail" not in kv:
+            raise ValueError(f"--faults entry for {site!r} needs fail=N")
+        if site in sites:
+            raise ValueError(f"duplicate --faults site {site!r}")
+        sites[site] = SiteFaults(**kv)
+    return sites
+
+
+class FaultRegistry:
+    """Per-run fault state: invocation counters + the parsed schedule."""
+
+    def __init__(self, spec: str | dict[str, SiteFaults]):
+        self.sites = parse_spec(spec) if isinstance(spec, str) else dict(spec)
+        self.counts: dict[str, int] = {}
+        self.injected = 0
+
+    def fire(self, site: str) -> None:
+        n = self.counts.get(site, 0)
+        self.counts[site] = n + 1
+        sf = self.sites.get(site)
+        if sf is not None and sf.after <= n < sf.after + sf.fail:
+            self.injected += 1
+            cls = (
+                InjectedFatalFaultError
+                if sf.kind == "fatal"
+                else InjectedFaultError
+            )
+            raise cls(
+                f"injected {sf.kind} fault at site {site!r} (invocation {n})"
+            )
+
+
+# The armed registry.  Module-global, single-threaded by construction:
+# the instrumented sites all run on the driver thread.
+_active: FaultRegistry | None = None
+
+
+def activate_faults(spec) -> FaultRegistry | None:
+    """Arm a fresh registry for one run (counters reset); ``spec`` may be
+    None/empty (no-op — fire() stays a cheap check).  Returns the
+    registry so callers can inspect ``injected`` afterwards."""
+    global _active
+    _active = FaultRegistry(spec) if spec else None
+    return _active
+
+
+def deactivate_faults() -> None:
+    global _active
+    _active = None
+
+
+def fire(site: str) -> None:
+    """Instrumentation hook: raises per the armed schedule, else no-op."""
+    if _active is not None:
+        _active.fire(site)

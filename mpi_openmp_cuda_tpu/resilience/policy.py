@@ -1,0 +1,169 @@
+"""Unified retry/backoff policy (SURVEY §5: absorb transient faults).
+
+One :class:`RetryPolicy` instance per run owns the three decisions the
+old per-call-site helpers (``io/cli.py`` ``_retrying`` /
+``_materialise_retrying``) each re-derived:
+
+* **classification** — (ValueError, TypeError) are shape/programming
+  errors and always propagate; anything else is transient and retried.
+  :data:`FATAL_ERROR_TYPES` is the single source.
+* **attempt budget** — ``retries`` extra attempts per budget.  A budget
+  is a mutable one-element list so several stages can SHARE one: the
+  streaming pipeline passes the same counter to a chunk's dispatch and
+  materialise stages, so the chunk gets N retries total, matching the
+  batch path's N+1-attempt contract.
+* **backoff** — exponential with deterministic seeded jitter.  The
+  jitter derives from ``(seed, describe, attempt)`` only — never from
+  time, pid, or host identity — so under ``--distributed`` every host
+  computes the IDENTICAL sleep sequence for a job-wide transient
+  failure and re-enters the sharded collectives in lockstep (the
+  cross-host contract documented at the CLI's ``--retries`` help; a
+  per-host random jitter would skew the schedules toward the
+  coordination-timeout teardown it exists to avoid).
+
+Budget exhaustion on a transient error raises
+:class:`RetryExhaustedError` chaining the last cause — the typed signal
+the degradation chain (:mod:`.degrade`) keys on.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import sys
+import time
+
+# The single source of the transient-vs-fatal classification (previously
+# a docstring contract in io/cli.py:_retrying).
+FATAL_ERROR_TYPES = (ValueError, TypeError)
+
+# Backoff defaults: first retry waits ~BASE seconds, doubling per attempt
+# up to CAP.  SEQALIGN_BACKOFF_BASE overrides (0 disables sleeping —
+# the chaos suite uses a near-zero base to keep injected-fault runs fast).
+_DEFAULT_BACKOFF_BASE = 0.05
+_DEFAULT_BACKOFF_FACTOR = 2.0
+_DEFAULT_BACKOFF_CAP = 2.0
+
+
+class RetryExhaustedError(RuntimeError):
+    """A transient failure outlived its retry budget (the policy's
+    exhaustion error: nonzero exit unless a degradation chain absorbs
+    it).  ``__cause__`` carries the last underlying error."""
+
+
+class RetryPolicy:
+    """Attempt budget + backoff + classification for one run.
+
+    ``sleep`` / ``log`` are injectable for tests; ``seed`` feeds the
+    deterministic jitter (same seed + site + attempt => same delay on
+    every host).
+    """
+
+    def __init__(
+        self,
+        retries: int = 0,
+        *,
+        backoff_base: float | None = None,
+        backoff_factor: float = _DEFAULT_BACKOFF_FACTOR,
+        backoff_cap: float = _DEFAULT_BACKOFF_CAP,
+        seed: int = 0,
+        sleep=time.sleep,
+        log=None,
+    ):
+        if retries < 0:
+            raise ValueError(f"retries must be >= 0, got {retries}")
+        self.retries = int(retries)
+        if backoff_base is None:
+            env = os.environ.get("SEQALIGN_BACKOFF_BASE")
+            try:
+                backoff_base = (
+                    float(env) if env else _DEFAULT_BACKOFF_BASE
+                )
+            except ValueError:
+                raise ValueError(
+                    f"SEQALIGN_BACKOFF_BASE must be a float, got {env!r}"
+                ) from None
+        self.backoff_base = float(backoff_base)
+        self.backoff_factor = float(backoff_factor)
+        self.backoff_cap = float(backoff_cap)
+        self.seed = int(seed)
+        self._sleep = sleep
+        self._log = log or (lambda msg: print(msg, file=sys.stderr))
+
+    # -- pieces ------------------------------------------------------------
+    @staticmethod
+    def is_fatal(exc: BaseException) -> bool:
+        return isinstance(exc, FATAL_ERROR_TYPES)
+
+    def new_budget(self) -> list[int]:
+        """A fresh shared attempt counter (see module docstring)."""
+        return [0]
+
+    def backoff_delay(self, attempt: int, describe: str) -> float:
+        """Deterministic delay before retry ``attempt`` (1-based) at site
+        ``describe``: exponential, capped, jittered in [0.5x, 1.5x) by a
+        PRNG seeded from (seed, describe, attempt) alone — identical on
+        every host of a lockstep SPMD job."""
+        if self.backoff_base <= 0:
+            return 0.0
+        raw = min(
+            self.backoff_cap,
+            self.backoff_base * self.backoff_factor ** (attempt - 1),
+        )
+        jitter = 0.5 + random.Random(
+            f"{self.seed}:{describe}:{attempt}"
+        ).random()
+        return raw * jitter
+
+    # -- the retry loop ----------------------------------------------------
+    def run(self, fn, describe: str, budget: list[int] | None = None):
+        """Run ``fn()`` absorbing up to ``retries`` transient failures.
+
+        ``budget`` shares one attempt counter across several ``run``
+        calls (stream mode: one chunk's dispatch + materialise).  Fatal
+        errors (:data:`FATAL_ERROR_TYPES`) always propagate untouched;
+        a transient error past the budget raises
+        :class:`RetryExhaustedError` chaining it.
+        """
+        used = self.new_budget() if budget is None else budget
+        while True:
+            try:
+                return fn()
+            except FATAL_ERROR_TYPES:
+                raise
+            except Exception as e:
+                used[0] += 1
+                if used[0] > self.retries:
+                    raise RetryExhaustedError(
+                        f"{describe}: retry budget exhausted after "
+                        f"{used[0]} attempts ({e})"
+                    ) from e
+                delay = self.backoff_delay(used[0], describe)
+                suffix = f" in {delay:.2f}s" if delay > 0 else ""
+                self._log(
+                    f"mpi_openmp_cuda_tpu: {describe} attempt {used[0]} "
+                    f"failed ({e}); retrying{suffix}"
+                )
+                if delay > 0:
+                    self._sleep(delay)
+
+    def materialise(self, promise, rescore, describe: str, budget):
+        """Materialise an async dispatch under the shared budget.
+
+        The first attempt forces ``promise``; every retry calls
+        ``rescore()`` (a synchronous rescore of the same chunk).  The
+        coordinator's chunk finish and the worker stream loop BOTH go
+        through this method, so a job-wide transient failure sees every
+        host take the same attempt sequence and re-enter the same
+        sharded collectives in lockstep — two diverging copies of this
+        pattern would turn such a failure into a coordination-timeout
+        teardown (ADVICE r3).
+        """
+        first = [promise]
+
+        def attempt():
+            if first:
+                return first.pop().result()
+            return rescore()
+
+        return self.run(attempt, describe, budget=budget)

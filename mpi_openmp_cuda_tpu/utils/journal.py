@@ -32,6 +32,8 @@ import os
 
 import numpy as np
 
+from ..resilience.faults import fire as _fault
+
 _FORMAT = "mpi_openmp_cuda_tpu.journal.v1"
 _STREAM_FORMAT = "mpi_openmp_cuda_tpu.stream-journal.v1"
 
@@ -89,6 +91,9 @@ def _read_records(path, fmt, fingerprint, parse_rec, foreign_hint="", mismatch_h
 def _write_records(f, recs) -> None:
     """Append JSON records, then flush + fsync (a kill loses at most the
     in-flight chunk)."""
+    # Fault site BEFORE any byte is written: an injected append failure
+    # models a full kill of the in-flight chunk, never a torn record.
+    _fault("journal_append")
     for rec in recs:
         f.write(json.dumps(rec) + "\n")
     f.flush()

@@ -114,7 +114,14 @@ def main() -> None:
     golden = run_mode(mode_args("batch"))
     for m in modes[1:]:
         out = run_mode(mode_args(m))
-        assert out == golden, f"mode {m} output diverges from batch"
+        if out != golden:
+            # An explicit error, not an assert: python -O must not turn a
+            # correctness gate into silently publishing walls for a mode
+            # that produced different bytes.
+            raise RuntimeError(
+                f"mode {m} output diverges from batch; refusing to "
+                "publish timings for non-identical output"
+            )
 
     def measure():
         walls = {m: [] for m in modes}

@@ -491,3 +491,38 @@ def test_two_process_ring_long_context_beyond_cap(tmp_path):
     )
     assert out0 == want
     assert out1 == ""
+
+
+def test_single_process_broadcast_abort_and_end_semantics():
+    """Single-process fast paths of every coordinator broadcast — the
+    early returns the multi-host pair tests above never reach, including
+    the failed=True abort headers (ISSUE: abort-path coverage without a
+    second process)."""
+    import numpy as np
+
+    from mpi_openmp_cuda_tpu.parallel import distributed as dist
+
+    # broadcast_chunk: payload passes through; end/failed both drain to
+    # None (the caller's stream-terminates contract either way).
+    codes = [np.array([1, 2], dtype=np.int8)]
+    assert dist.broadcast_chunk(codes) is codes
+    assert dist.broadcast_chunk(None, end=True) is None
+    assert dist.broadcast_chunk(codes, failed=True) is None
+
+    # broadcast_index_set: always an int32 array; the abort flag is
+    # irrelevant with no workers to release (the coordinator's real
+    # exception is already in flight).
+    got = dist.broadcast_index_set([3, 1, 2])
+    assert got.dtype == np.int32 and got.tolist() == [3, 1, 2]
+    assert dist.broadcast_index_set(None).tolist() == []
+    assert dist.broadcast_index_set(None, failed=True).tolist() == []
+
+    # broadcast_stream_meta: identity on the meta tuple; a failed abort
+    # with no meta yields None without raising.
+    meta = ([1, 2, 3, 4], np.array([1], dtype=np.int8), 5)
+    assert dist.broadcast_stream_meta(meta) is meta
+    assert dist.broadcast_stream_meta(None, failed=True) is None
+
+    # broadcast_problem: identity (coordinator keeps its parsed problem).
+    sentinel = object()
+    assert dist.broadcast_problem(sentinel) is sentinel

@@ -144,3 +144,38 @@ def test_cli_journal_composes_with_mesh(tmp_path, capsys):
     assert run(args) == 0
     assert capsys.readouterr().out == want
     assert (os.path.getmtime(jpath), open(jpath).read()) == before
+
+
+def test_stream_journal_enter_without_load_validates_header(tmp_path):
+    """__enter__ before load() must run the deferred load: a foreign
+    journal is rejected (not silently truncated by the 'w' reopen), and
+    a matching one is appended to, preserving its records."""
+    from mpi_openmp_cuda_tpu.utils.journal import StreamJournal, seq_hash
+
+    weights = [10, 2, 3, 4]
+    seq1 = np.arange(1, 9, dtype=np.int8)
+    seqs = [np.array([1, 2, 3], dtype=np.int8), np.array([4], dtype=np.int8)]
+    path = str(tmp_path / "s.jsonl")
+
+    # Seed a journal for THIS problem with one scored record.
+    first = StreamJournal(path, weights, seq1, len(seqs))
+    first.load()
+    with first:
+        first.append([0], [seq_hash(seqs[0])], [(5, 1, 2)])
+    before = open(path).read()
+
+    # Foreign problem (different weights), enter without load: must raise
+    # and leave the file untouched.
+    foreign = StreamJournal(path, [1, 1, 1, 1], seq1, len(seqs))
+    with pytest.raises(JournalMismatchError):
+        with foreign:
+            pass
+    assert open(path).read() == before
+
+    # Matching problem, enter without load: appends (no truncation).
+    again = StreamJournal(path, weights, seq1, len(seqs))
+    with again:
+        again.append([1], [seq_hash(seqs[1])], [(7, 0, 1)])
+    lines = open(path).read().splitlines()
+    assert lines[: len(before.splitlines())] == before.splitlines()
+    assert len(lines) == 3  # header + both records survived

@@ -1,0 +1,506 @@
+"""Resilience runtime tests: deterministic fault injection, the unified
+retry/backoff policy, and the backend degradation chain (ISSUE: chaos
+coverage for mpi_openmp_cuda_tpu/resilience/).
+
+The e2e tests drive the real CLI in-process with ``--faults`` specs and
+assert the acceptance contract: under-budget transient faults leave the
+output byte-identical to the goldens; over-budget faults exit non-zero
+with the policy's exhaustion error and NOTHING on stdout (fail-stop);
+``--degrade`` completes the run on the next backend down the chain with
+a logged fallback.  Every fault schedule is explicit, so these tests
+stay deterministic even under an ambient `make chaos` env (an explicit
+--faults overrides SEQALIGN_FAULTS and takes no retry floor).
+"""
+
+import pytest
+
+from conftest import run_cli_inproc as run_inproc
+from test_fixtures import fixture_path, golden
+
+from mpi_openmp_cuda_tpu.resilience.degrade import (
+    DegradedBackendMismatchError,
+    MaterialisedRows,
+    verify_rows_against_oracle,
+)
+from mpi_openmp_cuda_tpu.resilience.faults import (
+    FaultRegistry,
+    InjectedFatalFaultError,
+    InjectedFaultError,
+    SiteFaults,
+    activate_faults,
+    deactivate_faults,
+    fire,
+    parse_spec,
+)
+from mpi_openmp_cuda_tpu.resilience.policy import (
+    RetryExhaustedError,
+    RetryPolicy,
+)
+
+
+@pytest.fixture(autouse=True)
+def _fast_backoff(monkeypatch):
+    # e2e retries must not sleep through real backoff; unit tests that
+    # exercise the backoff math pass backoff_base explicitly.
+    monkeypatch.setenv("SEQALIGN_BACKOFF_BASE", "0")
+
+
+# -- spec grammar ----------------------------------------------------------
+
+
+def test_parse_spec_full_grammar():
+    spec = "chunk_scoring:fail=2;journal_append:fail=1,after=3,kind=fatal"
+    assert parse_spec(spec) == {
+        "chunk_scoring": SiteFaults(fail=2),
+        "journal_append": SiteFaults(fail=1, after=3, kind="fatal"),
+    }
+
+
+@pytest.mark.parametrize(
+    "bad, match",
+    [
+        ("bogus_site:fail=1", "known sites"),
+        ("chunk_scoring", "want site:fail=N"),
+        ("chunk_scoring:after=1", "needs fail=N"),
+        ("chunk_scoring:nope=1", "bad --faults key"),
+        ("chunk_scoring:fail=x", "bad --faults value"),
+        ("chunk_scoring:fail=-1", "must be >= 0"),
+        ("chunk_scoring:fail=1,kind=sometimes", "bad --faults kind"),
+        ("chunk_scoring:fail=1;chunk_scoring:fail=2", "duplicate"),
+    ],
+)
+def test_parse_spec_rejects_malformed(bad, match):
+    with pytest.raises(ValueError, match=match):
+        parse_spec(bad)
+
+
+def test_registry_counts_are_deterministic():
+    reg = FaultRegistry("chunk_scoring:fail=2,after=1")
+    reg.fire("chunk_scoring")  # invocation 0: before the window
+    with pytest.raises(InjectedFaultError):
+        reg.fire("chunk_scoring")  # 1
+    with pytest.raises(InjectedFaultError):
+        reg.fire("chunk_scoring")  # 2
+    reg.fire("chunk_scoring")  # 3: past the window
+    reg.fire("journal_append")  # other sites never fault
+    assert reg.injected == 2
+    # The schedule is a pure function of the call sequence: a fresh
+    # registry replays identically.
+    reg2 = FaultRegistry("chunk_scoring:fail=2,after=1")
+    reg2.fire("chunk_scoring")
+    for _ in range(2):
+        with pytest.raises(InjectedFaultError):
+            reg2.fire("chunk_scoring")
+
+
+def test_fatal_kind_is_a_value_error():
+    reg = FaultRegistry("device_transfer:fail=1,kind=fatal")
+    with pytest.raises(InjectedFatalFaultError) as exc:
+        reg.fire("device_transfer")
+    assert isinstance(exc.value, ValueError)
+    assert RetryPolicy.is_fatal(exc.value)
+    assert not RetryPolicy.is_fatal(InjectedFaultError("x"))
+
+
+def test_fire_is_inert_until_activated():
+    deactivate_faults()
+    fire("chunk_scoring")  # no registry: must be a no-op
+    try:
+        reg = activate_faults("chunk_scoring:fail=1")
+        with pytest.raises(InjectedFaultError):
+            fire("chunk_scoring")
+        assert reg.injected == 1
+    finally:
+        deactivate_faults()
+    fire("chunk_scoring")  # disarmed again
+
+
+# -- retry policy ----------------------------------------------------------
+
+
+def test_policy_shared_budget_spans_stages():
+    policy = RetryPolicy(retries=2, backoff_base=0, log=lambda m: None)
+    budget = policy.new_budget()
+    state = {"a": 0, "b": 0}
+
+    def stage_a():
+        state["a"] += 1
+        if state["a"] == 1:
+            raise RuntimeError("transient a")
+        return "a"
+
+    def stage_b():
+        state["b"] += 1
+        if state["b"] == 1:
+            raise RuntimeError("transient b")
+        return "b"
+
+    assert policy.run(stage_a, "a", budget=budget) == "a"
+    assert policy.run(stage_b, "b", budget=budget) == "b"
+    assert budget == [2]  # both stages drew from ONE counter
+    with pytest.raises(RetryExhaustedError):
+        policy.run(lambda: (_ for _ in ()).throw(RuntimeError("c")), "c", budget=budget)
+
+
+def test_policy_never_retries_fatal_errors():
+    calls = {"n": 0}
+
+    def bad():
+        calls["n"] += 1
+        raise ValueError("shape bug")
+
+    policy = RetryPolicy(retries=5, backoff_base=0, log=lambda m: None)
+    with pytest.raises(ValueError, match="shape bug"):
+        policy.run(bad, "x")
+    assert calls["n"] == 1
+
+
+def test_policy_exhaustion_chains_the_cause():
+    policy = RetryPolicy(retries=1, backoff_base=0, log=lambda m: None)
+
+    def down():
+        raise RuntimeError("persistent device loss")
+
+    with pytest.raises(RetryExhaustedError, match="persistent device loss") as exc:
+        policy.run(down, "scoring")
+    assert isinstance(exc.value.__cause__, RuntimeError)
+    assert "retry budget exhausted" in str(exc.value)
+
+
+def test_backoff_is_exponential_capped_and_deterministic():
+    delays = []
+    policy = RetryPolicy(
+        retries=6,
+        backoff_base=0.1,
+        backoff_cap=0.5,
+        sleep=delays.append,
+        log=lambda m: None,
+    )
+    state = {"n": 0}
+
+    def flaky():
+        state["n"] += 1
+        if state["n"] <= 6:
+            raise RuntimeError("flap")
+        return "ok"
+
+    assert policy.run(flaky, "site") == "ok"
+    assert len(delays) == 6
+    raw = [min(0.5, 0.1 * 2 ** k) for k in range(6)]
+    for d, r in zip(delays, raw):
+        assert 0.5 * r <= d < 1.5 * r  # jitter window around the raw curve
+    # Same (seed, describe, attempt) => the same delay on every host of a
+    # lockstep SPMD job; a different seed jitters differently.
+    twin = RetryPolicy(retries=6, backoff_base=0.1, backoff_cap=0.5)
+    assert [twin.backoff_delay(k + 1, "site") for k in range(6)] == delays
+    other = RetryPolicy(retries=6, backoff_base=0.1, backoff_cap=0.5, seed=7)
+    assert [other.backoff_delay(k + 1, "site") for k in range(6)] != delays
+
+
+def test_materialise_forces_promise_then_rescores():
+    policy = RetryPolicy(retries=1, backoff_base=0, log=lambda m: None)
+
+    class BrokenPromise:
+        def result(self):
+            raise RuntimeError("copy lost")
+
+    rescored = {"n": 0}
+
+    def rescore():
+        rescored["n"] += 1
+        return "rows"
+
+    budget = policy.new_budget()
+    assert policy.materialise(BrokenPromise(), rescore, "chunk", budget) == "rows"
+    assert rescored["n"] == 1 and budget == [1]
+
+
+# -- degradation primitives ------------------------------------------------
+
+
+def test_materialised_rows_contract():
+    rows = [(1, 2, 3)]
+    wrapped = MaterialisedRows(rows)
+    wrapped.prefetch()  # no-op by contract
+    assert wrapped.result() is rows
+
+
+def test_verify_rows_against_oracle_catches_corruption():
+    import numpy as np
+
+    seq1 = np.array([1, 2, 3, 4], dtype=np.int8)
+    seqs = [np.array([1, 2], dtype=np.int8), np.array([3], dtype=np.int8)]
+    weights = [4, 3, 2, 1]
+    from mpi_openmp_cuda_tpu.ops.oracle import score_batch_oracle
+
+    good = score_batch_oracle(seq1, seqs, weights)
+    verify_rows_against_oracle(seq1, seqs, weights, good)  # exact: passes
+    bad = [tuple(good[0]), (good[1][0] + 1, good[1][1], good[1][2])]
+    with pytest.raises(DegradedBackendMismatchError):
+        verify_rows_against_oracle(seq1, seqs, weights, bad)
+
+
+# -- e2e: the acceptance contract ------------------------------------------
+
+
+def test_batch_under_budget_faults_keep_goldens(capsys):
+    out, err = run_inproc(
+        "--input", fixture_path("tiny"),
+        "--retries", "2",
+        "--faults", "chunk_scoring:fail=2",
+        capsys=capsys,
+    )
+    assert out == golden("tiny")
+    assert err.count("retrying") == 2
+
+
+def test_batch_over_budget_faults_fail_stop(capsys):
+    out, err = run_inproc(
+        "--input", fixture_path("tiny"),
+        "--retries", "1",
+        "--faults", "chunk_scoring:fail=5",
+        capsys=capsys,
+        rc_want=1,
+    )
+    assert out == ""  # fail-stop: nothing on stdout
+    assert "retry budget exhausted" in err
+
+
+def test_stream_under_budget_faults_keep_goldens(capsys):
+    out, err = run_inproc(
+        "--input", fixture_path("stress_small"),
+        "--stream", "3",
+        "--retries", "2",
+        "--faults", "chunk_scoring:fail=2",
+        capsys=capsys,
+    )
+    assert out == golden("stress_small")
+    assert "retrying" in err
+
+
+def test_stream_over_budget_faults_fail_stop(capsys):
+    out, err = run_inproc(
+        "--input", fixture_path("stress_small"),
+        "--stream", "3",
+        "--faults", "chunk_scoring:fail=99",
+        capsys=capsys,
+        rc_want=1,
+    )
+    assert out == ""
+    assert "retry budget exhausted" in err
+
+
+def test_stream_chunk_budget_is_shared_across_stages(capsys):
+    # One dispatch fault + one materialise fault on the same chunk: with
+    # per-stage budgets --retries 1 would pass; the batch-parity contract
+    # (N retries per CHUNK) demands 2.
+    spec = "chunk_dispatch:fail=1;chunk_scoring:fail=1"
+    out, _ = run_inproc(
+        "--input", fixture_path("tiny"),
+        "--stream", "64",
+        "--retries", "2",
+        "--faults", spec,
+        capsys=capsys,
+    )
+    assert out == golden("tiny")
+    out, err = run_inproc(
+        "--input", fixture_path("tiny"),
+        "--stream", "64",
+        "--retries", "1",
+        "--faults", spec,
+        capsys=capsys,
+        rc_want=1,
+    )
+    assert out == "" and "retry budget exhausted" in err
+
+
+def test_stream_prefetch_fault_is_absorbed(capsys):
+    # The prefetched device->host copy is advisory: every prefetch may
+    # fail and the run must still produce the goldens with NO retries
+    # spent (the copy re-runs inside result()).
+    out, err = run_inproc(
+        "--input", fixture_path("stress_small"),
+        "--stream", "3",
+        "--faults", "device_transfer:fail=99",
+        capsys=capsys,
+    )
+    assert out == golden("stress_small")
+    assert "retrying" not in err
+
+
+def test_injected_fatal_fault_skips_retries(capsys):
+    out, err = run_inproc(
+        "--input", fixture_path("tiny"),
+        "--retries", "5",
+        "--faults", "chunk_scoring:fail=1,kind=fatal",
+        capsys=capsys,
+        rc_want=1,
+    )
+    assert out == ""
+    assert "injected fatal fault" in err
+    assert "retrying" not in err  # fatal: never retried
+
+
+def test_malformed_faults_spec_fails_fast(capsys):
+    _, err = run_inproc(
+        "--input", fixture_path("tiny"),
+        "--faults", "warp_core:fail=1",
+        capsys=capsys,
+        rc_want=1,
+    )
+    assert "error:" in err and "known sites" in err
+
+
+def test_env_spec_with_retry_floor(monkeypatch, capsys):
+    # SEQALIGN_FAULTS + SEQALIGN_FAULT_RETRIES: the chaos-suite contract —
+    # env-injected transients are absorbed by the floor even at --retries 0.
+    monkeypatch.setenv("SEQALIGN_FAULTS", "chunk_scoring:fail=2")
+    monkeypatch.setenv("SEQALIGN_FAULT_RETRIES", "3")
+    out, err = run_inproc(
+        "--input", fixture_path("tiny"), capsys=capsys
+    )
+    assert out == golden("tiny")
+    assert "retrying" in err
+
+
+def test_explicit_faults_override_env_without_floor(monkeypatch, capsys):
+    # An explicit --faults replaces the env spec entirely AND takes no
+    # retry floor: over-budget tests stay over-budget under `make chaos`.
+    monkeypatch.setenv("SEQALIGN_FAULTS", "chunk_scoring:fail=99")
+    monkeypatch.setenv("SEQALIGN_FAULT_RETRIES", "99")
+    out, err = run_inproc(
+        "--input", fixture_path("tiny"),
+        "--faults", "chunk_scoring:fail=1",
+        capsys=capsys,
+        rc_want=1,
+    )
+    assert out == "" and "retry budget exhausted" in err
+
+
+def test_faults_are_disarmed_after_the_run(capsys):
+    run_inproc(
+        "--input", fixture_path("tiny"),
+        "--retries", "1",
+        "--faults", "chunk_scoring:fail=1",
+        capsys=capsys,
+    )
+    # Library callers after a CLI run must see no ambient faults.
+    fire("chunk_scoring")
+
+
+# -- e2e: degradation chain ------------------------------------------------
+
+
+def test_degrade_xla_to_gather_completes_run(capsys):
+    out, err = run_inproc(
+        "--input", fixture_path("tiny"),
+        "--retries", "1",
+        "--faults", "chunk_scoring:fail=2",
+        "--degrade",
+        capsys=capsys,
+    )
+    assert out == golden("tiny")
+    assert "degrading to 'xla-gather'" in err
+
+
+def test_degrade_pallas_to_xla_completes_run(capsys):
+    # chunk_dispatch faults fire BEFORE any compilation, so a forced
+    # pallas->xla degradation runs on the CPU harness without ever paying
+    # an interpret-mode Pallas compile.
+    out, err = run_inproc(
+        "--input", fixture_path("tiny"),
+        "--backend", "pallas",
+        "--retries", "1",
+        "--faults", "chunk_dispatch:fail=2",
+        "--degrade",
+        capsys=capsys,
+    )
+    assert out == golden("tiny")
+    assert "backend 'pallas' exhausted its retry budget" in err
+    assert "degrading to 'xla'" in err
+
+
+def test_degrade_stream_mode_completes_run(capsys):
+    out, err = run_inproc(
+        "--input", fixture_path("stress_small"),
+        "--stream", "3",
+        "--retries", "1",
+        "--faults", "chunk_scoring:fail=2",
+        "--degrade",
+        capsys=capsys,
+    )
+    assert out == golden("stress_small")
+    assert "degrading to 'xla-gather'" in err
+
+
+def test_degrade_chain_exhaustion_fails_stop(capsys):
+    out, err = run_inproc(
+        "--input", fixture_path("tiny"),
+        "--faults", "chunk_scoring:fail=99",
+        "--degrade",
+        capsys=capsys,
+        rc_want=1,
+    )
+    assert out == ""
+    assert "degrading to 'xla-gather'" in err  # it DID try the chain
+    assert "retry budget exhausted" in err
+
+
+def test_degrade_rejected_under_distributed(capsys):
+    _, err = run_inproc(
+        "--degrade", "--distributed",
+        "--input", fixture_path("tiny"),
+        capsys=capsys,
+        rc_want=1,
+    )
+    assert "--distributed cannot be combined with --degrade" in err
+
+
+# -- e2e: journal composition ----------------------------------------------
+
+
+def test_stream_journal_mid_fault_then_resume(tmp_path, capsys):
+    # A run killed by over-budget faults mid-stream leaves a valid partial
+    # journal; the clean rerun resumes from it and reproduces the goldens
+    # with an exact 1 + N line journal (failed appends wrote nothing).
+    path = str(tmp_path / "j.jsonl")
+    out, err = run_inproc(
+        "--input", fixture_path("stress_small"),
+        "--stream", "3",
+        "--journal", path,
+        "--faults", "chunk_scoring:fail=99,after=2",
+        capsys=capsys,
+        rc_want=1,
+    )
+    assert out == "" and "retry budget exhausted" in err
+    with open(path) as f:
+        partial = f.read().splitlines()
+    assert len(partial) == 1 + 6  # header + the two pre-fault chunks of 3
+
+    out, _ = run_inproc(
+        "--input", fixture_path("stress_small"),
+        "--stream", "3",
+        "--journal", path,
+        capsys=capsys,
+    )
+    assert out == golden("stress_small")
+    with open(path) as f:
+        assert len(f.read().splitlines()) == 1 + 12
+
+
+def test_stream_journal_append_fault_retried_exactly(tmp_path, capsys):
+    # journal_append fires BEFORE the first byte: a retried append must
+    # leave no duplicate or torn records.
+    path = str(tmp_path / "j.jsonl")
+    out, err = run_inproc(
+        "--input", fixture_path("stress_small"),
+        "--stream", "3",
+        "--journal", path,
+        "--retries", "1",
+        "--faults", "journal_append:fail=1",
+        capsys=capsys,
+    )
+    assert out == golden("stress_small")
+    assert "journal append attempt 1 failed" in err
+    with open(path) as f:
+        assert len(f.read().splitlines()) == 1 + 12

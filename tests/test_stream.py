@@ -177,6 +177,7 @@ def test_stream_tiny_buffer_token_reassembly():
     assert toks == text.split()
 
 
+@pytest.mark.no_chaos  # the no-retries half asserts fail-stop at rc 1
 def test_stream_retries_transient_dispatch_failure(monkeypatch, capsys):
     # One injected transient failure at chunk dispatch: --retries 1 must
     # recover with byte-identical output; without retries it must fail
