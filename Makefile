@@ -18,7 +18,7 @@ PY_CFLAGS  := $(shell $(PYCONFIG) --includes)
 PY_LDFLAGS := $(shell $(PYCONFIG) --ldflags --embed)
 INPUT      ?= /root/reference/input5.txt
 
-.PHONY: build run run2 runOn2 test chaos bench bench-table check clean
+.PHONY: build run run2 runOn2 test chaos bench bench-table bench-gather check clean
 
 build: final
 
@@ -113,6 +113,14 @@ bench:
 # The full BASELINE.md config table (input2/3/5 + max-size synthetic).
 bench-table:
 	$(PYTHON) scripts/bench_table.py
+
+# The >=4096-weight regime's official-protocol row.  40000 > 32767 (the
+# length-aware f32 ceiling at l2p=128), so every bucket routes to the
+# int32 gather fallback — the record's "formulation" field must read
+# "xla-gather"; weights <= 32767 would be rescued into the exact f32
+# kernel on short-Seq2 buckets and silently time the wrong regime.
+bench-gather:
+	BENCH_BACKEND=pallas BENCH_WEIGHTS=40000,7,1,2 $(PYTHON) bench.py
 
 clean:
 	rm -f final

@@ -154,20 +154,29 @@ def production_schedule(problem, backend: str):
         choose_pallas_formulation,
         DEFAULT_CHUNK_BUDGET,
         effective_backend,
+        pack_classes,
         pad_batch_rows,
         pad_problem,
         plan_buckets,
         resolve_chunks_body,
         round_up,
     )
-    from mpi_openmp_cuda_tpu.ops.values import value_table
+    from mpi_openmp_cuda_tpu.ops.values import max_abs_value, value_table
 
     val = value_table(problem.weights).astype(np.int32).reshape(-1)
-    packable = backend == "pallas" and choose_pallas_formulation(val, ())[
-        :2
-    ] == ("pallas", "i8")
+    # Row packing only applies to 128-row buckets, so gate the packing
+    # sub-classes on the l2p=128 formulation (mirrors score_codes_async).
+    packable = False
+    classes: tuple = ()
+    if backend == "pallas":
+        fm = choose_pallas_formulation(val, (), 128)
+        if fm[0] == "pallas":
+            classes = pack_classes(fm[1], max_abs_value(val))
+            packable = bool(classes)
     groups = plan_buckets(
-        [c.size for c in problem.seq2_codes], packable=packable
+        [c.size for c in problem.seq2_codes],
+        packable=packable,
+        classes=classes or (8, 16, 32, 64),
     )
     sched = []
     for key in sorted(groups):
@@ -177,7 +186,9 @@ def production_schedule(problem, backend: str):
         # chunks only when the kernel actually runs (wide weights route
         # to gather).
         cb = choose_chunk(
-            batch, DEFAULT_CHUNK_BUDGET, backend=effective_backend(backend, val)
+            batch,
+            DEFAULT_CHUNK_BUDGET,
+            backend=effective_backend(backend, val, batch.l2p),
         )
         bp = round_up(batch.batch_size, cb)
         rows, lens = pad_batch_rows(batch, bp)
@@ -242,7 +253,7 @@ def kernel_floor_counts(problem, backend: str, buckets: bool = True):
         batch = pad_problem(problem.seq1_codes, problem.seq2_codes)
         cb = choose_chunk(
             batch, DEFAULT_CHUNK_BUDGET,
-            backend=effective_backend(backend, val_flat),
+            backend=effective_backend(backend, val_flat, batch.l2p),
         )
         bp = round_up(batch.batch_size, cb)
         _, lens = pad_batch_rows(batch, bp)
@@ -251,15 +262,19 @@ def kernel_floor_counts(problem, backend: str, buckets: bool = True):
     flops = 0
     vpu_elems = 0
     feed = None
+    from mpi_openmp_cuda_tpu.ops.values import max_abs_value
+
     for sub, lens_chunks in parts:
-        fm = choose_pallas_formulation(val_flat, (sub.l1p, sub.l2p))
+        fm = choose_pallas_formulation(val_flat, (sub.l1p, sub.l2p), sub.l2p)
         if fm[0] != "pallas":
             return flops, vpu_elems, None
         feed = fm[1]
         sb = choose_superblock(
             sub.l1p // 128, sub.l2p // 128, sub.len1, sub.len2, feed
         )
-        l2s = choose_rowpack(feed, sub.l2p, sub.len2)
+        l2s = choose_rowpack(
+            feed, sub.l2p, sub.len2, maxv=max_abs_value(val_flat)
+        )
         for chunk_lens in lens_chunks:
             flops += kernel_mxu_flops(
                 sub.len1, chunk_lens, sub.l1p, sub.l2p, feed, sb=sb, l2s=l2s
@@ -850,6 +865,16 @@ def main() -> None:
         problem.seq1_codes.size, [c.size for c in problem.seq2_codes]
     )
     value = elements / wall / n_chips
+    # Resolved formulation for the whole-batch padding — makes a gather-
+    # regime row (BENCH_WEIGHTS past the length-aware exact bound, e.g.
+    # `make bench-gather`) self-describing: the reader sees "xla-gather"
+    # on the row instead of inferring it from the weights.
+    from mpi_openmp_cuda_tpu.ops.dispatch import effective_backend, pad_problem
+    from mpi_openmp_cuda_tpu.ops.values import value_table
+
+    _batch = pad_problem(problem.seq1_codes, problem.seq2_codes)
+    _val = value_table(problem.weights).reshape(-1)
+    formulation = effective_backend(backend, _val, _batch.l2p)
     # The JSON record is printed AFTER the MFU accounting below so the MFU
     # fields can join it; stdout stays exactly one line either way.
     record = {
@@ -863,6 +888,7 @@ def main() -> None:
         # BASELINE.md's cold/warm table.
         "e2e_first_run_s": round(compile_and_run, 2),
         "e2e_warm_s": round(e2e_wall, 4),
+        "formulation": formulation,
     }
     # The probe context bracketing the recorded measurement, IN the record
     # (VERDICT r2: a degraded-probe run must be recognisable from the JSON

@@ -177,42 +177,51 @@ def resolve_auto_backend() -> str:
     return "xla"
 
 
-def mm_formulation_exact(val_flat: np.ndarray) -> bool:
+def mm_formulation_exact(val_flat: np.ndarray, l2p: int | None = None) -> bool:
     """True when every partial sum stays an exact float32 integer on the
-    matmul path (|score| <= BUF_SIZE_SEQ2 * max|value| < 2^24)."""
-    from .matmul_scorer import MAX_EXACT_WEIGHT
+    matmul path.  Length-aware (r6): with a concrete batch ``l2p`` the
+    bound is ``2 * l2p * max|value| < 2^24`` (operand-capped at 32767 —
+    see matmul_scorer.max_exact_value), so short-Seq2 buckets keep the
+    exact path far past the static 4095 ceiling; ``l2p=None`` is the
+    conservative whole-buffer bound."""
+    from .matmul_scorer import max_exact_value
     from .values import max_abs_value
 
-    return max_abs_value(val_flat) <= MAX_EXACT_WEIGHT
+    return max_abs_value(val_flat) <= max_exact_value(l2p)
 
 
-def choose_pallas_formulation(val_flat: np.ndarray, dims: tuple[int, ...]) -> tuple:
+def choose_pallas_formulation(
+    val_flat: np.ndarray, dims: tuple[int, ...], l2p: int | None = None
+) -> tuple:
     """The single source of the fused-kernel eligibility policy, shared by
     the batch-sharded and ring paths: ('pallas', feed) — feed being the
     fastest exact MXU operand type ('i8'/'bf16'/'f32') — when float32 math
-    is exact for these weights and every dimension in ``dims`` is
-    128-aligned; ('gather',) otherwise.  Raises the friendly RuntimeError
-    when the pallas module itself is unavailable."""
+    is exact for these weights at this Seq2 bucket width (``l2p=None`` =
+    static worst case) and every dimension in ``dims`` is 128-aligned;
+    ('gather',) otherwise.  Raises the friendly RuntimeError when the
+    pallas module itself is unavailable."""
     try:
         from .pallas_scorer import mxu_feed
     except ModuleNotFoundError as e:
         raise RuntimeError("backend 'pallas' is not available in this build") from e
-    if mm_formulation_exact(val_flat) and all(d % 128 == 0 for d in dims):
+    if mm_formulation_exact(val_flat, l2p) and all(d % 128 == 0 for d in dims):
         return ("pallas", mxu_feed(val_flat))
     return ("gather",)
 
 
-def xla_formulation_mode(backend: str, val_flat: np.ndarray) -> str:
+def xla_formulation_mode(
+    backend: str, val_flat: np.ndarray, l2p: int | None = None
+) -> str:
     """'mm' or 'gather' for an 'xla*' backend string — the single source of
     truth for the formulation choice, shared by the local and sharded paths."""
-    if backend == "xla" and mm_formulation_exact(val_flat):
+    if backend == "xla" and mm_formulation_exact(val_flat, l2p):
         return "mm"
     return "gather"
 
 
-def resolve_xla_formulation(backend: str, val_flat: np.ndarray):
+def resolve_xla_formulation(backend: str, val_flat: np.ndarray, l2p: int | None = None):
     """Pick the jitted chunked scorer for an 'xla*' backend string."""
-    if xla_formulation_mode(backend, val_flat) == "mm":
+    if xla_formulation_mode(backend, val_flat, l2p) == "mm":
         from .matmul_scorer import mm_precision, score_chunks_mm
 
         return functools.partial(
@@ -223,29 +232,57 @@ def resolve_xla_formulation(backend: str, val_flat: np.ndarray):
     return score_chunks
 
 
-def effective_backend(backend: str, val_flat: np.ndarray) -> str:
+def effective_backend(backend: str, val_flat: np.ndarray, l2p: int | None = None) -> str:
     """The formulation a backend string actually runs: 'pallas' only when
-    the fused kernel is eligible for these weights; its overflow-risk
-    fallback reports 'xla-gather'.  Single source for consumers that must
-    match the dispatch routing (bench's chunk policy)."""
-    if backend == "pallas" and choose_pallas_formulation(val_flat, ())[0] != "pallas":
+    the fused kernel is eligible for these weights (at this Seq2 bucket
+    width, when known); its overflow-risk fallback reports 'xla-gather'.
+    Single source for consumers that must match the dispatch routing
+    (bench's chunk policy)."""
+    if (
+        backend == "pallas"
+        and choose_pallas_formulation(val_flat, (), l2p)[0] != "pallas"
+    ):
         return "xla-gather"
     return backend
 
 
+def pack_classes(feed: str, maxv: int | None = None) -> tuple[int, ...]:
+    """Row-packing classes legal for one MXU feed (r6: packing covers all
+    three feeds, bounded by the packed kernel's int32 epilogue).
+
+    The packed epilogue packs ``(t1 + gdec) * 2^klb + key`` into int32
+    with ``klb <= 12`` at the ``sb <= 24`` bound, so the packed score
+    magnitude ``3 * l2s * max|v|`` must stay < 2^19.  i8 (|v| <= 127)
+    passes at every class by construction; bf16 (|v| <= 128) likewise
+    (3*64*128 < 2^19); the f32 feed keeps the classes its actual weight
+    magnitude affords — {8, 16, 32} at the static 4095 ceiling, shrinking
+    to none near the 32767 operand cap.  ``maxv=None`` is conservative
+    for non-i8 feeds (unknown weights -> no packing)."""
+    if feed == "i8":
+        return (8, 16, 32, 64)
+    if feed in ("bf16", "f32") and maxv is not None:
+        return tuple(s for s in (8, 16, 32, 64) if 3 * s * int(maxv) < 2**19)
+    return ()
+
+
 def plan_buckets(
-    sizes, *, packable: bool, min_rows: int = MIN_BUCKET_ROWS
+    sizes,
+    *,
+    packable: bool,
+    min_rows: int = MIN_BUCKET_ROWS,
+    classes: tuple[int, ...] = (8, 16, 32, 64),
 ) -> dict[int, list[int]]:
     """The length-bucketing schedule: input indices grouped by L2P shape
-    bucket (plus, when ``packable``, the sub-128 row-packing classes),
-    with straggler groups merged into the next wider one.  Shared by
-    ``score_codes_async`` and the bench's steady-state harness so the
-    bench times exactly the production dispatch schedule."""
+    bucket (plus, when ``packable``, the sub-128 row-packing ``classes``
+    from :func:`pack_classes`), with straggler groups merged into the next
+    wider one.  Shared by ``score_codes_async`` and the bench's
+    steady-state harness so the bench times exactly the production
+    dispatch schedule."""
 
     def bucket_key(size: int) -> int:
         l2p = round_up(max(size, 1), _LANE)
-        if packable and l2p == _LANE and size <= 64:
-            return next(s for s in (8, 16, 32, 64) if s >= size)
+        if packable and l2p == _LANE and classes and size <= classes[-1]:
+            return next(s for s in classes if s >= size)
         return l2p
 
     groups: dict[int, list[int]] = {}
@@ -258,21 +295,25 @@ def plan_buckets(
     return groups
 
 
-def choose_rowpack(feed: str, l2p: int, lens) -> int | None:
-    """Row-packing decision (VERDICT r3 item 3), shared by the local
-    dispatch and the bench body resolver so the bench times the same
-    program the scorer runs: pack p = 128/l2s pairs per tile when the
-    bucket is a single char-block (L2P == 128), the feed is the packed
-    integer i8 pipeline, there are >= 2 rows to share a tile, and every
-    live row fits a 64-row sub-tile."""
+def choose_rowpack(feed: str, l2p: int, lens, maxv: int | None = None) -> int | None:
+    """Row-packing decision (VERDICT r3 item 3; widened to the bf16/f32
+    feeds in r6), shared by the local dispatch and the bench body resolver
+    so the bench times the same program the scorer runs: pack p = 128/l2s
+    pairs per tile when the bucket is a single char-block (L2P == 128),
+    there are >= 2 rows to share a tile, every live row fits the widest
+    legal sub-tile class for this feed, and — for the non-i8 feeds — the
+    concrete weight magnitude ``maxv`` keeps the packed int32 epilogue
+    exact (see :func:`pack_classes`; ``maxv=None`` disables non-i8
+    packing, the pre-r6 behaviour)."""
     lens = [int(x) for x in lens]
     live = [x for x in lens if x > 0]
-    if feed != "i8" or l2p != _LANE or len(lens) < 2 or not live:
+    classes = pack_classes(feed, maxv)
+    if not classes or l2p != _LANE or len(lens) < 2 or not live:
         return None
     m = max(live)
-    if m > 64:
+    if m > classes[-1]:
         return None
-    return next(s for s in (8, 16, 32, 64) if s >= m)
+    return next(s for s in classes if s >= m)
 
 
 def resolve_chunks_body(backend: str, val_flat: np.ndarray, problem_dims=None):
@@ -284,11 +325,16 @@ def resolve_chunks_body(backend: str, val_flat: np.ndarray, problem_dims=None):
     ``problem_dims`` = (l1p, l2p, len1, lens) with CONCRETE lens selects
     the adaptive super-block width exactly like the production dispatch,
     so bench measurements time the same program the scorer would run.
+    The concrete l2p also engages the length-aware exactness bound — a
+    short-Seq2 bench problem routes exactly like the scorer would route
+    it, not like the static worst case.
     """
-    backend = effective_backend(backend, val_flat)
+    dims_l2p = problem_dims[1] if problem_dims is not None else None
+    backend = effective_backend(backend, val_flat, dims_l2p)
     if backend == "pallas":
-        fm = choose_pallas_formulation(val_flat, ())
+        fm = choose_pallas_formulation(val_flat, (), dims_l2p)
         from .pallas_scorer import choose_superblock, score_chunks_pallas_body
+        from .values import max_abs_value
 
         sb = None
         l2s = None
@@ -298,11 +344,13 @@ def resolve_chunks_body(backend: str, val_flat: np.ndarray, problem_dims=None):
                 l1p // 128, l2p // 128, int(len1), lens, fm[1]
             )
             if fm[0] == "pallas":
-                l2s = choose_rowpack(fm[1], l2p, lens)
+                l2s = choose_rowpack(
+                    fm[1], l2p, lens, maxv=max_abs_value(val_flat)
+                )
         return functools.partial(
             score_chunks_pallas_body, feed=fm[1], sb=sb, l2s=l2s
         )
-    if xla_formulation_mode(backend, val_flat) == "mm":
+    if xla_formulation_mode(backend, val_flat, dims_l2p) == "mm":
         from .matmul_scorer import mm_precision, score_chunks_mm_body
 
         return functools.partial(
@@ -544,17 +592,25 @@ class AlignmentScorer:
             # count, so the threshold scales with it); _score_local
             # re-derives the packed decision from the sub-batch's own
             # len2 max.
-            packable = (
-                self.sharding is None
-                and self.backend == "pallas"
-                and choose_pallas_formulation(val_flat, ())[:2]
-                == ("pallas", "i8")
-            )
+            # r6: packing covers every feed whose weights keep the packed
+            # int32 epilogue exact (pack_classes); the eligibility check
+            # runs at the packing bucket width (L2P == 128), where the
+            # length-aware exactness bound is widest.
+            packable = False
+            classes: tuple[int, ...] = ()
+            if self.sharding is None and self.backend == "pallas":
+                from .values import max_abs_value
+
+                fm = choose_pallas_formulation(val_flat, (), _LANE)
+                if fm[0] == "pallas":
+                    classes = pack_classes(fm[1], max_abs_value(val_flat))
+                    packable = bool(classes)
             groups = plan_buckets(
                 [c.size for c in seq2_codes],
                 packable=packable,
                 min_rows=MIN_BUCKET_ROWS
                 * (1 if self.sharding is None else self.sharding.n_devices),
+                classes=classes or (8, 16, 32, 64),
             )
             if len(groups) > 1:
                 parts = []
@@ -597,7 +653,10 @@ class AlignmentScorer:
             # Same eligibility policy as the sharded paths; the chunked
             # [NC, CB] shape buckets match the bench/sharded programs, so
             # batch sizes within one bucket share a single compilation.
-            fm = choose_pallas_formulation(val_flat, ())
+            # The bucket's own l2p engages the length-aware bound, so a
+            # short-Seq2 bucket keeps the exact kernel for weights past
+            # the static 4095 ceiling.
+            fm = choose_pallas_formulation(val_flat, (), batch.l2p)
         cb = choose_chunk(
             batch,
             self.chunk_budget,
@@ -628,14 +687,18 @@ class AlignmentScorer:
                 # tiles p = 128/l2s pairs at a time.  ONE policy source
                 # (choose_rowpack) shared with the bench resolver, or
                 # the bench would time a different program.
-                l2s = choose_rowpack(fm[1], batch.l2p, batch.len2)
+                from .values import max_abs_value
+
+                l2s = choose_rowpack(
+                    fm[1], batch.l2p, batch.len2, maxv=max_abs_value(val_flat)
+                )
                 out = score_chunks_pallas(*args, feed=fm[1], sb=sb, l2s=l2s)
             else:
                 from .xla_scorer import score_chunks
 
                 out = score_chunks(*args)
         else:
-            out = resolve_xla_formulation(self.backend, val_flat)(*args)
+            out = resolve_xla_formulation(self.backend, val_flat, batch.l2p)(*args)
         return PendingResult(out, b)
 
     # -- text-level API ----------------------------------------------------

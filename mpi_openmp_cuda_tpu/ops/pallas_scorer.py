@@ -45,10 +45,12 @@ Tie-break parity with the reference's offset-major, k-ascending-with-0-first
 order (cudaFunctions.cu:161) is preserved: strictly-greater running updates
 keep the smallest kappa, first-hit row selection uses a min-index reduction,
 and k=0 (kappa = len2) outranks equal-scoring k >= 1 via the G[len2]
-capture.  Float32 math is exact for |weight| <= 4095 (same bound as the
-matmul path; f32-feed matmuls run Precision.HIGHEST because TPU MXUs
-multiply f32 at bf16 precision by default — see ops/matmul_scorer.py);
-the module transparently falls back to the XLA bodies for larger weights
+capture.  Float32 math is exact for |weight| <= max_exact_value(l2p) —
+the length-aware bound shared with the matmul path (4095 for the padded
+2048-row buckets, up to 32767 at l2p = 128; f32-feed matmuls run
+Precision.HIGHEST because TPU MXUs multiply f32 at bf16 precision by
+default — see ops/matmul_scorer.py); the module transparently falls
+back to the XLA bodies for larger weights
 or for shape buckets that are not 128-aligned (e.g. the tiny-shape
 multi-chip dryrun).
 
@@ -124,9 +126,20 @@ kappa) cell is exact.  The per-lane argmax packs an offset-ORDER key
 first-hit tie-break.  input4: 40-56 us gated across records vs r3's
 75.1 us (+34-87% throughput; dispatch-floor noise dominates the spread
 at this size); packable-subset interleaved A/B reads packed 1.8-3.2x
-unpacked.  i8 feed only; dispatch buckets rows into packing classes
-{8, 16, 32, 64} so a long straggler splits off instead of blocking the
-batch (ops/dispatch.py::plan_buckets / choose_rowpack).
+unpacked.  Dispatch buckets rows into packing classes so a long
+straggler splits off instead of blocking the batch
+(ops/dispatch.py::plan_buckets / choose_rowpack).
+
+Extended r6: row packing serves EVERY feed, not just i8 — the packed
+matmuls run in the feed dtype and the prefix result is cast to int32
+before the integer argmax-key packing, which stays exact while
+3 * l2s * maxv < 2^19 (``dispatch.pack_classes``): i8 and bf16 keep
+all four classes {8, 16, 32, 64}; f32 keeps the classes its measured
+maxv affords (all four to |v| <= 2730, {8, 16, 32} through the static
+4095 bound, {8, 16} to 10922, {8} to 21845).  Gated A/B on a
+64-pair len2 <= 8 batch at |v| = 3000: packed f32 2.1x unpacked f32
+(the same structural win as i8's 1.8-3.2x, minus the HIGHEST matmul
+multiplier that both arms pay).
 """
 
 from __future__ import annotations
@@ -156,12 +169,21 @@ MAX_I8_EXACT_WEIGHT = 127
 
 _FEED_DTYPES = {"i8": jnp.int8, "bf16": jnp.bfloat16, "f32": jnp.float32}
 
+# Offline A/B hook (scripts/f32_bench.py F32_AB=wide): force the
+# pre-r6 1-wide f32 walk.  NOT a production knob — the jit/pallas_call
+# caches key on static args only, so flipping it requires
+# _pallas_call.cache_clear() + a fresh jit trace, which the bench script
+# does between arms.
+_F32_WIDE1_AB = False
+
 
 def mxu_feed(val_flat) -> str:
     """Fastest exact MXU operand type for this value table: 'i8' (int8
     operands, int32 accumulation) when |v| <= 127, 'bf16' (bf16 operands,
     f32 accumulation) at exactly 128, 'f32' otherwise (up to the matmul
-    path's 4095 bound; beyond that dispatch routes to the gather body)."""
+    path's length-aware ``max_exact_value(l2p)`` bound — 4095 for padded
+    2048-row buckets, up to 32767 at l2p = 128; beyond that dispatch
+    routes to the gather body)."""
     from .values import max_abs_value
 
     m = max_abs_value(val_flat)
@@ -202,22 +224,46 @@ _ITER_FLOOR_BASE_S = 0.70e-6
 _ITER_FLOOR_PER_SB_S = 0.040e-6
 _MAC_RATE = 112e12  # MACs/s, mixed one-hot i8 + int8 prefix stages
 
+# bf16-feed constants (r6: scripts/sb_refit.py SB_FEED=bf16, interleaved
+# sweeps at |w| = 128 over the same five workload classes).  The pre-r6
+# chooser ALIASED the i8 constants on argument alone; the gated refit
+# confirms the structural claim behind the alias at the WINNER level —
+# but not at the constant level: the honest bf16 MXU rate is ~half the
+# int8 rate, and the per-sb floor slope fits ~3x the i8 slope (the
+# f32->bf16 narrowing casts on the shear operand scale with the band
+# width, where i8 narrows once into the one-hot).  Log-err 0.031; every
+# winner matches the i8 chooser's pick on the swept grids, so the alias
+# was RIGHT, and is now measured rather than asserted.
+_ITER_FLOOR_BASE_BF16_S = 0.75e-6
+_ITER_FLOOR_PER_SB_BF16_S = 0.13e-6
+_MAC_RATE_BF16 = 58e12
+
 # f32-feed constants (r5: scripts/f32_bench.py, probe-gated interleaved
 # sb sweeps over three workload classes on the real chip — VERDICT r4
 # item 4; the old chooser PUNTED to the static policy for f32, which a
-# skew-class sweep measured at 2.63x over the per-batch best).  Grid fit
-# with a per-class call-overhead nuisance under the f32 WALK (wide1=True
-# — the f32 kernel has no 2-wide interleave, so the model prices every
-# tile's iteration individually), log-err 0.041 (the i8 refit's was
-# 0.025): the f32 kernel pays ~5.6x the i8 per-tile MAC time and a much
-# heavier iteration floor (f32 one-hot + f32 prefix surfaces).  The fit
-# reproduces the measured winners on max-size (sb=12) and skew (sb=2)
-# exactly and lands within 9% of best on input3-class (picks sb=3 at
-# 543.7 us vs best sb=6 at 497.8 us — inside the same <=10% wall-tie
-# band the i8 refit accepted).
-_ITER_FLOOR_BASE_F32_S = 1.00e-6
-_ITER_FLOOR_PER_SB_F32_S = 0.32e-6
-_MAC_RATE_F32 = 20e12
+# skew-class sweep measured at 2.63x over the per-batch best.  REFIT r6
+# under the 2-wide walk after the f32 interleave landed — the r5 fit
+# priced the old wide1 walk, and the model must match the walk it
+# prices).  Grid fit with a per-class call-overhead nuisance, log-err
+# 0.038 (r5's wide1 fit was 0.041): the f32 kernel still pays ~4x the
+# i8 per-tile MAC time and a much heavier iteration floor (f32 one-hot
+# + f32 prefix surfaces), but the 2-wide interleave hides more of the
+# per-iteration floor under the slow f32 MACs, which the refit absorbs
+# as a higher effective MAC rate with a steeper per-sb floor slope
+# (the f32 rotate/select surfaces DON'T pipeline, and double at 2-wide).
+# The fit reproduces the measured winners on max-size (sb=12) and skew
+# (sb=2) exactly and keeps the input3-class pick inside the measured
+# 3..6 shallow bowl (<=10% wall ties; fitted pick sb=6).
+_ITER_FLOOR_BASE_F32_S = 0.90e-6
+_ITER_FLOOR_PER_SB_F32_S = 0.40e-6
+_MAC_RATE_F32 = 28e12
+
+# Per-feed (base, per_sb, rate) for the chooser; see the blocks above.
+_SB_CONSTANTS = {
+    "i8": (_ITER_FLOOR_BASE_S, _ITER_FLOOR_PER_SB_S, _MAC_RATE),
+    "bf16": (_ITER_FLOOR_BASE_BF16_S, _ITER_FLOOR_PER_SB_BF16_S, _MAC_RATE_BF16),
+    "f32": (_ITER_FLOOR_BASE_F32_S, _ITER_FLOOR_PER_SB_F32_S, _MAC_RATE_F32),
+}
 
 
 def _live_superblocks(nbn: int, sb: int, len1: int, l2: int) -> int:
@@ -244,8 +290,9 @@ def choose_superblock(nbn: int, nbi: int, len1: int, lens, feed: str) -> int:
     Narrow super-blocks skip dead blocks per pair but pay the iteration
     floor more often.  Minimise the measured cost model over nbn's
     divisors; concrete ``lens`` required (dispatch-time decision)."""
-    # bf16 shares the i8 constants (same int-side VPU surfaces, MAC time
-    # still floor-dominated at these widths); f32 has its own r5-fit set.
+    # Per-feed constant sets (_SB_CONSTANTS): i8's r4 refit, bf16's r6
+    # refit (confirming — with numbers — the structural claim behind the
+    # old i8 alias), f32's r6 refit under the 2-wide walk.
     # Bounded cache key (ADVICE r3): the cost model consumes lens only
     # through ceil(l2/128) (live char-blocks) and len1 - l2 at sb*128
     # granularity (live super-blocks), so a histogram of lens rounded UP
@@ -261,7 +308,7 @@ def choose_superblock(nbn: int, nbi: int, len1: int, lens, feed: str) -> int:
         l2r = -(-l2 // _BLK) * _BLK
         hist[l2r] = hist.get(l2r, 0) + 1
     return _choose_superblock_cached(
-        nbn, nbi, len1, tuple(sorted(hist.items())), feed == "f32"
+        nbn, nbi, len1, tuple(sorted(hist.items())), feed
     )
 
 
@@ -293,11 +340,13 @@ def superblock_model_cost(
     floor = base + sb * per_sb
     t_iter2 = max(floor, 2 * tile_macs / rate)
     t_iter1 = max(floor, tile_macs / rate)
-    # Mirrors the kernel's r3 walk: 2-wide even part + a 1-wide tail for
+    # Mirrors the kernel's walk: 2-wide even part + a 1-wide tail for
     # odd tile counts; wide=1 throughout for single-char-block buckets
-    # AND for the f32 feed (`wide1` — the kernel's own gate is
-    # `feed == "f32" or nbi == 1`, and the model must match the walk it
-    # prices or the next refit silently fits the wrong structure).
+    # only (the kernel's r6 gate is `nbi == 1` — every feed interleaves
+    # now).  ``wide1`` remains for pricing the pre-r6 f32 walk in A/B
+    # tooling (scripts/f32_bench.py); the shipped chooser never sets it.
+    # The model must match the walk it prices or the next refit silently
+    # fits the wrong structure.
     wide = 1 if wide1 or nbi == 1 else 2
     cost = 0.0
     for l2, count in lens_hist:
@@ -312,18 +361,10 @@ def superblock_model_cost(
 
 @functools.lru_cache(maxsize=256)
 def _choose_superblock_cached(
-    nbn: int, nbi: int, len1: int, lens_hist: tuple, f32: bool = False
+    nbn: int, nbi: int, len1: int, lens_hist: tuple, feed: str = "i8"
 ) -> int:
-    kw = (
-        dict(
-            base=_ITER_FLOOR_BASE_F32_S,
-            per_sb=_ITER_FLOOR_PER_SB_F32_S,
-            rate=_MAC_RATE_F32,
-            wide1=True,
-        )
-        if f32
-        else {}
-    )
+    base, per_sb, rate = _SB_CONSTANTS[feed]
+    kw = dict(base=base, per_sb=per_sb, rate=rate)
     best_sb, best_cost = None, None
     # Every divisor of nbn in [2, 24], widest first (ties go wide).  The
     # r3 bound extension 16 -> 24 lets tiny-Seq2 batches against the
@@ -440,7 +481,10 @@ def kernel_vpu_pass_elems(
         per_tile = {
             # the shear + the cyclic rollP lane shift
             "rotate": 2 * W * _BLK,
-            "cast": W * _BLK,
+            # i8: int32->int8 vb narrowing; bf16: f32->bf16 narrowing
+            # PLUS the f32->int32 prefix cast; f32: prefix cast only
+            # (vb re-cast is a no-op).
+            "cast": (2 if feed == "bf16" else 1) * W * _BLK,
             # one-hot build + g subtract + gpack + segmented row-max
             # + p thin per-segment epilogues
             "fma": 2 * _BLK * _BLK + 3 * W * _BLK + 10 * p * W,
@@ -526,12 +570,18 @@ def _pair(
     # then all prefix matmuls, then the reductions) lets the hardware
     # overlap MXU matmuls with VPU rotates/reductions — the stages are
     # cost-ADDITIVE in the 1-wide loop (measured by scripts/kernel_ablate:
-    # pair2 ~10% faster; 4-wide regresses on VMEM pressure).  The f32
-    # feed keeps the 1-wide loop (double-width f32 tiles spill), and so
-    # does nbi == 1 (tiny-Seq2 buckets): there the second tile is ALWAYS
-    # the zeroed overhang, so wide=2 doubles every stage for nothing —
-    # interleaved A/B on input4 (sb=24): wide=1 +33% median.
-    wide = 1 if feed == "f32" or nbi == 1 else 2
+    # pair2 ~10% faster; 4-wide regresses on VMEM pressure).  r6: the f32
+    # feed now takes the 2-wide walk too — the old "double-width f32
+    # tiles spill" parenthetical was an unmeasured assumption, and the
+    # gated interleaved A/B (scripts/f32_bench.py F32_AB=wide) reads
+    # 2-wide at +9.8% (input3-class), +6.4% (max-size, sb=12) and +4.1%
+    # (skew, sb=2) with NO spill through sb=12 (two [128, 1664] f32
+    # accumulators are ~1.7 MiB — well under the per-core VMEM budget;
+    # 4-wide f32 does exceed it at sb >= 8 and stays rejected).  Only
+    # nbi == 1 (tiny-Seq2 buckets) keeps wide=1: there the second tile
+    # is ALWAYS the zeroed overhang, so wide=2 doubles every stage for
+    # nothing — interleaved A/B on input4 (sb=24): wide=1 +33% median.
+    wide = 1 if nbi == 1 or (feed == "f32" and _F32_WIDE1_AB) else 2
     # The carryfold stage-4 form only lowers at wide=2: at wide=1 Mosaic
     # hits "Not implemented: Sublane broadcast" in the folded reduction
     # (same limitation as the f32 branch), so wide=1 keeps the pre-fold
@@ -546,8 +596,10 @@ def _pair(
             carry, runmax, runkap, t1 = car
             acc_t = jnp.int32 if feed == "i8" else jnp.float32
             # TPU MXUs multiply f32 at bf16 precision by default; the f32
-            # feed (128 < |v| <= 4095) needs multi-pass HIGHEST to stay
-            # exact (one operand is 0/1, values fit 16 mantissa bits).
+            # feed (128 < |v| <= max_exact_value(l2p) <= 32767) needs
+            # multi-pass HIGHEST to stay exact (one operand is 0/1,
+            # values fit 16 mantissa bits: 2*maxv <= 2^16 - 1 by the
+            # HIGHEST-operand half of the bound).
             # The i8/bf16 feeds are exact natively.
             prec = lax.Precision.HIGHEST if feed == "f32" else None
 
@@ -633,7 +685,7 @@ def _pair(
                             ltri,
                             dd,
                             preferred_element_type=jnp.float32,
-                            # |dd| <= 8190 > 2^8
+                            # |dd| <= 2*maxv <= 2^16 - 1 > bf16-exact
                             precision=lax.Precision.HIGHEST,
                         )
                     )
@@ -999,7 +1051,9 @@ def _pallas_best(seq1ext, len1, rows, lens, val_flat, feed="f32", sb=None):
     )
 
 
-def _kernel_packed(meta_ref, codes_ref, a_ref, out_ref, *, nbn, pretiled, sb, l2s):
+def _kernel_packed(
+    meta_ref, codes_ref, a_ref, out_ref, *, nbn, pretiled, sb, l2s, feed
+):
     """Row-packed grid cell: p = 128/l2s pairs share ONE [128, W] tile
     (VERDICT r3 item 3 — tiny-Seq2 batches wasted rows 82..127 of every
     tile; the full-width stage passes now amortise over p pairs).
@@ -1019,8 +1073,15 @@ def _kernel_packed(meta_ref, codes_ref, a_ref, out_ref, *, nbn, pretiled, sb, l2
     are cyclically permuted, so the lane index no longer orders offsets
     and the first-hit tie-break would break without it.
 
-    i8-feed only (gated at dispatch): values |v| <= 127, scores
-    |g| <= l2s*127 <= 8128, packs < 2^26 — every packing exact."""
+    All three feeds pack (r6; dispatch-gated by ``pack_classes``): the
+    matmuls run in the feed dtype (f32 accumulate; HIGHEST for the f32
+    feed, whose operands exceed bf16 exactness), and the prefix result
+    is cast to int32 BEFORE the pack arithmetic, so the argmax-key
+    packing is integer-exact whenever ``3 * l2s * maxv < 2**19``:
+    |g| <= l2s*maxv and |sv| <= 2*l2s*maxv, and with klb <= 12 (sb <=
+    24) and the kappa base _KB = 2^12 both ``gpack`` and ``spack`` stay
+    inside int32.  i8 (maxv <= 127) passes every class by construction;
+    bf16 (maxv <= 128) likewise; f32 classes shrink as maxv grows."""
     p = _BLK // l2s
     sbw = sb * _BLK
     W = sbw + _BLK
@@ -1037,11 +1098,14 @@ def _kernel_packed(meta_ref, codes_ref, a_ref, out_ref, *, nbn, pretiled, sb, l2
         jnp.minimum, [jnp.where(x > 0, x, big) for x in l2]
     )
 
+    feed_t = _FEED_DTYPES[feed]
+    acc_t = jnp.int32 if feed == "i8" else jnp.float32
+    prec = lax.Precision.HIGHEST if feed == "f32" else None
     ri1 = lax.broadcasted_iota(jnp.int32, (_BLK, _BLK), 0)
     ci1 = lax.broadcasted_iota(jnp.int32, (_BLK, _BLK), 1)
     liw = lax.broadcasted_iota(jnp.int32, (1, W), 1)
     # Block-diagonal ltri: prefix sums stay segment-local.
-    ltri_bd = ((ri1 >= ci1) & (ri1 // l2s == ci1 // l2s)).astype(jnp.int8)
+    ltri_bd = ((ri1 >= ci1) & (ri1 // l2s == ci1 // l2s)).astype(feed_t)
     # kappa bits use the row index WITHIN the segment.
     rloc = lax.broadcasted_iota(jnp.int32, (_BLK, W), 0) & (l2s - 1)
     ohb = codes_ref[0, 0, :, :] == ci1
@@ -1062,11 +1126,20 @@ def _kernel_packed(meta_ref, codes_ref, a_ref, out_ref, *, nbn, pretiled, sb, l2
                 astart = pl.multiple_of(a_ref.shape[1] - n0 - W, _BLK)
                 aband = a_ref[:, pl.ds(astart, W)]
             vp = jnp.dot(
-                ohb.astype(jnp.int8), aband, preferred_element_type=jnp.int32
+                ohb.astype(feed_t),
+                aband,
+                preferred_element_type=acc_t,
+                precision=prec,
             )
             vp2 = pltpu.roll(vp, shift=0, axis=1, stride=1, stride_axis=0)
-            vb = vp2.astype(jnp.int8)
-            P = jnp.dot(ltri_bd, vb, preferred_element_type=jnp.int32)
+            vb = vp2.astype(feed_t)
+            P = jnp.dot(
+                ltri_bd, vb, preferred_element_type=acc_t, precision=prec
+            )
+            if feed != "i8":
+                # Integer-exact under the 3*l2s*maxv < 2^19 dispatch
+                # gate; everything downstream is the i8 int32 pack path.
+                P = P.astype(jnp.int32)
             # prefix(d1) = prefix(d0) shifted one lane (cyclic): the band
             # is contiguous, so the cyclic neighbour IS position+1 inside
             # the window (rowpack_proto.py part 1).
@@ -1152,12 +1225,18 @@ def _kernel_packed(meta_ref, codes_ref, a_ref, out_ref, *, nbn, pretiled, sb, l2
 
 @functools.lru_cache(maxsize=32)
 def _pallas_call_packed(
-    nbn: int, wneed: int, tiles: int, interpret: bool, sb: int, l2s: int
+    nbn: int,
+    wneed: int,
+    tiles: int,
+    interpret: bool,
+    sb: int,
+    l2s: int,
+    feed: str = "i8",
 ):
-    pretiled = _pretile_ok(nbn, 1, "i8", sb)
+    pretiled = _pretile_ok(nbn, 1, feed, sb)
     p = _BLK // l2s
     kernel = functools.partial(
-        _kernel_packed, nbn=nbn, pretiled=pretiled, sb=sb, l2s=l2s
+        _kernel_packed, nbn=nbn, pretiled=pretiled, sb=sb, l2s=l2s, feed=feed
     )
     slots = nbn // sb
     bandw = sb * _BLK + _BLK
@@ -1186,10 +1265,14 @@ def _pallas_call_packed(
     )
 
 
-def _pallas_best_packed(seq1ext, len1, rows, lens, val_flat, sb=None, l2s=64):
+def _pallas_best_packed(
+    seq1ext, len1, rows, lens, val_flat, feed="i8", sb=None, l2s=64
+):
     """Row-packed variant of :func:`_pallas_best` for nbi == 1 buckets
-    whose every pair has len2 <= l2s (i8 feed only; enforced at
-    dispatch).  Same return contract; p = 128/l2s pairs per tile."""
+    whose every pair has len2 <= l2s (any feed whose packing class
+    passes ``dispatch.pack_classes`` — the 3*l2s*maxv < 2^19 int32
+    epilogue bound; enforced at dispatch).  Same return contract;
+    p = 128/l2s pairs per tile."""
     b, l2p = rows.shape
     assert l2p == _BLK, l2p
     w = seq1ext.shape[0] - l2p - 1
@@ -1208,13 +1291,16 @@ def _pallas_best_packed(seq1ext, len1, rows, lens, val_flat, sb=None, l2s=64):
     a_small = lax.dot_general(
         val27, oh1, (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32,
+        # f32-feed weights exceed the default precision's bf16-exact
+        # range; i8/bf16 values fit and keep the fast path.
+        precision=lax.Precision.HIGHEST if feed == "f32" else None,
     )
     a_ext = (
         jnp.zeros((_BLK, wneed), jnp.float32)
         .at[:ALPHABET_SIZE]
         .set(a_small[:, ::-1])
-    ).astype(jnp.int8)
-    if _pretile_ok(nbn, 1, "i8", sb):
+    ).astype(_FEED_DTYPES[feed])
+    if _pretile_ok(nbn, 1, feed, sb):
         sbw = sb * _BLK
         bandw = sbw + _BLK
         a_in = jnp.stack(
@@ -1240,7 +1326,7 @@ def _pallas_best_packed(seq1ext, len1, rows, lens, val_flat, sb=None, l2s=64):
     )
 
     interpret = jax.default_backend() != "tpu"
-    out = _pallas_call_packed(nbn, wneed, tiles, interpret, sb, l2s)(
+    out = _pallas_call_packed(nbn, wneed, tiles, interpret, sb, l2s, feed)(
         meta, codes, a_in
     )[0][:b, 0, :]
     return (
@@ -1253,11 +1339,11 @@ def _pallas_best_packed(seq1ext, len1, rows, lens, val_flat, sb=None, l2s=64):
 
 def _pallas_rows(seq1ext, len1, rows, lens, val_flat, feed="f32", sb=None, l2s=None):
     """Score a [B, L2P] padded batch with the fused kernel; returns [B, 3].
-    ``l2s`` (dispatch-gated: i8 feed, L2P == 128, all len2 <= l2s) routes
-    to the row-packed kernel."""
+    ``l2s`` (dispatch-gated: ``pack_classes(feed, maxv)`` non-empty,
+    L2P == 128, all len2 <= l2s) routes to the row-packed kernel."""
     if l2s is not None:
         best, bn, bk, eq = _pallas_best_packed(
-            seq1ext, len1, rows, lens, val_flat, sb=sb, l2s=l2s
+            seq1ext, len1, rows, lens, val_flat, feed=feed, sb=sb, l2s=l2s
         )
     else:
         best, bn, bk, eq = _pallas_best(
@@ -1291,8 +1377,8 @@ def score_chunks_pallas_body(
     non-128-aligned shape buckets (tiny problems).  ``feed`` must come
     from ``mxu_feed(val_flat)`` on concrete weights (checked at dispatch
     sites; this body may be traced with abstract values).  ``l2s``
-    routes to the row-packed kernel (dispatch-gated: i8 feed,
-    L2P == 128, every len2 <= l2s)."""
+    routes to the row-packed kernel (dispatch-gated: packing class in
+    ``pack_classes(feed, maxv)``, L2P == 128, every len2 <= l2s)."""
     nc, cb, l2p = seq2_chunks.shape
     l1p = seq1ext.shape[0] - l2p - 1
     if not _shapes_supported(l1p, l2p):

@@ -36,31 +36,33 @@ from ..utils.constants import ALPHABET_SIZE, INT32_MIN
 _NEG = jnp.int32(INT32_MIN)
 
 
-def _score_pair(seq1ext, len1, seq2row, len2, val_flat):
+def _score_pair(vw, len1, seq2row, len2):
     """Score one (seq1, seq2) pair over the full padded candidate grid.
 
-    seq1ext : [L1P + L2P + 1] int32 — seq1 codes padded with trailing zeros
-              so diagonal gathers never go out of bounds.
+    vw      : [27 * (L1P + L2P + 1)] int32 — flattened window-value table
+              ``vw[c * wext + t] = val[c, seq1ext[t]]``, precomputed ONCE
+              per batch by :func:`score_chunks_body` (r6 hoist: the Seq1
+              side of the value lookup is pair-independent, so the old
+              per-pair ``g0``/``g1`` char gathers chained into a value
+              gather collapse to a single gather per diagonal family).
     len1    : scalar int32 actual length of seq1.
     seq2row : [L2P] int32 padded seq2 codes.
     len2    : scalar int32 actual length.
-    val_flat: [27*27] int32 flattened signed pair-value table.
 
     Returns (score, n, k) int32 scalars.
     """
     l2p = seq2row.shape[0]
-    noff = seq1ext.shape[0] - l2p - 1  # == L1P: covers all valid offsets
+    wext = vw.shape[0] // ALPHABET_SIZE  # == L1P + L2P + 1
+    noff = wext - l2p - 1  # == L1P: covers all valid offsets
 
     n = jnp.arange(noff, dtype=jnp.int32)[:, None]
     i = jnp.arange(l2p, dtype=jnp.int32)[None, :]
     idx0 = n + i
 
-    g0 = jnp.take(seq1ext, idx0)  # seq1 char on the unshifted diagonal
-    g1 = jnp.take(seq1ext, idx0 + 1)  # ... and after the hyphen shift
-    pair_base = seq2row[None, :].astype(jnp.int32) * ALPHABET_SIZE
+    vw_base = seq2row[None, :].astype(jnp.int32) * wext
     charmask = i < len2  # zero out padded seq2 positions
-    v0 = jnp.where(charmask, jnp.take(val_flat, pair_base + g0), 0)
-    v1 = jnp.where(charmask, jnp.take(val_flat, pair_base + g1), 0)
+    v0 = jnp.where(charmask, jnp.take(vw, vw_base + idx0), 0)
+    v1 = jnp.where(charmask, jnp.take(vw, vw_base + idx0 + 1), 0)
 
     c0 = jnp.cumsum(v0, axis=1)
     c1 = jnp.cumsum(v1, axis=1)
@@ -101,12 +103,16 @@ def score_chunks_body(seq1ext, len1, seq2_chunks, len2_chunks, val_flat):
     Unjitted body so the distribution layer can reuse it inside shard_map;
     single-device callers use the jitted ``score_chunks`` below.
     """
+    # r6 window-value hoist: vw[c, t] = val[c, seq1ext[t]] is shared by
+    # every pair and chunk — build it once ([27, L1P+L2P+1] int32, a few
+    # hundred KB at cap) instead of re-gathering seq1 chars per pair.
+    vw = jnp.take(
+        val_flat.reshape(ALPHABET_SIZE, ALPHABET_SIZE), seq1ext, axis=1
+    ).reshape(-1)
 
     def chunk_fn(args):
         rows, lens = args
-        return jax.vmap(
-            lambda r, l: _score_pair(seq1ext, len1, r, l, val_flat)
-        )(rows, lens)
+        return jax.vmap(lambda r, l: _score_pair(vw, len1, r, l))(rows, lens)
 
     return lax.map(chunk_fn, (seq2_chunks, len2_chunks))
 

@@ -1,0 +1,40 @@
+"""JAX API compatibility for the pinned deployment surface.
+
+The package pins ``jax >= 0.4.37`` (pyproject.toml) — the floor is the
+version the suite is actually run against, chosen for the Pallas strided
+rotate (``pltpu.roll`` with ``stride``/``stride_axis``) and the modern
+``shard_map``.  One API moved between the floor and current jax:
+``shard_map`` lived in ``jax.experimental.shard_map`` (replication check
+spelled ``check_rep``) before graduating to ``jax.shard_map`` (spelled
+``check_vma``).  Every shard_map construction in the package goes through
+this one shim so the two sharded paths (batch + ring) cannot drift in how
+they handle the rename.
+"""
+
+from __future__ import annotations
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma: bool = True):
+    """``jax.shard_map`` with the ``check_vma``/``check_rep`` rename
+    papered over: the graduated API when present, else the experimental
+    one (jax 0.4.x), mapping ``check_vma`` onto its ``check_rep`` — the
+    same trace-time replication safety net under its earlier name."""
+    import jax
+
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(
+            f,
+            mesh=mesh,
+            in_specs=in_specs,
+            out_specs=out_specs,
+            check_vma=check_vma,
+        )
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    return _shard_map(
+        f,
+        mesh=mesh,
+        in_specs=in_specs,
+        out_specs=out_specs,
+        check_rep=check_vma,
+    )

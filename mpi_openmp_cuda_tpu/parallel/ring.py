@@ -143,7 +143,9 @@ class RingSharding:
         mode: tuple = ("gather",)
         if backend == "pallas":
             # Bs (the kernel's L1P) is forced to a 128 multiple below.
-            mode = choose_pallas_formulation(val_flat, (batch.l2p,))
+            # The kernel's Seq2 span is l2p on every shard, so the
+            # length-aware exactness bound applies unchanged here.
+            mode = choose_pallas_formulation(val_flat, (batch.l2p,), batch.l2p)
 
         sp, dp = self.sp, self.dp
         bs, _ = ring_plan(batch.l1p, batch.l2p, sp, pallas=mode[0] == "pallas")
@@ -256,16 +258,26 @@ def _ring_fn(mesh, bs, l2p, cb, mode: tuple = ("gather",)):
             n_local = jnp.arange(bs, dtype=jnp.int32)[:, None]
             i = jnp.arange(l2p, dtype=jnp.int32)[None, :]
             idx0 = n_local + i
-            g0 = jnp.take(win, idx0)
-            g1 = jnp.take(win, idx0 + 1)
             kk = jnp.arange(l2p, dtype=jnp.int32)[None, :]
             gn = d * bs + n_local
 
+            # Window-value hoist (r6): the whole Seq1 side of the value
+            # lookup is pair-independent, so materialise
+            # vw[c, t] = val[c, win[t]] once per shard ([27, win_len]
+            # int32, a few KB) right after the ring exchanges.  Each
+            # candidate pair then performs ONE [Bs, L2P] gather per
+            # diagonal family — indexing vw by row-major arithmetic —
+            # where the previous body chained a [Bs, L2P] window-char
+            # gather (g0/g1) into the value gather under the vmap.
+            vw = jnp.take(
+                val_flat.reshape(ALPHABET_SIZE, ALPHABET_SIZE), win, axis=1
+            ).reshape(-1)  # [27 * win_len]
+
             def pair_candidate(row, len2):
-                pair_base = row[None, :].astype(jnp.int32) * ALPHABET_SIZE
+                vw_base = row[None, :].astype(jnp.int32) * win_len
                 charmask = i < len2
-                v0 = jnp.where(charmask, jnp.take(val_flat, pair_base + g0), 0)
-                v1 = jnp.where(charmask, jnp.take(val_flat, pair_base + g1), 0)
+                v0 = jnp.where(charmask, jnp.take(vw, vw_base + idx0), 0)
+                v1 = jnp.where(charmask, jnp.take(vw, vw_base + idx0 + 1), 0)
                 c0 = jnp.cumsum(v0, axis=1)
                 c1 = jnp.cumsum(v1, axis=1)
                 t0 = c0[:, -1:]
@@ -309,8 +321,10 @@ def _ring_fn(mesh, bs, l2p, cb, mode: tuple = ("gather",)):
         out_k = jnp.where(searchable, best[:, 2], 0)
         return jnp.stack([score, out_n, out_k], axis=1).astype(jnp.int32)
 
+    from .compat import shard_map
+
     return jax.jit(
-        jax.shard_map(
+        shard_map(
             local_fn,
             mesh=mesh,
             in_specs=(P(SEQ_AXIS), P(), P(BATCH_AXIS), P(BATCH_AXIS), P()),

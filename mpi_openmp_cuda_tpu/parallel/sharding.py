@@ -151,8 +151,10 @@ class BatchSharding:
         if backend == "pallas":
             # Shared eligibility policy (exactness + import guard); shape
             # alignment is handled per-shard by pallas_pair_scorer's own
-            # fallback, so no dims are pinned here.
-            fm = choose_pallas_formulation(val_flat, ())
+            # fallback, so no dims are pinned here.  The broadcast batch's
+            # l2p engages the length-aware exactness bound identically on
+            # every host (same compiled SPMD program).
+            fm = choose_pallas_formulation(val_flat, (), batch.l2p)
             if fm[0] == "pallas":
                 from ..ops.pallas_scorer import choose_superblock
 
@@ -170,7 +172,7 @@ class BatchSharding:
                 # Same float32 bound as the matmul path: route to int32.
                 mode = ("gather",)
         else:
-            m = xla_formulation_mode(backend, val_flat)
+            m = xla_formulation_mode(backend, val_flat, batch.l2p)
             if m == "mm":
                 from ..ops.matmul_scorer import mm_precision
 
@@ -240,8 +242,10 @@ def _sharded_fn(mesh, cb, mode: tuple):
         )
         return out.reshape(bl, 3)
 
+    from .compat import shard_map
+
     return jax.jit(
-        jax.shard_map(
+        shard_map(
             local_fn,
             mesh=mesh,
             in_specs=(P(), P(), P(BATCH_AXIS), P(BATCH_AXIS), P()),

@@ -21,6 +21,16 @@ Probe-bracketed like bench.py (quiet window = both probes >= gate);
 retries with backoff until gated or attempts exhausted.  Output: one
 JSON line with per-sb walls, the static/best gap, and the cliff.
 
+r6 arms:
+
+* ``F32_AB=wide`` adds a 1-wide f32 program per sb (the pre-r6 walk,
+  forced via ``pallas_scorer._F32_WIDE1_AB`` with a
+  ``_pallas_call.cache_clear()`` between arms) measured in the SAME
+  interleaved rounds — the A/B behind the kernel's 2-wide f32 gate.
+* ``F32_PACK=1`` adds a packed-vs-unpacked f32 pair on a tiny-Seq2
+  (len2 <= 8, 64-pair) workload — validates that the row-packing win
+  carries to the f32 feed under the 3*l2s*maxv < 2^19 class gate.
+
 Usage: ``python scripts/f32_bench.py`` (F32_BENCH_ROUNDS /
 F32_BENCH_ATTEMPTS mirror the other harnesses' knobs).
 """
@@ -40,7 +50,7 @@ import bench
 F32_WEIGHTS = [300, 7, 1, 2]
 
 
-def build_prog(problem, weights, feed, sb):
+def build_prog(problem, weights, feed, sb, l2s=None):
     """Compiled+warmed two-point progs for the whole-batch single program
     at (feed, sb) — same protocol as scripts/sb_refit.py."""
     import jax
@@ -68,7 +78,7 @@ def build_prog(problem, weights, feed, sb):
             def step(c, i):
                 out = score_chunks_pallas_body(
                     s1, l1, jnp.roll(rows, i, axis=1),
-                    jnp.roll(lens, i, axis=1), v, feed=feed, sb=sb, l2s=None,
+                    jnp.roll(lens, i, axis=1), v, feed=feed, sb=sb, l2s=l2s,
                 )
                 return c + out.sum(), None
 
@@ -143,6 +153,48 @@ def main() -> None:
         variants[f"f32-sb{sb}"], _ = build_prog(
             problem, F32_WEIGHTS, "f32", sb
         )
+    if os.environ.get("F32_AB") == "wide":
+        # The pre-r6 1-wide f32 walk, same shapes/weights, fresh traces:
+        # the module flag is read at trace time and the pallas_call
+        # wrapper is lru-cached, so both caches must be cleared around
+        # each arm or the flip silently reuses the other arm's kernel.
+        import mpi_openmp_cuda_tpu.ops.pallas_scorer as ps
+
+        ps._F32_WIDE1_AB = True
+        ps._pallas_call.cache_clear()
+        try:
+            for sb in sbs:
+                variants[f"f32w1-sb{sb}"], _ = build_prog(
+                    problem, F32_WEIGHTS, "f32", sb
+                )
+        finally:
+            ps._F32_WIDE1_AB = False
+            ps._pallas_call.cache_clear()
+    if os.environ.get("F32_PACK") == "1":
+        # Packed-vs-unpacked f32 on a tiny-Seq2 workload: len2 <= 8 so
+        # the l2s=8 class is legal for any in-range f32 maxv
+        # (3 * 8 * 21845 < 2^19).
+        from types import SimpleNamespace
+
+        prng = np.random.default_rng(11)
+        pk_problem = SimpleNamespace(
+            seq1_codes=prng.integers(1, 27, size=2976).astype(np.int8),
+            seq2_codes=[
+                prng.integers(1, 27, size=int(l)).astype(np.int8)
+                for l in prng.integers(2, 9, size=64)
+            ],
+            weights=F32_WEIGHTS,
+        )
+        pk_nbn = pad_problem(
+            pk_problem.seq1_codes, pk_problem.seq2_codes
+        ).l1p // 128
+        pk_sb = _superblock(pk_nbn)
+        variants["f32pack-l2s8"], _ = build_prog(
+            pk_problem, F32_WEIGHTS, "f32", pk_sb, l2s=8
+        )
+        variants["f32pack-unpacked"], _ = build_prog(
+            pk_problem, F32_WEIGHTS, "f32", pk_sb
+        )
     i8_sb = choose_superblock(
         nbn, nbatch.l2p // 128, nbatch.len1, nbatch.len2, "i8"
     )
@@ -161,7 +213,7 @@ def main() -> None:
         measure, on_tpu, gate, max_attempts, "[f32-bench]"
     )
 
-    f32_walls = {k: med[k] for k in med if k.startswith("f32")}
+    f32_walls = {k: med[k] for k in med if k.startswith("f32-")}
     best_key = min(f32_walls, key=f32_walls.get)
     static_key = f"f32-sb{static_sb}"
     rec = {
@@ -175,6 +227,17 @@ def main() -> None:
         "i8_to_f32_cliff": round(med[static_key] / med[f"i8-sb{i8_sb}"], 2),
         "rounds": rounds,
     }
+    if any(k.startswith("f32w1-") for k in med):
+        # >1 means the 2-wide walk is faster at that sb.
+        rec["f32_wide1_over_wide2"] = {
+            k.removeprefix("f32-"): round(med["f32w1-" + k.removeprefix("f32-")] / v, 3)
+            for k, v in f32_walls.items()
+            if "f32w1-" + k.removeprefix("f32-") in med
+        }
+    if "f32pack-l2s8" in med:
+        rec["f32_unpacked_over_packed"] = round(
+            med["f32pack-unpacked"] / med["f32pack-l2s8"], 2
+        )
     if a.pmin is not None:
         # probe_gated only when a probe actually ran (off-TPU records
         # must not claim a gate that never existed — r5 code review).

@@ -238,3 +238,68 @@ for trial in range(60):
                 print(f"MISMATCH trial {trial} seg {j} l2s={l2s_t} sb={sb_t} "
                       f"nbn={nbn_t} len1={len1_t} l2={l2}: got {got[j]} ref {ref}")
 print(f"part 2: {trials - fails}/{trials} segments exact")
+if fails:
+    sys.exit(1)
+
+
+# ======================================================================
+# Part 3 (r6): f32-feed packing exactness at the class boundaries.
+# The packed kernel's non-i8 path computes the two matmuls in the feed
+# dtype with float32 accumulation, then casts the prefix P to int32
+# before the integer argmax-key packing.  Exactness argument:
+#   * every product has a 0/1 operand, so products are exact;
+#   * a segment-local prefix sums <= l2s values of |v| <= maxv, so
+#     |P| <= l2s * maxv < 2^19 / 3 < 2^24 — float32 integer-exact;
+#   * gpack = g * 4096 + kappa-bits and spack = sv * 2^klb + key stay
+#     inside int32 while 3 * l2s * maxv < 2^19 (dispatch.pack_classes).
+# This part checks the argument EMPIRICALLY at each class's worst legal
+# maxv: the f32-accumulated prefix must equal the int64 reference
+# bit-for-bit, and every pack must fit int32.
+# ======================================================================
+
+CLASS_MAXV = {8: 21845, 16: 10922, 32: 5461, 64: 2730}
+p3_fail = 0
+for l2s_t, maxv in sorted(CLASS_MAXV.items()):
+    assert 3 * l2s_t * maxv < 2**19, (l2s_t, maxv)
+    sbw_t = 2 * BLK
+    Wt = sbw_t + BLK
+    valw = rng.integers(-maxv, maxv + 1, size=(27, 27)).astype(np.int64)
+    valw[0, :] = 0
+    valw[:, 0] = 0
+    # adversarial corner: force worst-case same-sign runs in one segment
+    valw[1, :] = maxv
+    valw[2, :] = -maxv
+    codes_t = rng.integers(1, 27, size=BLK).astype(np.int64)
+    codes_t[:l2s_t] = 1        # a segment of all +maxv rows
+    codes_t[l2s_t : 2 * l2s_t] = 2  # and one of all -maxv rows
+    s1_t = rng.integers(1, 27, size=3 * BLK).astype(np.int64)
+    pos = sbw_t + BLK - 1 - np.arange(Wt)
+    s1ext_t = np.zeros(4 * BLK, np.int64)
+    s1ext_t[: s1_t.size] = s1_t
+    vp = valw[codes_t[:, None], s1ext_t[pos][None, :]]
+    vp2 = np.stack([np.roll(vp[r], r) for r in range(BLK)])
+    Lbd = np.zeros((BLK, BLK), np.int64)
+    for r in range(BLK):
+        for r2 in range(BLK):
+            if r >= r2 and r // l2s_t == r2 // l2s_t:
+                Lbd[r, r2] = 1
+    P_ref = Lbd @ vp2
+    # float32-accumulated prefix, as the kernel's non-i8 matmul produces
+    P_f32 = (Lbd.astype(np.float32) @ vp2.astype(np.float32)).astype(np.int64)
+    exact = bool((P_f32 == P_ref).all())
+    rollP = np.roll(P_ref, 1, axis=1)
+    g = P_ref - rollP
+    KB = 4096
+    klb = 12
+    gpack_max = int(np.abs(g).max()) * KB + KB
+    spack_max = (int(np.abs(P_ref).max()) + int(np.abs(g).max())) * (1 << klb) + (1 << klb)
+    fits = gpack_max < 2**31 and spack_max < 2**31
+    tag = "OK" if exact and fits else "FAIL"
+    if tag == "FAIL":
+        p3_fail += 1
+    print(
+        f"part 3: l2s={l2s_t} maxv={maxv}: f32 prefix exact={exact} "
+        f"gpack<=2^{gpack_max.bit_length()} spack<=2^{spack_max.bit_length()} {tag}"
+    )
+if p3_fail:
+    sys.exit(1)

@@ -1,10 +1,13 @@
-"""Refit choose_superblock's cost model on the shipped (r3/r4) kernel —
-VERDICT r3 item 6.
+"""Refit choose_superblock's cost model on the shipped kernel —
+VERDICT r3 item 6, extended r6 to every MXU feed.
 
-The three constants (`_ITER_FLOOR_BASE_S`, `_ITER_FLOOR_PER_SB_S`,
-`_MAC_RATE`) were r2-kernel fits from sb <= 12 sweeps; r3 changed the
-per-iteration cost structure (tail1 exact walk, wide=1 for nbi == 1)
-and widened the choice space to sb = 24.  This script:
+The per-feed constant triples (`_SB_CONSTANTS[feed]` = base, per_sb,
+rate) were historically fit for i8 only; the bf16 chooser ALIASED the
+i8 constants on argument alone and f32 carried an r5 fit of the
+pre-interleave 1-wide walk.  ``SB_FEED`` (i8 default / bf16 / f32)
+selects the feed under refit: the workload weights move to that feed's
+value range and the grid ranges scale to the feed's plausible rate.
+This script:
 
 1. Sweeps sb on-device over four unpacked workload classes (interleaved
    rounds — sequential cross-variant measurements fabricate effects on
@@ -14,7 +17,8 @@ and widened the choice space to sb = 24.  This script:
    for call overhead the model deliberately excludes).
 3. Reports each workload's measured winner vs the refit model's argmin.
 
-Usage: python scripts/sb_refit.py  (TPU; ~10 min including compiles).
+Usage: [SB_FEED=bf16] python scripts/sb_refit.py
+(TPU; ~10 min including compiles).
 """
 
 from __future__ import annotations
@@ -29,8 +33,22 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import bench  # noqa: E402
 
+# Weights that land the value table in each feed's range (asserted via
+# mxu_feed at startup); per-feed (rate, per_sb) refit grid bounds — the
+# i8 grid would clip a plausible bf16/f32 optimum.
+FEED_WEIGHTS = {
+    "i8": [3, 2, 1, 4],
+    "bf16": [128, 2, 1, 4],
+    "f32": [3000, 7, 1, 2],
+}
+FEED_GRID = {
+    "i8": ((100e12, 400e12), (0.0, 0.06e-6)),
+    "bf16": ((30e12, 120e12), (0.0, 0.25e-6)),
+    "f32": ((10e12, 60e12), (0.0, 0.6e-6)),
+}
 
-def workloads():
+
+def workloads(feed: str = "i8"):
     rng = np.random.default_rng(7)
 
     def mk(len1, lens):
@@ -38,17 +56,21 @@ def workloads():
         seqs = [rng.integers(1, 27, size=int(l)).astype(np.int32) for l in lens]
         return s1, seqs
 
+    # The f32 feed's largest legal packing class at |v| ~ 3000 is 32
+    # (dispatch.pack_classes' 3*l2s*maxv < 2^19 bound); validating the
+    # packed walk at a class dispatch would never choose would be noise.
+    pk = 64 if feed != "f32" else 32
     return {
         # (seq1, seqs, sb candidates, l2s)
         "input3-class": (*mk(1489, rng.integers(56, 1153, size=32)), (2, 3, 4, 6, 12), None),
         "max-size": (*mk(3000, rng.integers(1200, 2000, size=64)), (2, 4, 6, 8, 12, 24), None),
         "skew": (*mk(1489, rng.integers(1460, 1490, size=64)), (2, 3, 4, 6, 12), None),
         "input4-class-unpacked": (*mk(2976, rng.integers(5, 83, size=30)), (4, 8, 12, 24), None),
-        "input4-class-packed": (*mk(2976, rng.integers(5, 65, size=30)), (4, 8, 12, 24), 64),
+        "input4-class-packed": (*mk(2976, rng.integers(5, pk + 1, size=30)), (4, 8, 12, 24), pk),
     }
 
 
-def build_progs(name, seq1, seqs, sbs, l2s):
+def build_progs(name, seq1, seqs, sbs, l2s, feed: str = "i8"):
     import jax
     import jax.numpy as jnp
     from jax import lax
@@ -58,7 +80,10 @@ def build_progs(name, seq1, seqs, sbs, l2s):
     from mpi_openmp_cuda_tpu.ops.values import value_table
 
     batch = pad_problem(seq1, seqs)
-    val = value_table([3, 2, 1, 4]).astype(np.int32).reshape(-1)
+    val = value_table(FEED_WEIGHTS[feed]).astype(np.int32).reshape(-1)
+    from mpi_openmp_cuda_tpu.ops.pallas_scorer import mxu_feed
+
+    assert mxu_feed(val) == feed, (mxu_feed(val), feed)
     b = batch.batch_size
     rows, lens = pad_batch_rows(batch, b)
     args = (
@@ -74,7 +99,7 @@ def build_progs(name, seq1, seqs, sbs, l2s):
             def step(c, i):
                 out = score_chunks_pallas_body(
                     s1, l1, jnp.roll(rows, i, axis=1),
-                    jnp.roll(lens, i, axis=1), v, feed="i8", sb=sb, l2s=l2s,
+                    jnp.roll(lens, i, axis=1), v, feed=feed, sb=sb, l2s=l2s,
                 )
                 return c + out.sum(), None
 
@@ -93,15 +118,11 @@ def build_progs(name, seq1, seqs, sbs, l2s):
         # a properly-amortised interleaved A/B).  The SHIPPED cost model
         # constants (right order of magnitude everywhere) size the
         # amortisation, so the sizing tracks any future refit.
-        from mpi_openmp_cuda_tpu.ops.pallas_scorer import (
-            _ITER_FLOOR_BASE_S,
-            _ITER_FLOOR_PER_SB_S,
-            _MAC_RATE,
-        )
+        from mpi_openmp_cuda_tpu.ops.pallas_scorer import _SB_CONSTANTS
 
         rough = max(
             model_cost(
-                _ITER_FLOOR_BASE_S, _ITER_FLOOR_PER_SB_S, _MAC_RATE,
+                *_SB_CONSTANTS[feed],
                 nbn, nbi, batch.len1, [len(s) for s in seqs], sb,
             ),
             2e-6,
@@ -134,11 +155,16 @@ def model_cost(base, per_sb, rate, nbn, nbi, len1, lens, sb):
 
 def main() -> None:
     rounds = int(os.environ.get("SB_ROUNDS", "3"))
-    wl = workloads()
+    feed = os.environ.get("SB_FEED", "i8")
+    if feed not in FEED_WEIGHTS:
+        raise SystemExit(f"SB_FEED must be one of {sorted(FEED_WEIGHTS)}")
+    wl = workloads(feed)
     built = {}
     for name, (seq1, seqs, sbs, l2s) in wl.items():
-        built[name] = (build_progs(name, seq1, seqs, sbs, l2s), seqs, sbs, l2s)
-        print(f"built {name}", file=sys.stderr)
+        built[name] = (
+            build_progs(name, seq1, seqs, sbs, l2s, feed), seqs, sbs, l2s
+        )
+        print(f"built {name} (feed={feed})", file=sys.stderr)
 
     p0 = bench.probe_or_none()
     meas: dict = {name: {sb: [] for sb in v[2]} for name, v in built.items()}
@@ -178,12 +204,11 @@ def main() -> None:
     # cross-check below fails loudly if the shared structure drifts.
     from mpi_openmp_cuda_tpu.ops.pallas_scorer import (
         _BLK,
-        _ITER_FLOOR_BASE_S,
-        _ITER_FLOOR_PER_SB_S,
         _live_superblocks,
-        _MAC_RATE,
+        _SB_CONSTANTS,
     )
 
+    s_base, s_per_sb, s_rate = _SB_CONSTANTS[feed]
     names = sorted({r[0] for r in fit_rows})
     struct = []
     for name, sb, m, nbn, nbi, len1, lens, wide in fit_rows:
@@ -206,22 +231,23 @@ def main() -> None:
         # superblock_model_cost without this decomposition fails here
         # instead of silently fitting the old structure.
         fast = A * max(
-            _ITER_FLOOR_BASE_S + sb * _ITER_FLOOR_PER_SB_S, macs / _MAC_RATE
+            s_base + sb * s_per_sb, macs / s_rate
         ) + B * max(
-            _ITER_FLOOR_BASE_S + sb * _ITER_FLOOR_PER_SB_S,
-            2 * macs / _MAC_RATE,
+            s_base + sb * s_per_sb,
+            2 * macs / s_rate,
         )
         ref = model_cost(
-            _ITER_FLOOR_BASE_S, _ITER_FLOOR_PER_SB_S, _MAC_RATE,
+            s_base, s_per_sb, s_rate,
             nbn, nbi, len1, lens, sb,
         )
         assert abs(fast - ref) <= 1e-9 + 1e-6 * ref, (name, sb, fast, ref)
 
     best = None
+    (rate_lo, rate_hi), (psb_lo, psb_hi) = FEED_GRID[feed]
     for base, per_sb, rate in itertools.product(
         np.linspace(0.2e-6, 1.4e-6, 25),
-        np.linspace(0.0, 0.06e-6, 13),
-        np.linspace(100e12, 400e12, 25),
+        np.linspace(psb_lo, psb_hi, 13),
+        np.linspace(rate_lo, rate_hi, 25),
     ):
         err = 0.0
         for name in names:
@@ -242,11 +268,11 @@ def main() -> None:
             best = (err, base, per_sb, rate)
     err, base, per_sb, rate = best
     print(
-        f"\nrefit: base={base * 1e6:.2f}us per_sb={per_sb * 1e6:.3f}us "
+        f"\nrefit[{feed}]: base={base * 1e6:.2f}us per_sb={per_sb * 1e6:.3f}us "
         f"rate={rate / 1e12:.0f}e12 MAC/s (log-err {err:.3f}); shipped "
-        f"constants: base={_ITER_FLOOR_BASE_S * 1e6:.2f}us "
-        f"per_sb={_ITER_FLOOR_PER_SB_S * 1e6:.3f}us "
-        f"rate={_MAC_RATE / 1e12:.0f}e12"
+        f"constants: base={s_base * 1e6:.2f}us "
+        f"per_sb={s_per_sb * 1e6:.3f}us "
+        f"rate={s_rate / 1e12:.0f}e12"
     )
     ok = True
     for name in names:

@@ -34,13 +34,20 @@ from mpi_openmp_cuda_tpu.ops.dispatch import AlignmentScorer
 from mpi_openmp_cuda_tpu.ops.oracle import score_batch_oracle
 
 # One regime per MXU feed plus the boundaries and the gather fallback.
+# The f32 exact ceiling is length-aware (max_exact_value(l2p)): 4095 at
+# the padded l2p=2048 buckets, 32767 at l2p=128 — so [4096,...] now
+# exercises the widened exact f32 path on short-Seq2 buckets and the
+# gather fallback on long ones, while [40000,...] (> 32767) is a true
+# all-bucket gather regime.
 WEIGHT_REGIMES = [
     [10, 2, 3, 4],     # i8 feed (fixtures' regime)
     [127, 2, 3, 4],    # i8 upper boundary
     [128, 2, 3, 4],    # bf16 boundary
     [300, 7, 1, 2],    # f32 feed (the regime the default precision broke)
-    [4095, 1, 1, 1],   # f32 upper boundary
-    [4096, 1, 1, 1],   # int32 gather fallback
+    [4095, 1, 1, 1],   # f32 static upper boundary (exact at any l2p)
+    [4096, 1, 1, 1],   # mixed: exact f32 at small l2p, gather beyond
+    [32767, 1, 1, 1],  # f32 length-aware ceiling (exact only at l2p=128)
+    [40000, 1, 1, 1],  # int32 gather fallback at every bucket
     [1, 1, 1, 1],      # maximal ties
 ]
 

@@ -55,11 +55,14 @@ def test_bench_emits_contract_json_line():
                         "feed_roofline_tflops", "feed_roofline_kind",
                         "mfu_vs_feed_roofline",
                         "vpu_probe_arith_gelems", "vpu_floor_us",
-                        "wall_vs_vpu_floor"}
+                        "wall_vs_vpu_floor", "formulation"}
     assert rec["e2e_first_run_s"] >= 0 and rec["e2e_warm_s"] >= 0
     assert rec["unit"] == "elements/s/chip"
     assert rec["value"] > 0 and rec["vs_baseline"] > 0
     assert "stress_small.txt" in rec["metric"]
+    # r6: the record self-describes the formulation it actually timed;
+    # CPU default backend is the XLA mm path.
+    assert rec["formulation"] == "xla"
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,170 @@
+"""Dispatch-policy unit tests (r6): choose_chunk boundary cases, the
+length-aware f32 exactness bound, the row-packing maxv gates, and the
+>32767-weight gather routing with oracle bit-exactness."""
+
+import numpy as np
+import pytest
+
+from mpi_openmp_cuda_tpu.ops.dispatch import (
+    PALLAS_MAX_CHUNK,
+    AlignmentScorer,
+    choose_chunk,
+    choose_rowpack,
+    effective_backend,
+    pack_classes,
+    pad_problem,
+)
+from mpi_openmp_cuda_tpu.ops.matmul_scorer import (
+    MAX_EXACT_WEIGHT,
+    max_exact_value,
+)
+from mpi_openmp_cuda_tpu.ops.oracle import score_batch_oracle
+from mpi_openmp_cuda_tpu.ops.values import value_table
+
+
+def _batch(n_pairs, len2=4):
+    rng = np.random.default_rng(n_pairs)
+    seq1 = rng.integers(1, 27, size=40).astype(np.int8)
+    seqs = [
+        rng.integers(1, 27, size=len2).astype(np.int8) for _ in range(n_pairs)
+    ]
+    return pad_problem(seq1, seqs)
+
+
+# ---------------------------------------------------------------------------
+# choose_chunk boundaries (satellite: the policy had no direct unit tests;
+# every case here is a boundary the score paths can actually reach).
+# ---------------------------------------------------------------------------
+
+
+def test_choose_chunk_budget_below_one_pair():
+    # Budget smaller than a single pair's footprint must still make
+    # progress: chunk of 1, never 0.
+    batch = _batch(8)
+    assert batch.l1p * batch.l2p > 64
+    assert choose_chunk(batch, 64, "xla") == 1
+    assert choose_chunk(batch, 64, "pallas") == 1
+
+
+def test_choose_chunk_batch_of_one():
+    # A 1-pair batch chunks at exactly 1 regardless of budget or backend.
+    batch = _batch(1)
+    for backend in ("xla", "pallas"):
+        assert choose_chunk(batch, 1 << 30, backend) == 1
+
+
+def test_choose_chunk_caps_at_batch_pow2():
+    # A huge budget clamps to the power-of-two bucket of the batch size,
+    # not the raw budget quotient (3 pairs -> bucket 4).
+    batch = _batch(3)
+    assert choose_chunk(batch, 1 << 30, "xla") == 4
+    assert choose_chunk(batch, 1 << 30, "pallas") == 4
+
+
+def test_choose_chunk_pallas_max_chunk_cap():
+    # The fused kernel takes the whole batch per call but never above
+    # PALLAS_MAX_CHUNK; the XLA formulations have no such cap (their
+    # budget quotient is the binding constraint).
+    batch = _batch(600, len2=1)
+    assert choose_chunk(batch, 1 << 30, "pallas") == PALLAS_MAX_CHUNK
+    assert choose_chunk(batch, 1 << 30, "xla") > 0
+
+
+def test_choose_chunk_power_of_two():
+    for n in (1, 2, 5, 9, 31):
+        batch = _batch(n)
+        for budget in (1, 1 << 16, 1 << 24, 1 << 30):
+            cb = choose_chunk(batch, budget, "pallas")
+            assert cb >= 1 and (cb & (cb - 1)) == 0
+
+
+# ---------------------------------------------------------------------------
+# Length-aware f32 exactness bound (r6 tentpole).
+# ---------------------------------------------------------------------------
+
+
+def test_max_exact_value_boundaries():
+    # Unknown bucket width -> the static padded-2048 worst case.
+    assert max_exact_value() == MAX_EXACT_WEIGHT == 4095
+    assert max_exact_value(2048) == 4095
+    # Short buckets are capped by the HIGHEST-operand bound (2*maxv
+    # <= 2^16 - 1), not the f24 accumulation bound.
+    assert max_exact_value(128) == 32767
+    # In between, the accumulation bound (2 * l2p * maxv < 2^24) rules.
+    assert max_exact_value(512) == (2**24 - 1) // 1024
+    # Monotone non-increasing in bucket width.
+    vals = [max_exact_value(l2p) for l2p in (128, 256, 512, 1024, 2048)]
+    assert vals == sorted(vals, reverse=True)
+
+
+def test_effective_backend_length_aware():
+    """The gather cliff moved: 4096 is rescued into the exact f32 path at
+    l2p=128 buckets, while anything past 32767 gathers at every width."""
+    w4096 = value_table([4096, 7, 1, 2]).reshape(-1)
+    w40000 = value_table([40000, 7, 1, 2]).reshape(-1)
+    assert effective_backend("pallas", w4096) == "xla-gather"  # static bound
+    assert effective_backend("pallas", w4096, 128) == "pallas"
+    assert effective_backend("pallas", w40000, 128) == "xla-gather"
+    assert effective_backend("xla", w40000, 128) == "xla"
+
+
+# ---------------------------------------------------------------------------
+# Row-packing maxv gates (r6: packing widened beyond the i8 feed).
+# ---------------------------------------------------------------------------
+
+
+def test_pack_classes_maxv_gates():
+    # i8 weights can never break the 3 * l2s * maxv < 2^19 epilogue
+    # bound, so every class is legal without knowing maxv.
+    assert pack_classes("i8") == (8, 16, 32, 64)
+    # Non-i8 feeds with unknown weights must not pack.
+    assert pack_classes("bf16") == ()
+    assert pack_classes("f32") == ()
+    # Exact class thresholds of the int32 epilogue bound.
+    assert pack_classes("f32", 2730) == (8, 16, 32, 64)
+    assert pack_classes("f32", 2731) == (8, 16, 32)
+    assert pack_classes("f32", 5461) == (8, 16, 32)
+    assert pack_classes("f32", 5462) == (8, 16)
+    assert pack_classes("f32", 10922) == (8, 16)
+    assert pack_classes("f32", 10923) == (8,)
+    assert pack_classes("f32", 21845) == (8,)
+    assert pack_classes("f32", 21846) == ()
+    # bf16's whole domain (|v| <= 128) passes every class.
+    assert pack_classes("bf16", 128) == (8, 16, 32, 64)
+
+
+def test_choose_rowpack_feed_gates():
+    assert choose_rowpack("i8", 128, [2, 3]) == 8
+    # Non-i8 needs a concrete maxv.
+    assert choose_rowpack("f32", 128, [2, 3]) is None
+    assert choose_rowpack("f32", 128, [2, 3], maxv=3000) == 8
+    # Rows wider than the widest legal class for this maxv: no packing.
+    assert choose_rowpack("f32", 128, [40, 40], maxv=21845) is None
+    # Multi-block buckets and singleton batches never pack.
+    assert choose_rowpack("i8", 256, [2, 3]) is None
+    assert choose_rowpack("i8", 128, [5]) is None
+
+
+# ---------------------------------------------------------------------------
+# Gather-regime routing + bit-exactness (satellite f).
+# ---------------------------------------------------------------------------
+
+
+def test_gather_regime_routes_and_matches_oracle():
+    """Weights past the 32767 length-aware ceiling must route the pallas
+    backend to the int32 gather fallback at every bucket and stay
+    bit-exact vs the host oracle (the regime `make bench-gather` times)."""
+    weights = [40000, 7, 1, 2]
+    val = value_table(weights).reshape(-1)
+    assert effective_backend("pallas", val, 128) == "xla-gather"
+    rng = np.random.default_rng(3)
+    seq1 = rng.integers(1, 27, size=90).astype(np.int8)
+    seqs = [
+        rng.integers(1, 27, size=int(l)).astype(np.int8)
+        for l in rng.integers(1, 40, size=9)
+    ]
+    got = [
+        tuple(int(x) for x in r)
+        for r in AlignmentScorer("pallas").score_codes(seq1, seqs, weights)
+    ]
+    assert got == score_batch_oracle(seq1, seqs, weights)

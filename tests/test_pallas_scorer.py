@@ -261,8 +261,10 @@ def test_choose_superblock_regimes():
     near-tie) per regime — constants refit on the r3/r4 kernel by
     scripts/sb_refit.py's interleaved v2 sweep (VERDICT r3 item 6):
     wide blocks for wide valid-offset ranges, narrow blocks for
-    near-Seq1-length batches; the f32 (wide=1) feed runs the same model
-    with its own r5-fit constants (scripts/f32_bench.py)."""
+    near-Seq1-length batches; the f32 feed (2-wide since r6) runs the
+    same model with its own constants, refit under the 2-wide walk
+    (scripts/f32_bench.py F32_AB=wide + scripts/sb_refit.py
+    SB_FEED=f32)."""
     from mpi_openmp_cuda_tpu.ops.pallas_scorer import (
         _superblock,
         choose_superblock,
@@ -285,9 +287,10 @@ def test_choose_superblock_regimes():
     skew = [1480] * 64
     assert choose_superblock(12, 12, 1489, skew, "i8") in (2, 3)
     assert choose_superblock(4, 4, 450, [445] * 8, "i8") == 2
-    # f32 runs the adaptive model with its own r5-fit constants
-    # (scripts/f32_bench.py gated sweeps; the old static punt measured
-    # 2.63x over best on the skew class): skew picks the measured winner
+    # f32 runs the adaptive model with its own constants — r6-refit
+    # under the 2-wide walk (scripts/f32_bench.py gated sweeps; the old
+    # static punt measured 2.63x over best on the skew class): skew
+    # picks the measured winner
     # sb=2, max-size keeps sb=12 (measured winner), and the input3-class
     # mix lands in the measured 3..6 shallow bowl (sb=6 best at 497.8 us,
     # sb=3/4 within 10%; the real input3 histogram picks 3, this
@@ -446,6 +449,40 @@ def test_rowpack_tie_break_low_entropy():
     seq1 = rng.integers(1, 3, size=260).astype(np.int8)
     seqs = [rng.integers(1, 3, size=int(rng.integers(1, 60))) for _ in range(7)]
     weights = [5, 1, 1, 1]
+    got = _score(seq1, seqs, weights)
+    want = [prefix_best(seq1, s, weights) for s in seqs]
+    assert [tuple(int(x) for x in row) for row in got] == want
+
+
+@pytest.mark.parametrize(
+    "feed,weights",
+    [
+        # f32 rides the fast tier: it is the feed r6 newly opened to
+        # packing AND the one whose class gate depends on maxv; bf16
+        # (every class legal at |v| <= 128) rides slow.
+        ("f32", [3000, 7, 1, 2]),
+        pytest.param("bf16", [128, 2, 3, 4], marks=pytest.mark.slow),
+    ],
+)
+def test_rowpack_non_i8_feeds_match_oracle(feed, weights):
+    """r6: row packing widened to the bf16/f32 feeds under the
+    3 * l2s * maxv < 2^19 int32-epilogue gate.  The dispatch must
+    actually route these batches to the packed kernel (asserted via
+    choose_rowpack at the concrete maxv) and stay oracle-exact,
+    tie-break included."""
+    from mpi_openmp_cuda_tpu.ops.dispatch import choose_rowpack
+    from mpi_openmp_cuda_tpu.ops.pallas_scorer import mxu_feed
+    from mpi_openmp_cuda_tpu.ops.values import max_abs_value, value_table
+
+    val = value_table(weights).reshape(-1)
+    assert mxu_feed(val) == feed
+    maxv = max_abs_value(val)
+    rng = np.random.default_rng(len(feed))
+    lens = [int(rng.integers(2, 9)) for _ in range(8)]
+    lens[0] = 8  # class boundary
+    seqs = [rng.integers(1, 27, size=l).astype(np.int8) for l in lens]
+    seq1 = rng.integers(1, 27, size=120).astype(np.int8)
+    assert choose_rowpack(feed, 128, lens, maxv=maxv) == 8
     got = _score(seq1, seqs, weights)
     want = [prefix_best(seq1, s, weights) for s in seqs]
     assert [tuple(int(x) for x in row) for row in got] == want
