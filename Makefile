@@ -18,7 +18,7 @@ PY_CFLAGS  := $(shell $(PYCONFIG) --includes)
 PY_LDFLAGS := $(shell $(PYCONFIG) --ldflags --embed)
 INPUT      ?= /root/reference/input5.txt
 
-.PHONY: build run run2 runOn2 test chaos bench bench-table bench-gather check clean
+.PHONY: build run run2 runOn2 test chaos analyze bench bench-table bench-gather check clean
 
 build: final
 
@@ -78,6 +78,13 @@ chaos:
 	SEQALIGN_FAULTS="chunk_scoring:fail=2;journal_append:fail=1" \
 	SEQALIGN_FAULT_RETRIES=3 SEQALIGN_BACKOFF_BASE=0.01 \
 	$(PYTHON) -m pytest tests/ -q
+
+# Static-analysis gate (docs/ARCHITECTURE.md §9): seqlint, the
+# exhaustive VMEM chooser sweep, the eval_shape entry-point contract
+# audit, plus ruff/mypy when installed (gated on availability — the
+# deployment container does not ship them).  CPU-only, a few seconds.
+analyze:
+	$(PYTHON) scripts/analyze.py
 
 # Full coverage in TWO pytest processes: the fast tier, then the
 # slow-marked tests alone.  A single combined process segfaults jaxlib's

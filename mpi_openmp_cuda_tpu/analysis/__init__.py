@@ -1,0 +1,85 @@
+"""Static analysis & machine-checked contracts (`seqcheck`).
+
+PR 2 shipped with an *unmeasured assumption* ("2-wide f32 tiles spill
+VMEM") sitting in the kernel chooser for a whole PR cycle, and the
+numeric gates that keep the fused kernel exact — ``max_exact_value(l2p)``,
+the ``3 * l2s * maxv < 2**19`` rowpack epilogue bound — were enforced
+only by convention at the call sites in ``ops/dispatch.py``.  This
+package turns those conventions into four cooperating passes, all
+runnable on CPU-only CI (``make analyze``):
+
+* :mod:`.contracts` — declarative shape/dtype/value-range contracts on
+  every scorer entry point, verified abstractly via ``jax.eval_shape``
+  and (under ``--check`` / ``SEQALIGN_CHECK``) at runtime via
+  ``jax.experimental.checkify``.
+* :mod:`.vmem` — a static per-config VMEM footprint model derived from
+  the ``BlockSpec``s of ``_pallas_call`` / ``_pallas_call_packed``,
+  exhaustively swept over the chooser space; an emitted config past the
+  per-core budget is a red X, not a surprise on real hardware.
+* :mod:`.seqlint` — an AST lint with repo-specific rules (host syncs in
+  jitted scoring paths, scattered env reads, Python branches on traced
+  values, bare asserts in runtime paths, wall-clock reads in
+  deterministic resilience/journal decision paths).
+* :mod:`.recompile` — a jit cache-miss counting harness so tests can pin
+  the expected number of compilations per bucketed schedule.
+
+Everything raises a :class:`SeqcheckError` subclass with a message
+naming the violated bound and the fix, so a CI failure is actionable
+without rerunning anything on a TPU.
+"""
+
+from __future__ import annotations
+
+
+class SeqcheckError(RuntimeError):
+    """Base of every analysis-pass failure (contracts, VMEM audit, lint
+    driver).  Always carries an actionable message: the violated bound,
+    the observed value, and where the legal policy lives."""
+
+
+class ContractViolation(SeqcheckError):
+    """A scorer entry point was (or would be) invoked outside its
+    declared shape/dtype/value-range contract."""
+
+
+class ExactnessViolation(ContractViolation):
+    """Weight magnitudes exceed the float32 exactness ceiling for the
+    requested formulation at the batch's Seq2 bucket width."""
+
+
+class FeedViolation(ContractViolation):
+    """The requested MXU feed does not match the one the value table
+    affords (``pallas_scorer.mxu_feed``)."""
+
+
+class RowpackViolation(ContractViolation):
+    """A row-packing request breaches the packed kernel's int32 epilogue
+    gate (``3 * l2s * maxv < 2**19``) or its shape preconditions."""
+
+
+class SuperblockViolation(ContractViolation):
+    """An offset-super-block width the kernel cannot execute (does not
+    divide the offset-block count, or exceeds the ``sb <= 24`` packed
+    argmax-key bound)."""
+
+
+class VmemBudgetError(SeqcheckError):
+    """A kernel configuration's modelled VMEM footprint exceeds the
+    per-core budget."""
+
+
+class LintError(SeqcheckError):
+    """The repo-specific AST lint found violations (driver-level error;
+    individual findings are :class:`.seqlint.LintFinding` rows)."""
+
+
+__all__ = [
+    "SeqcheckError",
+    "ContractViolation",
+    "ExactnessViolation",
+    "FeedViolation",
+    "RowpackViolation",
+    "SuperblockViolation",
+    "VmemBudgetError",
+    "LintError",
+]

@@ -1,0 +1,422 @@
+"""Declarative shape/dtype/value-range contracts for the scorer entry
+points.
+
+Two enforcement tiers, per the MPI-rical argument (PAPERS.md) that
+distributed-kernel invariants need *tooling*, not author discipline:
+
+* **Abstract** (:func:`audit_entry_points`) — every registered entry
+  point is traced with ``jax.eval_shape`` over representative abstract
+  operands (no FLOPs, no device, no TPU) and its output aval is checked
+  against the declared contract.  Runs in ``make analyze`` and CI.
+* **Concrete** (:func:`validate_dispatch`) — the numeric-range gates
+  that cannot be seen in an aval (float32 exactness ceiling, rowpack
+  epilogue bound, superblock divisibility) are checked against the
+  CONCRETE dispatch decision at the single place all of them become
+  real: ``AlignmentScorer._score_local``.  Enabled by ``--check`` /
+  ``SEQALIGN_CHECK``; each failure is a distinct
+  :class:`~..analysis.ContractViolation` subclass naming the violated
+  bound and the fix.
+* **Traced** (:func:`checked_pallas_body`) — a
+  ``jax.experimental.checkify`` wrapper over the fused body for the
+  value-range facts that only exist inside the traced program (len2
+  within the padded bucket, codes within the alphabet, int32 prefix-cast
+  headroom).  Debug aid for new kernel work; not on the hot path.
+
+Adding a contract for a new entry point = one :class:`EntryContract`
+row in :data:`ENTRY_CONTRACTS`.  See ARCHITECTURE.md §9.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Sequence
+
+import numpy as np
+
+from . import (
+    ContractViolation,
+    ExactnessViolation,
+    FeedViolation,
+    RowpackViolation,
+    SuperblockViolation,
+)
+
+_LANE = 128
+_VAL_SIZE = 27 * 27  # ALPHABET_SIZE**2 flat substitution table
+
+
+# --------------------------------------------------------------------------
+# Concrete value-range gates (the --check tier).
+# --------------------------------------------------------------------------
+
+
+def check_feed(feed: str, maxv: int) -> None:
+    """``feed`` must be the feed ``mxu_feed`` affords for this weight
+    magnitude — a narrower feed silently truncates operands on the MXU."""
+    from ..ops.pallas_scorer import mxu_feed
+
+    if feed not in ("i8", "bf16", "f32"):
+        raise FeedViolation(
+            f"unknown MXU feed {feed!r}: legal feeds are 'i8', 'bf16', 'f32' "
+            "(ops/pallas_scorer.mxu_feed)"
+        )
+    afforded = mxu_feed(np.asarray([maxv], dtype=np.int64))
+    order = {"i8": 0, "bf16": 1, "f32": 2}
+    if order[feed] < order[afforded]:
+        raise FeedViolation(
+            f"feed {feed!r} cannot represent max|v|={maxv} exactly "
+            f"(i8 holds |v|<=127, bf16 |v|<=128); use feed {afforded!r} "
+            "from ops/pallas_scorer.mxu_feed(val_flat)"
+        )
+
+
+def check_exactness(maxv: int, l2p: int) -> None:
+    """f32-formulation exactness ceiling: every prefix partial of the
+    delta formulation is an integer bounded by ``2 * l2p * max|v|`` and
+    must stay below 2^24 (f32 integer-exact range); the gather int16
+    window additionally caps |v| at 32767.  Length-aware per PR 2."""
+    from ..ops.matmul_scorer import max_exact_value
+
+    ceiling = max_exact_value(l2p)
+    if maxv > ceiling:
+        raise ExactnessViolation(
+            f"max|v|={maxv} exceeds the f32 exactness ceiling "
+            f"max_exact_value(l2p={l2p})={ceiling}: prefix partials up to "
+            f"2*{l2p}*{maxv} would round in float32. Route this batch to "
+            "the gather formulation (dispatch auto-selects it; see "
+            "ops/matmul_scorer.max_exact_value)"
+        )
+
+
+def check_rowpack(feed: str, l2p: int, l2s: int | None, maxv: int) -> None:
+    """Row-packing preconditions: packing only exists for single
+    char-block buckets, l2s must be a legal sub-tile class for this
+    feed, and the packed epilogue key ``(t1 + gdec) * 2^klb + key``
+    needs the packed score magnitude ``3 * l2s * max|v|`` below 2^19."""
+    from ..ops.dispatch import pack_classes
+
+    if l2s is None:
+        return
+    if l2p != _LANE:
+        raise RowpackViolation(
+            f"row packing (l2s={l2s}) requires a single char-block bucket "
+            f"(L2P == {_LANE}), got L2P={l2p}: multi-block buckets walk "
+            "blocks per pair and cannot share tiles (dispatch.choose_rowpack)"
+        )
+    legal = pack_classes(feed, maxv)
+    if l2s not in legal:
+        if 3 * l2s * maxv >= 1 << 19:
+            raise RowpackViolation(
+                f"rowpack class l2s={l2s} breaches the packed int32 "
+                f"epilogue gate for feed {feed!r}: 3*{l2s}*{maxv} = "
+                f"{3 * l2s * maxv} >= 2^19 = {1 << 19}, so the packed "
+                f"argmax key would collide. Legal classes for max|v|={maxv}: "
+                f"{legal or '() — packing disabled at this magnitude'} "
+                "(dispatch.pack_classes)"
+            )
+        raise RowpackViolation(
+            f"rowpack class l2s={l2s} is not a legal sub-tile class for "
+            f"feed {feed!r} at max|v|={maxv}: legal classes are {legal} "
+            "(dispatch.pack_classes)"
+        )
+
+
+def check_superblock(nbn: int, sb: int | None) -> None:
+    """Superblock width must tile the offset-block count exactly and
+    stay within the packed argmax key budget (klb <= 12 => sb <= 24)."""
+    if sb is None:
+        return
+    if sb < 1 or nbn % sb != 0:
+        raise SuperblockViolation(
+            f"superblock sb={sb} does not tile the offset-block count "
+            f"nbn={nbn}: the kernel grid needs nbn % sb == 0 "
+            f"(divisors of {nbn} are legal; pallas_scorer.choose_superblock)"
+        )
+    if sb > 24:
+        raise SuperblockViolation(
+            f"superblock sb={sb} exceeds the packed argmax key bound "
+            "sb <= 24 (key bits klb <= 12 keep (t1+gdec)*2^klb+key inside "
+            "int32; pallas_scorer._superblock)"
+        )
+
+
+def validate_dispatch(
+    *,
+    feed: str,
+    maxv: int,
+    l1p: int,
+    l2p: int,
+    sb: int | None,
+    l2s: int | None,
+) -> None:
+    """Validate one CONCRETE pallas dispatch decision — the ``--check`` /
+    ``SEQALIGN_CHECK`` hook called from ``AlignmentScorer._score_local``
+    after the choosers have run.  Raises a distinct
+    :class:`ContractViolation` subclass per violated gate."""
+    check_feed(feed, maxv)
+    check_exactness(maxv, l2p)
+    check_rowpack(feed, l2p, l2s, maxv)
+    check_superblock(l1p // _LANE, sb)
+
+
+# --------------------------------------------------------------------------
+# Abstract entry-point contracts (eval_shape tier).
+# --------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class EntryContract:
+    """One scorer entry point and its declared abstract contract.
+
+    ``make`` returns ``(callable, args)`` for ``jax.eval_shape``;
+    ``out_shape``/``out_dtype`` declare the result aval.  Construction is
+    deferred into ``make`` so importing this module stays jax-light.
+    """
+
+    name: str
+    make: Callable[[int, int, int, int], tuple]  # (b, nc, l1p, l2p) ->
+    out_shape: Callable[[int, int, int, int], tuple]
+    out_dtype: str
+    doc: str = ""
+
+
+def _aval(shape: Sequence[int], dtype: str):
+    import jax
+
+    return jax.ShapeDtypeStruct(tuple(shape), np.dtype(dtype))
+
+
+def _chunk_args(b: int, nc: int, l1p: int, l2p: int) -> tuple:
+    """Abstract operands for the chunked [NC, CB, L2P] -> [NC, CB, 3]
+    bodies (cb = b // nc)."""
+    cb = b // nc
+    return (
+        _aval((l1p + l2p + 1,), "int32"),  # seq1ext
+        _aval((), "int32"),  # len1
+        _aval((nc, cb, l2p), "int32"),  # seq2_chunks
+        _aval((nc, cb), "int32"),  # len2_chunks
+        _aval((_VAL_SIZE,), "int32"),  # val_flat
+    )
+
+
+def _pair_args(b: int, nc: int, l1p: int, l2p: int) -> tuple:
+    """Abstract operands for the per-shard pair scorer
+    ([BL, L2P] -> [BL, 3])."""
+    return (
+        _aval((l1p + l2p + 1,), "int32"),
+        _aval((), "int32"),
+        _aval((b, l2p), "int32"),  # rows
+        _aval((b,), "int32"),  # lens
+        _aval((_VAL_SIZE,), "int32"),
+    )
+
+
+def _make_gather(b, nc, l1p, l2p):
+    from ..ops.xla_scorer import score_chunks_body
+
+    return score_chunks_body, _chunk_args(b, nc, l1p, l2p)
+
+
+def _make_mm(b, nc, l1p, l2p):
+    from ..ops.matmul_scorer import score_chunks_mm_body
+
+    return score_chunks_mm_body, _chunk_args(b, nc, l1p, l2p)
+
+
+def _make_pallas(b, nc, l1p, l2p):
+    import functools
+
+    from ..ops.pallas_scorer import score_chunks_pallas_body
+
+    # interpret-free: eval_shape never runs the kernel, only shapes it.
+    fn = functools.partial(score_chunks_pallas_body, feed="f32")
+    return fn, _chunk_args(b, nc, l1p, l2p)
+
+
+def _make_pair(b, nc, l1p, l2p):
+    from ..ops.pallas_scorer import pallas_pair_scorer
+
+    return pallas_pair_scorer(l1p, l2p, "f32", None), _pair_args(
+        b, nc, l1p, l2p
+    )
+
+
+def _make_shard_map(b, nc, l1p, l2p):
+    """The BatchSharding shard_map wrapper, over however many devices the
+    host exposes (CPU CI: the analyze driver forces 8 virtual devices)."""
+    import jax
+
+    from ..parallel.mesh import make_mesh
+    from ..parallel.sharding import _sharded_fn
+
+    mesh = make_mesh()
+    ndev = len(mesh.devices.ravel())
+    bp = max(b, ndev)  # at least one row per device
+    bp += (-bp) % ndev
+    cb = max(1, bp // ndev)
+    fn = _sharded_fn(mesh, cb, ("pallas", l1p, l2p, "f32", None))
+    return fn, _pair_args(bp, nc, l1p, l2p)
+
+
+def _chunk_out(b, nc, l1p, l2p):
+    return (nc, b // nc, 3)
+
+
+def _pair_out(b, nc, l1p, l2p):
+    return (b, 3)
+
+
+def _shard_out(b, nc, l1p, l2p):
+    import jax
+
+    ndev = jax.device_count()
+    bp = max(b, ndev)
+    bp += (-bp) % ndev
+    return (bp, 3)
+
+
+ENTRY_CONTRACTS: tuple[EntryContract, ...] = (
+    EntryContract(
+        name="xla_scorer.score_chunks_body",
+        make=_make_gather,
+        out_shape=_chunk_out,
+        out_dtype="int32",
+        doc="gather formulation, [NC,CB,L2P] -> [NC,CB,3] int32",
+    ),
+    EntryContract(
+        name="matmul_scorer.score_chunks_mm_body",
+        make=_make_mm,
+        out_shape=_chunk_out,
+        out_dtype="int32",
+        doc="matmul delta formulation, [NC,CB,L2P] -> [NC,CB,3] int32",
+    ),
+    EntryContract(
+        name="pallas_scorer.score_chunks_pallas_body",
+        make=_make_pallas,
+        out_shape=_chunk_out,
+        out_dtype="int32",
+        doc="fused pallas body, [NC,CB,L2P] -> [NC,CB,3] int32",
+    ),
+    EntryContract(
+        name="pallas_scorer.pallas_pair_scorer",
+        make=_make_pair,
+        out_shape=_pair_out,
+        out_dtype="int32",
+        doc="per-shard pair callable, [BL,L2P] -> [BL,3] int32",
+    ),
+    EntryContract(
+        name="sharding._sharded_fn (shard_map wrapper)",
+        make=_make_shard_map,
+        out_shape=_shard_out,
+        out_dtype="int32",
+        doc="jitted shard_map scorer over the host mesh, [BP,L2P] -> [BP,3]",
+    ),
+)
+
+# Representative shape buckets: the 128-aligned pallas regime, a
+# multi-block wide bucket, and a tiny non-aligned bucket (mm fallback
+# inside the pallas body).
+_AUDIT_BUCKETS: tuple[tuple[int, int, int, int], ...] = (
+    # (b, nc, l1p, l2p)
+    (8, 2, 512, 128),
+    (16, 4, 3072, 2048),
+    (4, 1, 200, 40),
+)
+
+
+def audit_entry_points(buckets=_AUDIT_BUCKETS) -> list[str]:
+    """``jax.eval_shape`` every registered entry point over the audit
+    buckets and verify the output aval.  Returns human-readable report
+    rows; raises :class:`ContractViolation` on the first mismatch."""
+    import jax
+
+    rows = []
+    for contract in ENTRY_CONTRACTS:
+        for b, nc, l1p, l2p in buckets:
+            fn, args = contract.make(b, nc, l1p, l2p)
+            try:
+                out = jax.eval_shape(fn, *args)
+            except ContractViolation:
+                raise
+            except Exception as exc:  # noqa: BLE001 - re-raise with context
+                raise ContractViolation(
+                    f"{contract.name} failed abstract evaluation at bucket "
+                    f"(b={b}, nc={nc}, l1p={l1p}, l2p={l2p}): {exc!r}"
+                ) from exc
+            want_shape = tuple(contract.out_shape(b, nc, l1p, l2p))
+            want_dtype = np.dtype(contract.out_dtype)
+            got_shape = tuple(out.shape)
+            got_dtype = np.dtype(out.dtype)
+            if got_shape != want_shape or got_dtype != want_dtype:
+                raise ContractViolation(
+                    f"{contract.name}: output contract mismatch at bucket "
+                    f"(b={b}, nc={nc}, l1p={l1p}, l2p={l2p}): declared "
+                    f"{want_shape} {want_dtype}, traced {got_shape} "
+                    f"{got_dtype}"
+                )
+            rows.append(
+                f"{contract.name:<45s} (b={b:>3d}, l1p={l1p:>5d}, "
+                f"l2p={l2p:>5d}) -> {got_shape} {got_dtype} OK"
+            )
+    return rows
+
+
+# --------------------------------------------------------------------------
+# checkify tier: traced value-range checks.
+# --------------------------------------------------------------------------
+
+
+def checked_pallas_body(feed: str = "f32", sb: int | None = None):
+    """Wrap the fused body in ``jax.experimental.checkify`` asserts over
+    facts only visible on traced values: chunk lengths within the padded
+    bucket, codes within the alphabet, and weights within the int32
+    prefix-cast headroom.  Returns ``fn(args...) -> (err, out)``; call
+    ``err.throw()`` to surface violations.  The checks run in a
+    checkified PROLOGUE over the inputs only — checkify cannot discharge
+    its error state through ``pallas_call``'s aliased refs, so the
+    kernel itself is invoked outside the transform.  Debug tool for
+    kernel work — the hot path stays checkify-free."""
+    import jax.numpy as jnp
+    from jax.experimental import checkify
+
+    from ..ops.matmul_scorer import max_exact_value
+    from ..ops.pallas_scorer import score_chunks_pallas_body
+
+    def prologue(seq2_chunks, len2_chunks, val_flat):
+        l2p = seq2_chunks.shape[-1]
+        checkify.check(
+            jnp.all(len2_chunks <= l2p),
+            "len2 {m} exceeds the padded bucket width "  # noqa: UP032
+            + str(l2p)
+            + " (rows would read past the chunk)",
+            m=jnp.max(len2_chunks),
+        )
+        checkify.check(
+            jnp.all((seq2_chunks >= 0) & (seq2_chunks < 27)),
+            "seq2 codes outside the alphabet [0, 27)",
+        )
+        ceiling = max_exact_value(l2p)
+        absmax = jnp.max(jnp.abs(val_flat))
+        checkify.check(
+            absmax <= ceiling,
+            "max|v| {m} exceeds max_exact_value(l2p="
+            + str(l2p)
+            + ")="
+            + str(ceiling)
+            + ": f32 prefix partials would round / int32 prefix cast "
+            "would overflow",
+            m=absmax,
+        )
+        return 0
+
+    checked_prologue = checkify.checkify(prologue)
+
+    def fn(seq1ext, len1, seq2_chunks, len2_chunks, val_flat):
+        err, _ = checked_prologue(seq2_chunks, len2_chunks, val_flat)
+        out = score_chunks_pallas_body(
+            seq1ext, len1, seq2_chunks, len2_chunks, val_flat, feed=feed,
+            sb=sb,
+        )
+        return err, out
+
+    return fn

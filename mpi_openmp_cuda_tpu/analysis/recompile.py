@@ -1,0 +1,112 @@
+"""Jit cache-miss counting harness (the recompile detector).
+
+The bucketed schedule exists so a production stream compiles each shape
+bucket ONCE and then stays on the fast path; a stray recompile (a
+closure captured as a traced constant, a non-hashable static arg, a
+drifting weak_type) silently multiplies serving latency without failing
+any correctness test.  This harness turns the compile count into a
+pinned, assertable number.
+
+Signal: ``jax.monitoring``'s ``/jax/core/compile/backend_compile_duration``
+event fires exactly once per real XLA/Mosaic backend compilation (cache
+hits — both in-memory jit cache and the persistent compilation cache —
+do not fire it).  jax 0.4.x has no listener-unregister API, so ONE
+module-level listener increments a process-global counter and the
+context manager reports deltas.
+
+Caveats for test authors:
+
+* Helper ops (``jnp.ones`` etc.) compile tiny programs too — pin
+  *deltas around warmed code paths* (steady-state zero; deterministic
+  repeat counts after ``jax.clear_caches()``), not absolute magic
+  numbers for cold processes.
+* The persistent compile cache must be off (the test conftest disables
+  it) or cold counts become machine-dependent.
+"""
+
+from __future__ import annotations
+
+import contextlib
+
+from . import SeqcheckError
+
+_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+
+_state = {"registered": False, "count": 0}
+
+
+def _listener(event: str, duration: float, **kwargs) -> None:
+    if event == _COMPILE_EVENT:
+        _state["count"] += 1
+
+
+def _ensure_registered() -> None:
+    if _state["registered"]:
+        return
+    from jax import monitoring
+
+    monitoring.register_event_duration_secs_listener(_listener)
+    _state["registered"] = True
+
+
+def compile_count() -> int:
+    """Process-global backend-compilation count since the harness was
+    first armed (monotonic; compare deltas, not absolutes)."""
+    _ensure_registered()
+    return _state["count"]
+
+
+class CompileTally:
+    """Result handle for :func:`count_compiles`: ``.count`` is live
+    inside the block and frozen after it."""
+
+    def __init__(self, start: int):
+        self._start = start
+        self._end: int | None = None
+
+    @property
+    def count(self) -> int:
+        end = self._end if self._end is not None else _state["count"]
+        return end - self._start
+
+    def _freeze(self) -> None:
+        self._end = _state["count"]
+
+
+@contextlib.contextmanager
+def count_compiles():
+    """``with count_compiles() as tally:`` — ``tally.count`` is the
+    number of backend compilations triggered inside the block."""
+    _ensure_registered()
+    tally = CompileTally(_state["count"])
+    try:
+        yield tally
+    finally:
+        tally._freeze()
+
+
+@contextlib.contextmanager
+def assert_compiles(expected: int | None = None, *, at_most: int | None = None):
+    """Pin the compilations of a block: exact (``expected``) or bounded
+    (``at_most``).  Raises :class:`SeqcheckError` naming the breach —
+    the steady-state form is ``assert_compiles(0)`` around a warmed
+    scoring call."""
+    if (expected is None) == (at_most is None):
+        raise ValueError("pass exactly one of expected= / at_most=")
+    with count_compiles() as tally:
+        yield tally
+    n = tally.count
+    if expected is not None and n != expected:
+        raise SeqcheckError(
+            f"recompile detector: block compiled {n} program(s), pinned "
+            f"expectation is {expected}. A higher count means a jit "
+            "cache miss slipped in (unhashed static arg, traced-constant "
+            "closure, dtype/weak_type drift); lower means the pin is "
+            "stale — update it WITH the dispatch change that removed the "
+            "compilation."
+        )
+    if at_most is not None and n > at_most:
+        raise SeqcheckError(
+            f"recompile detector: block compiled {n} program(s), bound "
+            f"is {at_most}: a jit cache miss slipped into a warmed path."
+        )

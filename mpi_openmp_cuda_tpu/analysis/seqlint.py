@@ -1,0 +1,385 @@
+"""Repo-specific AST lint (``seqlint``).
+
+Generic linters cannot know that ``.item()`` inside a traced scoring
+body forces a device sync, that env reads outside the platform registry
+fragment configuration, or that a wall-clock read inside the resilience
+decision paths breaks replay determinism.  These rules encode THIS
+repo's conventions:
+
+=======  ==================================================================
+SEQ001   no host-sync (``.item()`` / ``np.asarray`` / ``np.array`` /
+         ``float()``/``int()`` on expressions) inside traced scoring
+         paths (ops/ and parallel/ kernel & body functions) — each one
+         stalls the device pipeline per call.
+SEQ002   no ``os.environ`` / ``os.getenv`` outside ``utils/platform.py``
+         — all knobs go through the typed env registry so ``--help`` and
+         the docs can enumerate them (PR 3 satellite).
+SEQ003   no Python ``if``/``while`` on traced intermediates inside
+         traced scoring paths — tracing turns them into
+         ``TracerBoolConversionError`` at best, silent per-shape
+         recompiles at worst; use ``lax.cond``/``jnp.where``.
+SEQ004   no bare ``assert`` in runtime paths (the package) — asserts
+         vanish under ``python -O``; raise ``RuntimeError`` with an
+         actionable message instead (codifies PR 1's migration).
+SEQ005   no wall-clock reads (``time.time``/``monotonic``/
+         ``perf_counter`` / ``datetime.now``) in the deterministic
+         resilience / journal decision paths — fault injection and
+         replay must be time-independent (``time.sleep`` is fine: it
+         delays, it does not decide).
+=======  ==================================================================
+
+Suppression: append ``# seqlint: disable=SEQ00N`` to the offending line
+(multiple codes comma-separated).  A file-level
+``# seqlint: disable-file=SEQ00N`` in the first ten lines suppresses a
+rule for the whole file.  ``analysis/`` itself must stay
+suppression-free (ISSUE 3 acceptance).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import re
+from pathlib import Path
+
+from . import LintError
+
+#: Functions considered "traced scoring paths" for SEQ001/SEQ003: the
+#: kernel bodies, the chunked-batch bodies, and the nested shard_map /
+#: loop-body callables in ops/ and parallel/.
+_TRACED_NAME_RE = re.compile(
+    r"^(_kernel\w*|_pair|\w*_body|local_fn|fn|cands|ibody\w*|nbody|"
+    r"prologue|step|combine|inner)$"
+)
+
+#: Modules whose traced functions SEQ001/SEQ003 police.
+_TRACED_DIRS = ("ops", "parallel")
+
+#: Modules whose DECISIONS must be wall-clock-free (SEQ005).
+_DETERMINISTIC_PATHS = ("resilience/", "utils/journal.py")
+
+#: The single legal home for environment reads (SEQ002).
+_ENV_HOME = "utils/platform.py"
+
+_WALLCLOCK_ATTRS = {
+    ("time", "time"),
+    ("time", "monotonic"),
+    ("time", "perf_counter"),
+    ("time", "process_time"),
+    ("time", "time_ns"),
+    ("time", "monotonic_ns"),
+    ("datetime", "now"),
+    ("datetime", "utcnow"),
+    ("date", "today"),
+}
+
+_SUPPRESS_RE = re.compile(r"#\s*seqlint:\s*disable=([A-Z0-9, ]+)")
+_SUPPRESS_FILE_RE = re.compile(r"#\s*seqlint:\s*disable-file=([A-Z0-9, ]+)")
+
+
+@dataclasses.dataclass(frozen=True)
+class LintFinding:
+    code: str
+    path: str
+    line: int
+    message: str
+
+    def describe(self) -> str:
+        return f"{self.path}:{self.line}: {self.code} {self.message}"
+
+
+def _suppressions(source: str):
+    """Per-line and file-level rule suppressions from comments."""
+    per_line: dict[int, set[str]] = {}
+    file_level: set[str] = set()
+    for i, text in enumerate(source.splitlines(), start=1):
+        m = _SUPPRESS_RE.search(text)
+        if m:
+            per_line[i] = {c.strip() for c in m.group(1).split(",")}
+        if i <= 10:
+            m = _SUPPRESS_FILE_RE.search(text)
+            if m:
+                file_level |= {c.strip() for c in m.group(1).split(",")}
+    return per_line, file_level
+
+
+class _Scope:
+    """One function scope: whether it is a traced scoring path, and
+    which local names hold traced intermediates (assigned from jnp/lax/
+    pl/pltpu calls or from the function's array-like parameters)."""
+
+    def __init__(self, name: str, traced: bool):
+        self.name = name
+        self.traced = traced
+        self.traced_names: set[str] = set()
+
+
+_TRACED_MODULES = {"jnp", "lax", "pl", "pltpu", "jax", "checkify"}
+
+
+def _is_traced_expr(node: ast.AST, scope: _Scope) -> bool:
+    """Conservatively: does this expression involve a traced value —
+    a jnp/lax/... call, or a name previously assigned from one?"""
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Name) and sub.id in scope.traced_names:
+            return True
+        if isinstance(sub, ast.Call):
+            root = sub.func
+            while isinstance(root, ast.Attribute):
+                root = root.value
+            if isinstance(root, ast.Name) and root.id in _TRACED_MODULES:
+                return True
+    return False
+
+
+class _Linter(ast.NodeVisitor):
+    def __init__(self, path: str, rel: str, source: str):
+        self.path = path
+        self.rel = rel
+        self.findings: list[LintFinding] = []
+        self.per_line, self.file_level = _suppressions(source)
+        self.scopes: list[_Scope] = []
+        parts = Path(rel).parts
+        self.in_traced_dir = len(parts) > 1 and parts[1] in _TRACED_DIRS
+        self.is_env_home = rel.endswith(_ENV_HOME)
+        self.in_deterministic = any(
+            p in rel for p in _DETERMINISTIC_PATHS
+        )
+
+    # -- bookkeeping -------------------------------------------------------
+
+    def _emit(self, code: str, node: ast.AST, message: str):
+        line = getattr(node, "lineno", 0)
+        if code in self.file_level or code in self.per_line.get(line, ()):
+            return
+        self.findings.append(LintFinding(code, self.rel, line, message))
+
+    def _enter_function(self, node):
+        traced = self.in_traced_dir and bool(
+            _TRACED_NAME_RE.match(node.name)
+        )
+        self.scopes.append(_Scope(node.name, traced))
+        self.generic_visit(node)
+        self.scopes.pop()
+
+    visit_FunctionDef = _enter_function
+    visit_AsyncFunctionDef = _enter_function
+
+    @property
+    def scope(self) -> _Scope | None:
+        for s in reversed(self.scopes):
+            if s.traced:
+                return s
+        return None
+
+    # -- SEQ004: bare assert ----------------------------------------------
+
+    def visit_Assert(self, node: ast.Assert):
+        self._emit(
+            "SEQ004",
+            node,
+            "bare assert in a runtime path vanishes under python -O; "
+            "raise RuntimeError with an actionable message",
+        )
+        self.generic_visit(node)
+
+    # -- SEQ003 state: track traced intermediates --------------------------
+
+    def visit_Assign(self, node: ast.Assign):
+        scope = self.scope
+        if scope is not None and _is_traced_expr(node.value, scope):
+            for tgt in node.targets:
+                for sub in ast.walk(tgt):
+                    if isinstance(sub, ast.Name):
+                        scope.traced_names.add(sub.id)
+        self.generic_visit(node)
+
+    # -- SEQ003: Python branch on traced values ----------------------------
+
+    def _check_branch(self, node):
+        scope = self.scope
+        if scope is not None and _is_traced_expr(node.test, scope):
+            self._emit(
+                "SEQ003",
+                node,
+                f"Python branch on a traced value in `{scope.name}`: "
+                "tracing cannot follow host control flow — use lax.cond "
+                "/ lax.select / jnp.where",
+            )
+        self.generic_visit(node)
+
+    visit_If = _check_branch
+    visit_While = _check_branch
+
+    # -- SEQ001 / SEQ002 / SEQ005: calls -----------------------------------
+
+    def visit_Call(self, node: ast.Call):
+        func = node.func
+        scope = self.scope
+
+        # SEQ001: host-sync inside traced scoring paths.
+        if scope is not None:
+            if isinstance(func, ast.Attribute) and func.attr == "item":
+                self._emit(
+                    "SEQ001",
+                    node,
+                    f".item() in traced path `{scope.name}` forces a "
+                    "device->host sync per call; keep the value on device",
+                )
+            if (
+                isinstance(func, ast.Attribute)
+                and isinstance(func.value, ast.Name)
+                and func.value.id == "np"
+                and func.attr in ("asarray", "array")
+            ):
+                self._emit(
+                    "SEQ001",
+                    node,
+                    f"np.{func.attr}() in traced path `{scope.name}` "
+                    "materialises the operand on host; use jnp",
+                )
+            if (
+                isinstance(func, ast.Name)
+                and func.id in ("float", "int")
+                and node.args
+                and not isinstance(node.args[0], ast.Constant)
+                and _is_traced_expr(node.args[0], scope)
+            ):
+                self._emit(
+                    "SEQ001",
+                    node,
+                    f"{func.id}() on a traced value in `{scope.name}` "
+                    "forces a host sync; use .astype()/jnp casts",
+                )
+
+        # SEQ002: env reads outside the registry.
+        if not self.is_env_home:
+            is_environ = (
+                isinstance(func, ast.Attribute)
+                and isinstance(func.value, ast.Attribute)
+                and isinstance(func.value.value, ast.Name)
+                and func.value.value.id == "os"
+                and func.value.attr == "environ"
+            )  # os.environ.get(...)
+            is_getenv = (
+                isinstance(func, ast.Attribute)
+                and isinstance(func.value, ast.Name)
+                and func.value.id == "os"
+                and func.attr == "getenv"
+            ) or (isinstance(func, ast.Name) and func.id == "getenv")
+            if is_environ or is_getenv:
+                self._emit(
+                    "SEQ002",
+                    node,
+                    "environment read outside utils/platform.py; add the "
+                    "variable to the env registry (utils.platform) and "
+                    "use its typed accessor",
+                )
+
+        # SEQ005: wall-clock in deterministic paths.
+        if self.in_deterministic and isinstance(func, ast.Attribute):
+            base = func.value
+            if (
+                isinstance(base, ast.Name)
+                and (base.id, func.attr) in _WALLCLOCK_ATTRS
+            ) or (
+                isinstance(base, ast.Attribute)
+                and (base.attr, func.attr) in _WALLCLOCK_ATTRS
+            ):
+                self._emit(
+                    "SEQ005",
+                    node,
+                    "wall-clock read in a deterministic resilience/"
+                    "journal path; decisions must replay identically — "
+                    "derive from the seeded policy state instead",
+                )
+        self.generic_visit(node)
+
+    # -- SEQ002: os.environ subscripts / membership ------------------------
+
+    def visit_Subscript(self, node: ast.Subscript):
+        if not self.is_env_home:
+            v = node.value
+            if (
+                isinstance(v, ast.Attribute)
+                and isinstance(v.value, ast.Name)
+                and v.value.id == "os"
+                and v.attr == "environ"
+            ):
+                self._emit(
+                    "SEQ002",
+                    node,
+                    "environment read outside utils/platform.py; add the "
+                    "variable to the env registry (utils.platform) and "
+                    "use its typed accessor",
+                )
+        self.generic_visit(node)
+
+    def visit_Compare(self, node: ast.Compare):
+        # `"X" in os.environ` membership probes count as reads too.
+        if not self.is_env_home:
+            for cmp_node, op in zip(node.comparators, node.ops):
+                if (
+                    isinstance(op, (ast.In, ast.NotIn))
+                    and isinstance(cmp_node, ast.Attribute)
+                    and isinstance(cmp_node.value, ast.Name)
+                    and cmp_node.value.id == "os"
+                    and cmp_node.attr == "environ"
+                ):
+                    self._emit(
+                        "SEQ002",
+                        node,
+                        "os.environ membership probe outside "
+                        "utils/platform.py; use the env registry's typed "
+                        "accessor (utils.platform)",
+                    )
+        self.generic_visit(node)
+
+
+def lint_file(path: str | Path, package_root: str | Path) -> list[LintFinding]:
+    path = Path(path)
+    rel = str(path.relative_to(Path(package_root).parent))
+    source = path.read_text()
+    try:
+        tree = ast.parse(source, filename=str(path))
+    except SyntaxError as exc:
+        return [
+            LintFinding("SEQ000", rel, exc.lineno or 0, f"syntax error: {exc}")
+        ]
+    linter = _Linter(str(path), rel, source)
+    linter.visit(tree)
+    return sorted(linter.findings, key=lambda f: (f.path, f.line, f.code))
+
+
+def lint_package(package_root: str | Path | None = None) -> list[LintFinding]:
+    """Lint every module of the installed package tree.  scripts/ and
+    tests/ are host-side tooling, outside the runtime rules' scope."""
+    if package_root is None:
+        package_root = Path(__file__).resolve().parent.parent
+    package_root = Path(package_root)
+    findings: list[LintFinding] = []
+    for path in sorted(package_root.rglob("*.py")):
+        if "__pycache__" in path.parts:
+            continue
+        findings.extend(lint_file(path, package_root))
+    return findings
+
+
+def run_or_raise(package_root: str | Path | None = None) -> int:
+    """Driver entry: lint the package, raise :class:`LintError` listing
+    every finding, return the number of files checked when clean."""
+    if package_root is None:
+        package_root = Path(__file__).resolve().parent.parent
+    findings = lint_package(package_root)
+    if findings:
+        rows = "\n  ".join(f.describe() for f in findings)
+        raise LintError(
+            f"seqlint: {len(findings)} violation(s):\n  {rows}\n"
+            "Fix the violation or suppress a justified case with "
+            "`# seqlint: disable=<code>` (see ARCHITECTURE.md §9)."
+        )
+    return sum(
+        1
+        for p in Path(package_root).rglob("*.py")
+        if "__pycache__" not in p.parts
+    )

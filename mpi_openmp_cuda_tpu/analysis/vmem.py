@@ -1,0 +1,293 @@
+"""Static per-config VMEM footprint model for the fused Pallas kernel.
+
+PR 2's lesson: the f32 wide-walk rejection ("double-width f32 tiles
+spill VMEM") sat unmeasured in the chooser for a full PR cycle.  This
+pass makes the memory story a machine-checked artifact: the footprint
+of every configuration the dispatch choosers can EMIT is modelled
+statically — from the same parameters that build the ``BlockSpec``s in
+``_pallas_call`` / ``_pallas_call_packed`` — and
+:func:`audit_chooser_space` fails CI if any emitted config exceeds the
+per-core budget.  Runs in milliseconds on CPU; no TPU, no tracing.
+
+The model (all byte counts; ``_BLK = 128`` rows throughout):
+
+* **Resident A** — the value-expanded Seq1 band is grid-invariant
+  (constant BlockSpec index map), so exactly one copy lives in VMEM for
+  the whole grid.  Pre-tiled layout: ``slots * 128 * bandw * itemsize``
+  (the literal ``_pretile_ok`` expression, capped at its 8 MiB budget);
+  flat fallback: ``128 * wneed * itemsize``.
+* **Streamed blocks** — the codes and output blocks vary with the grid
+  index, so Pallas double-buffers them: 2x ``pp * nbi * 128 * 4`` in,
+  2x ``pp * 128 * 4`` out.
+* **Kernel working set** — per interleaved tile ("half"), the maximum
+  over the stage pipeline: stage 2's rotate holds source + destination
+  accumulators (``2 * 128 * bandw * 4``); stage 3 holds the sheared
+  accumulator, its feed-dtype copy, and two prefix surfaces
+  (``128 * bandw * (4 + item) + 2 * 128 * sbw * 4``).  The flat-A path
+  adds the dynamic lane-slice band copy.  Halves run stage-locked
+  (stage-major interleave), so the working set is ADDITIVE across
+  ``wide``.  ``pp`` pairs are sequential and reuse the working set.
+
+The model is intentionally an upper-bound estimate of *data* in VMEM —
+Mosaic's register allocation and op fusion can only shrink it — so a
+config passing here has genuine headroom, and the historically measured
+spills sit where the model says pressure peaks (the 4-wide f32 walk at
+sb >= 8 models at ~2x the 2-wide working set that measured clean).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+
+from . import VmemBudgetError
+
+_BLK = 128
+#: Per-core VMEM capacity (the pallas guide's ~16 MB/core figure).
+VMEM_BUDGET_BYTES = 16 << 20
+
+_ITEM = {"i8": 1, "bf16": 2, "f32": 4}
+
+#: Shape caps of the bucketed schedule: BUF_SIZE_SEQ1 = 3000 -> l1p <=
+#: 3072 (nbn <= 24), BUF_SIZE_SEQ2 = 2000 -> l2p <= 2048 (nbi <= 16).
+MAX_NBN = 24
+MAX_NBI = 16
+
+#: Representative weight magnitudes per feed for the rowpack sweep: the
+#: feed boundaries plus the f32 exactness milestones (static 4095
+#: ceiling, length-aware 32767 cap at l2p = 128).
+_FEED_MAXV = {
+    "i8": (127,),
+    "bf16": (128,),
+    "f32": (129, 1000, 4095, 32767),
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class VmemEstimate:
+    """Modelled footprint of one kernel configuration."""
+
+    kind: str  # 'unpacked' | 'packed'
+    feed: str
+    nbn: int
+    nbi: int
+    sb: int
+    pp: int  # pairs per grid cell (unpacked) / p pairs per tile (packed)
+    l2s: int | None  # rowpack class (packed only)
+    pretiled: bool
+    a_bytes: int
+    stream_bytes: int
+    working_bytes: int
+
+    @property
+    def total_bytes(self) -> int:
+        return self.a_bytes + self.stream_bytes + self.working_bytes
+
+    @property
+    def headroom_bytes(self) -> int:
+        return VMEM_BUDGET_BYTES - self.total_bytes
+
+    def describe(self) -> str:
+        mib = self.total_bytes / (1 << 20)
+        return (
+            f"{self.kind:<8s} feed={self.feed:<4s} nbn={self.nbn:>2d} "
+            f"nbi={self.nbi:>2d} sb={self.sb:>2d} pp={self.pp} "
+            f"l2s={self.l2s or '-':>2} "
+            f"{'pretiled' if self.pretiled else 'flat':>8s} "
+            f"total={mib:6.2f} MiB "
+            f"(A={self.a_bytes >> 10} KiB, stream="
+            f"{self.stream_bytes >> 10} KiB, work="
+            f"{self.working_bytes >> 10} KiB)"
+        )
+
+
+def estimate_unpacked(
+    nbn: int, nbi: int, feed: str, sb: int, pp: int
+) -> VmemEstimate:
+    """Model one ``_pallas_call`` configuration (the [B, L2P] kernel)."""
+    from ..ops.pallas_scorer import _pretile_ok
+
+    item = _ITEM[feed]
+    sbw = sb * _BLK
+    bandw = sbw + _BLK
+    wneed = (nbn + nbi) * _BLK
+    pretiled = _pretile_ok(nbn, nbi, feed, sb)
+
+    if pretiled:
+        slots = (nbn // sb) * nbi
+        a_bytes = slots * _BLK * bandw * item
+    else:
+        a_bytes = _BLK * wneed * item
+
+    # Double-buffered streamed blocks (grid-varying index maps).
+    codes = pp * nbi * _BLK * 1 * 4
+    out = pp * 1 * _BLK * 4
+    stream_bytes = 2 * (codes + out)
+
+    # Per-half stage peak (see module docstring); halves are additive.
+    wide = 1 if nbi == 1 else 2
+    flat_copy = 0 if pretiled else _BLK * bandw * item
+    stage2 = 2 * _BLK * bandw * 4
+    stage3 = _BLK * bandw * (4 + item) + 2 * _BLK * sbw * 4
+    working_bytes = wide * (max(stage2, stage3) + flat_copy)
+
+    return VmemEstimate(
+        kind="unpacked",
+        feed=feed,
+        nbn=nbn,
+        nbi=nbi,
+        sb=sb,
+        pp=pp,
+        l2s=None,
+        pretiled=pretiled,
+        a_bytes=a_bytes,
+        stream_bytes=stream_bytes,
+        working_bytes=working_bytes,
+    )
+
+
+def estimate_packed(nbn: int, feed: str, sb: int, l2s: int) -> VmemEstimate:
+    """Model one ``_pallas_call_packed`` configuration (nbi == 1,
+    p = 128 // l2s pairs per tile)."""
+    from ..ops.pallas_scorer import _pretile_ok
+
+    item = _ITEM[feed]
+    sbw = sb * _BLK
+    w = sbw + _BLK
+    wneed = (nbn + 1) * _BLK
+    p = _BLK // l2s
+    pretiled = _pretile_ok(nbn, 1, feed, sb)
+
+    if pretiled:
+        slots = nbn // sb
+        a_bytes = slots * _BLK * w * item
+    else:
+        a_bytes = _BLK * wneed * item
+
+    codes = 1 * 1 * _BLK * 1 * 4
+    out = p * 1 * _BLK * 4
+    stream_bytes = 2 * (codes + out)
+
+    # Packed pipeline peak: P, rollP, g, gpack coexist as full-W int32
+    # surfaces after the prefix matmul; the rotate's src/dst pair and the
+    # feed-dtype narrowed copy peak lower.
+    flat_copy = 0 if pretiled else _BLK * w * item
+    rotate = 2 * _BLK * w * 4
+    epilogue = 4 * _BLK * w * 4
+    working_bytes = max(rotate + _BLK * w * item, epilogue) + flat_copy
+
+    return VmemEstimate(
+        kind="packed",
+        feed=feed,
+        nbn=nbn,
+        nbi=1,
+        sb=sb,
+        pp=p,
+        l2s=l2s,
+        pretiled=pretiled,
+        a_bytes=a_bytes,
+        stream_bytes=stream_bytes,
+        working_bytes=working_bytes,
+    )
+
+
+def fits_budget(
+    nbn: int,
+    nbi: int,
+    feed: str,
+    sb: int,
+    pp: int = 2,
+    budget: int = VMEM_BUDGET_BYTES,
+) -> bool:
+    """Feasibility predicate consumed by the chooser's candidate filter
+    (``pallas_scorer.emittable_superblocks``): does the worst-case
+    (pp = 2) modelled footprint of this unpacked config fit the per-core
+    budget?  The packed kernel needs no gate: at nbi == 1 every sb <= 24
+    models under budget for all feeds and classes (verified by the
+    exhaustive sweep)."""
+    return estimate_unpacked(nbn, nbi, feed, sb, pp).total_bytes <= budget
+
+
+def iter_chooser_space():
+    """Yield a :class:`VmemEstimate` for every configuration the
+    dispatch choosers can emit across the bucketed schedule's shape caps
+    (all feeds, packed and unpacked, both pp parities).  The emittable
+    super-block set comes from the chooser's own candidate enumeration
+    (``pallas_scorer.emittable_superblocks``), so a chooser change that
+    widens the space is automatically re-audited."""
+    from ..ops.dispatch import pack_classes
+    from ..ops.pallas_scorer import emittable_superblocks
+
+    for nbn, nbi in itertools.product(
+        range(1, MAX_NBN + 1), range(1, MAX_NBI + 1)
+    ):
+        for feed in ("i8", "bf16", "f32"):
+            for sb in emittable_superblocks(nbn, nbi, feed):
+                for pp in (1, 2):
+                    yield estimate_unpacked(nbn, nbi, feed, sb, pp)
+
+    # Row-packed kernel: single char-block buckets only (l2p == 128).
+    for nbn in range(1, MAX_NBN + 1):
+        for feed, maxvs in _FEED_MAXV.items():
+            classes = set()
+            for maxv in maxvs:
+                classes.update(pack_classes(feed, maxv))
+            for sb in emittable_superblocks(nbn, 1, feed):
+                for l2s in sorted(classes):
+                    yield estimate_packed(nbn, feed, sb, l2s)
+
+
+def audit_chooser_space(budget: int = VMEM_BUDGET_BYTES):
+    """Exhaustively sweep the chooser space against ``budget``.
+
+    Returns ``(n_configs, worst)`` where ``worst`` is the
+    :class:`VmemEstimate` with the least headroom; raises
+    :class:`VmemBudgetError` listing every over-budget config (capped at
+    20 rows) if the sweep finds any."""
+    over: list[VmemEstimate] = []
+    worst: VmemEstimate | None = None
+    n = 0
+    for est in iter_chooser_space():
+        n += 1
+        if worst is None or est.total_bytes > worst.total_bytes:
+            worst = est
+        if est.total_bytes > budget:
+            over.append(est)
+    if worst is None:
+        raise VmemBudgetError("chooser sweep yielded no configurations")
+    if over:
+        over.sort(key=lambda e: -e.total_bytes)
+        rows = "\n  ".join(e.describe() for e in over[:20])
+        more = f"\n  ... and {len(over) - 20} more" if len(over) > 20 else ""
+        raise VmemBudgetError(
+            f"{len(over)} of {n} emittable kernel configs exceed the "
+            f"{budget >> 20} MiB per-core VMEM budget:\n  {rows}{more}\n"
+            "Shrink the offending config's superblock/pretile footprint "
+            "or gate it out in ops/dispatch (choose_superblock / "
+            "pack_classes) before it reaches hardware."
+        )
+    return n, worst
+
+
+def check_config(
+    *,
+    nbn: int,
+    nbi: int,
+    feed: str,
+    sb: int,
+    pp: int = 2,
+    l2s: int | None = None,
+    budget: int = VMEM_BUDGET_BYTES,
+) -> VmemEstimate:
+    """Model ONE concrete config (the ``--check`` dispatch hook) and
+    raise :class:`VmemBudgetError` if it exceeds ``budget``."""
+    if l2s is not None:
+        est = estimate_packed(nbn, feed, sb, l2s)
+    else:
+        est = estimate_unpacked(nbn, nbi, feed, sb, pp)
+    if est.total_bytes > budget:
+        raise VmemBudgetError(
+            f"dispatch emitted a kernel config over the {budget >> 20} MiB "
+            f"per-core VMEM budget: {est.describe()}"
+        )
+    return est

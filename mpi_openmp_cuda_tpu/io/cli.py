@@ -10,7 +10,6 @@ contract without breaking it (SURVEY §5 config tier).
 from __future__ import annotations
 
 import argparse
-import os
 import sys
 
 from ..ops.dispatch import AlignmentScorer
@@ -22,6 +21,7 @@ from ..resilience.degrade import (
 )
 from ..resilience.faults import activate_faults, deactivate_faults
 from ..resilience.policy import RetryPolicy
+from ..utils.platform import env_flag, env_int, env_str
 from ..utils.profiling import PhaseTimer, device_trace
 from .parse import load_problem
 from .printer import guarded_stdout, print_results, write_json_sidecar
@@ -160,6 +160,15 @@ def build_arg_parser() -> argparse.ArgumentParser:
         "round trip, so prefer CHUNK large enough that chunks are few "
         "unless memory-bound (measured: scripts/stream_bench.py)",
     )
+    p.add_argument(
+        "--check",
+        action="store_true",
+        help="validate every concrete dispatch decision against the "
+        "static-analysis contracts before launch (feed/exactness/rowpack/"
+        "superblock gates plus the VMEM footprint model in "
+        "mpi_openmp_cuda_tpu/analysis); the SEQALIGN_CHECK env var "
+        "enables the same checks when this flag is absent",
+    )
     return p
 
 
@@ -181,17 +190,9 @@ def _build_policy(args) -> tuple[RetryPolicy, str | None]:
     retries = args.retries
     fault_spec = args.faults
     if fault_spec is None:
-        fault_spec = os.environ.get("SEQALIGN_FAULTS") or None
+        fault_spec = env_str("SEQALIGN_FAULTS") or None
         if fault_spec:
-            floor_env = os.environ.get("SEQALIGN_FAULT_RETRIES", "0") or "0"
-            try:
-                floor = int(floor_env)
-            except ValueError:
-                raise ValueError(
-                    "SEQALIGN_FAULT_RETRIES must be an integer, "
-                    f"got {floor_env!r}"
-                ) from None
-            retries = max(retries, floor)
+            retries = max(retries, env_int("SEQALIGN_FAULT_RETRIES", 0))
     return RetryPolicy(retries=retries), fault_spec
 
 
@@ -205,6 +206,7 @@ def _make_degrader(args, scorer) -> BackendDegrader:
             backend=b,
             chunk_budget=scorer.chunk_budget,
             sharding=scorer.sharding,
+            check=scorer.check,
         ),
         enabled=bool(args.degrade),
     )
@@ -245,7 +247,11 @@ def _make_scorer(args, distributed_active: bool) -> AlignmentScorer:
         sharding = _feature_import(
             "--distributed batch sharding", _imp_default
         ).over_devices(None)
-    return AlignmentScorer(backend=args.backend, sharding=sharding)
+    return AlignmentScorer(
+        backend=args.backend,
+        sharding=sharding,
+        check=bool(args.check) or env_flag("SEQALIGN_CHECK"),
+    )
 
 
 def _run_streaming_worker(args, timer: PhaseTimer, dist, policy) -> int:
@@ -563,14 +569,11 @@ def _run_streaming(
                 # window+1 chunks of codes plus the output lines.
                 import collections
 
-                depth_env = os.environ.get("TPU_SEQALIGN_STREAM_DEPTH", "4")
-                try:
-                    window = 1 if multi else max(1, int(depth_env))
-                except ValueError:
-                    raise ValueError(
-                        "TPU_SEQALIGN_STREAM_DEPTH must be an integer, "
-                        f"got {depth_env!r}"
-                    ) from None
+                window = (
+                    1
+                    if multi
+                    else max(1, env_int("TPU_SEQALIGN_STREAM_DEPTH", 4))
+                )
                 pendings = collections.deque()
                 end_sent = False
                 for start, codes in header.iter_chunks(args.stream):

@@ -100,12 +100,15 @@ def choose_chunk(batch: PaddedBatch, budget: int, backend: str = "xla") -> int:
 
     The XLA formulations materialise O(L1P x L2P) intermediates per pair,
     so their chunk is budget / (l1p*l2p).  The fused Pallas kernel keeps V
-    in VMEM and streams one grid cell per pair — its per-pair HBM is just
-    the codes row + a 128-lane output — so it takes the whole batch in
-    one call (capped): splitting it pays per-call dispatch overhead AND
-    re-DMAs the A bands per call (measured on the max-size config: the
-    old l1p*l2p budget forced cb=2 -> 32 calls x 6.8 MiB of A3 traffic,
-    ~2x the kernel's own wall)."""
+    in VMEM and streams pairs through the grid — pp = 2 pairs per grid
+    cell on even chunks (pp = 1 odd), and p = 128/l2s pairs per tile on
+    the row-packed path — so its per-pair HBM is just the codes row + a
+    128-lane output row (verified against analysis.vmem's streamed-block
+    model) and it takes the whole batch in one call (capped): splitting
+    it pays per-call dispatch overhead AND re-DMAs the A bands per call
+    (measured on the max-size config: the old l1p*l2p budget forced
+    cb=2 -> 32 calls x 6.8 MiB of A3 traffic, ~2x the kernel's own
+    wall)."""
     if backend == "pallas":
         per_pair = batch.l2p  # codes row; outputs are O(128)
     else:
@@ -470,6 +473,7 @@ class AlignmentScorer:
         backend: str = "xla",
         chunk_budget: int = DEFAULT_CHUNK_BUDGET,
         sharding=None,
+        check: bool | None = None,
     ):
         if backend == "auto":
             backend = resolve_auto_backend()
@@ -478,6 +482,13 @@ class AlignmentScorer:
         self.backend = backend
         self.chunk_budget = chunk_budget
         self.sharding = sharding  # parallel.BatchSharding or None
+        if check is None:
+            from ..utils.platform import env_flag
+
+            check = env_flag("SEQALIGN_CHECK")
+        # --check / SEQALIGN_CHECK: validate every concrete dispatch
+        # decision against the analysis-pass contracts before launch.
+        self.check = bool(check)
 
     # -- code-level API ----------------------------------------------------
     def score_codes(
@@ -692,6 +703,27 @@ class AlignmentScorer:
                 l2s = choose_rowpack(
                     fm[1], batch.l2p, batch.len2, maxv=max_abs_value(val_flat)
                 )
+                if self.check:
+                    # The single point where every dispatch decision is
+                    # concrete: feed, chunk, superblock, rowpack class.
+                    from ..analysis import contracts, vmem
+
+                    contracts.validate_dispatch(
+                        feed=fm[1],
+                        maxv=int(max_abs_value(val_flat)),
+                        l1p=batch.l1p,
+                        l2p=batch.l2p,
+                        sb=sb,
+                        l2s=l2s,
+                    )
+                    vmem.check_config(
+                        nbn=batch.l1p // 128,
+                        nbi=batch.l2p // 128,
+                        feed=fm[1],
+                        sb=sb,
+                        pp=2 if cb % 2 == 0 else 1,
+                        l2s=l2s,
+                    )
                 out = score_chunks_pallas(*args, feed=fm[1], sb=sb, l2s=l2s)
             else:
                 from .xla_scorer import score_chunks

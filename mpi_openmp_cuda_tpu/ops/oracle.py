@@ -39,7 +39,12 @@ def _as_codes(seq) -> np.ndarray:
 def equal_length_score(seq1, seq2, weights) -> int:
     """Positional score of two equal-length code vectors (branch A)."""
     seq1, seq2 = _as_codes(seq1), _as_codes(seq2)
-    assert seq1.size == seq2.size
+    if seq1.size != seq2.size:
+        # Runtime path: must survive python -O (seqlint SEQ004).
+        raise RuntimeError(
+            f"equal_length_score needs equal-length inputs, got "
+            f"{seq1.size} vs {seq2.size}"
+        )
     val = value_table(weights)
     return int(val[seq2, seq1].sum())
 

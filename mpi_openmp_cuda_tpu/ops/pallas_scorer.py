@@ -359,6 +359,27 @@ def superblock_model_cost(
     return cost
 
 
+@functools.lru_cache(maxsize=1024)
+def emittable_superblocks(nbn: int, nbi: int, feed: str) -> tuple[int, ...]:
+    """Every super-block width the chooser may emit for this config,
+    ascending: the divisors of nbn in [2, 24] that pass the static VMEM
+    feasibility gate (analysis.vmem — PR 2's unmeasured-spill bug class
+    turned into arithmetic: the wide-walk working set at sb >= 20 models
+    over the 16 MiB per-core budget for the wider feeds), plus the
+    static ``_superblock`` fallback and the degenerate sb = 1.  Single
+    source of truth for BOTH the chooser's candidate list and the
+    exhaustive audit sweep (analysis.vmem.iter_chooser_space), so they
+    cannot drift."""
+    from ..analysis.vmem import fits_budget
+
+    divs = [
+        sb
+        for sb in range(2, min(nbn, 24) + 1)
+        if nbn % sb == 0 and fits_budget(nbn, nbi, feed, sb)
+    ]
+    return tuple(sorted({1, _superblock(nbn), *divs}))
+
+
 @functools.lru_cache(maxsize=256)
 def _choose_superblock_cached(
     nbn: int, nbi: int, len1: int, lens_hist: tuple, feed: str = "i8"
@@ -366,17 +387,21 @@ def _choose_superblock_cached(
     base, per_sb, rate = _SB_CONSTANTS[feed]
     kw = dict(base=base, per_sb=per_sb, rate=rate)
     best_sb, best_cost = None, None
-    # Every divisor of nbn in [2, 24], widest first (ties go wide).  The
-    # r3 bound extension 16 -> 24 lets tiny-Seq2 batches against the
-    # caps-size Seq1 run ONE 24-block super-block instead of two
-    # (interleaved A/B on input4: sb=24 beats sb=12 in both passes,
-    # median +45%); the cost model keeps sb=12 for max-size-class
-    # batches, whose dead-lane waste at sb=24 outweighs the halved
-    # iteration count.  For 2 <= nbn <= 24 the divisors always include
-    # nbn itself, which also covers the prime Seq1 buckets (13, 17, 19,
-    # 23); a larger prime nbn (huge ring shard) must not allocate an
-    # nbn-wide band and falls back to the static policy.
-    candidates = [sb for sb in range(min(nbn, 24), 1, -1) if nbn % sb == 0]
+    # Every divisor of nbn in [2, 24] passing the VMEM feasibility gate,
+    # widest first (ties go wide).  The r3 bound extension 16 -> 24 lets
+    # tiny-Seq2 batches against the caps-size Seq1 run ONE 24-block
+    # super-block instead of two (interleaved A/B on input4: sb=24 beats
+    # sb=12 in both passes, median +45%); the cost model keeps sb=12 for
+    # max-size-class batches, whose dead-lane waste at sb=24 outweighs
+    # the halved iteration count.  For 2 <= nbn <= 24 the divisors
+    # always include nbn itself, which also covers the prime Seq1
+    # buckets (13, 17, 19, 23); a larger prime nbn (huge ring shard)
+    # must not allocate an nbn-wide band and falls back to the static
+    # policy.
+    candidates = [
+        sb for sb in sorted(emittable_superblocks(nbn, nbi, feed))[::-1]
+        if sb >= 2
+    ]
     for sb in candidates:
         cost = superblock_model_cost(nbn, nbi, len1, lens_hist, sb, **kw)
         if best_cost is None or cost < best_cost:
@@ -1274,7 +1299,13 @@ def _pallas_best_packed(
     epilogue bound; enforced at dispatch).  Same return contract;
     p = 128/l2s pairs per tile."""
     b, l2p = rows.shape
-    assert l2p == _BLK, l2p
+    if l2p != _BLK:
+        # Runtime path: must survive python -O (seqlint SEQ004).
+        raise RuntimeError(
+            f"row-packed kernel requires a single char-block bucket "
+            f"(L2P == {_BLK}), got L2P={l2p}; dispatch.choose_rowpack "
+            "must not emit l2s for wider buckets"
+        )
     w = seq1ext.shape[0] - l2p - 1
     nbn = w // _BLK
     wneed = w + l2p

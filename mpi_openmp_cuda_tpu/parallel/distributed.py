@@ -12,9 +12,8 @@ reference's ``MPI_Bcast`` of seq1/weights/sizes (main.c:149-152).
 
 from __future__ import annotations
 
-import os
-
 from ..resilience.faults import fire as _fault
+from ..utils.platform import env_int, env_str
 
 
 def initialize_distributed(
@@ -32,13 +31,13 @@ def initialize_distributed(
     """
     import jax
 
-    coordinator_address = coordinator_address or os.environ.get(
+    coordinator_address = coordinator_address or env_str(
         "JAX_COORDINATOR_ADDRESS"
     )
-    if num_processes is None and "JAX_NUM_PROCESSES" in os.environ:
-        num_processes = int(os.environ["JAX_NUM_PROCESSES"])
-    if process_id is None and "JAX_PROCESS_ID" in os.environ:
-        process_id = int(os.environ["JAX_PROCESS_ID"])
+    if num_processes is None:
+        num_processes = env_int("JAX_NUM_PROCESSES")
+    if process_id is None:
+        process_id = env_int("JAX_PROCESS_ID")
     try:
         jax.distributed.initialize(
             coordinator_address=coordinator_address,

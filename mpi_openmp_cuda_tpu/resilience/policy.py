@@ -28,7 +28,6 @@ the degradation chain (:mod:`.degrade`) keys on.
 
 from __future__ import annotations
 
-import os
 import random
 import sys
 import time
@@ -74,15 +73,11 @@ class RetryPolicy:
             raise ValueError(f"retries must be >= 0, got {retries}")
         self.retries = int(retries)
         if backoff_base is None:
-            env = os.environ.get("SEQALIGN_BACKOFF_BASE")
-            try:
-                backoff_base = (
-                    float(env) if env else _DEFAULT_BACKOFF_BASE
-                )
-            except ValueError:
-                raise ValueError(
-                    f"SEQALIGN_BACKOFF_BASE must be a float, got {env!r}"
-                ) from None
+            from ..utils.platform import env_float
+
+            backoff_base = env_float(
+                "SEQALIGN_BACKOFF_BASE", _DEFAULT_BACKOFF_BASE
+            )
         self.backoff_base = float(backoff_base)
         self.backoff_factor = float(backoff_factor)
         self.backoff_cap = float(backoff_cap)

@@ -1,15 +1,184 @@
-"""Platform selection helper.
+"""Platform selection helper and the environment-variable registry.
 
 Some TPU environments install a sitecustomize hook that force-registers a
 PJRT plugin and rewrites ``jax.config.jax_platforms`` at interpreter start,
 which silently overrides a user's ``JAX_PLATFORMS=cpu``.  This helper
 re-asserts the user's explicit choice (needed by the CPU-mesh test harness
 and any non-TPU deployment) without touching the TPU default path.
+
+This module is also the ONLY legal home for environment reads in the
+package (enforced by seqlint SEQ002): every knob is declared once in
+:data:`ENV_VARS` with its type, default, and one-line doc, and consumers
+go through the typed accessors (:func:`env_str` / :func:`env_int` /
+:func:`env_flag`).  Reads happen at CALL time, not import time, so
+tests' ``monkeypatch.setenv`` keeps working.  Centralising the parse
+also centralises the error message: a malformed integer raises one
+uniform, actionable ``ValueError`` naming the variable and the observed
+text, instead of each call site improvising its own.
 """
 
 from __future__ import annotations
 
+import dataclasses
 import os
+
+
+# --------------------------------------------------------------------------
+# Environment-variable registry (PR 3 satellite: the SEQ002 consolidation).
+# --------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class EnvVar:
+    """One declared environment knob: its name, value type ('str' /
+    'int' / 'flag'), default, and a one-line doc for --help and docs."""
+
+    name: str
+    kind: str
+    default: str | int | bool | None
+    doc: str
+
+
+ENV_VARS: tuple[EnvVar, ...] = (
+    EnvVar(
+        "JAX_PLATFORMS",
+        "str",
+        None,
+        "jax backend override (cpu for the virtual-device test mesh)",
+    ),
+    EnvVar(
+        "XLA_FLAGS",
+        "str",
+        None,
+        "XLA flags; xla_force_host_platform_device_count sets the "
+        "virtual CPU mesh width",
+    ),
+    EnvVar(
+        "TPU_SEQALIGN_COMPILE_CACHE",
+        "str",
+        None,
+        "persistent compile-cache directory ('off'/'0' disables)",
+    ),
+    EnvVar(
+        "TPU_SEQALIGN_STREAM_DEPTH",
+        "int",
+        4,
+        "in-flight device batches in the streaming scorer",
+    ),
+    EnvVar(
+        "SEQALIGN_FAULTS",
+        "str",
+        None,
+        "deterministic fault-injection spec (see --faults)",
+    ),
+    EnvVar(
+        "SEQALIGN_FAULT_RETRIES",
+        "int",
+        0,
+        "extra retry-budget floor when a fault spec is armed",
+    ),
+    EnvVar(
+        "SEQALIGN_BACKOFF_BASE",
+        "float",
+        None,
+        "override the retry policy's backoff base delay in seconds",
+    ),
+    EnvVar(
+        "SEQALIGN_CHECK",
+        "flag",
+        False,
+        "enable runtime dispatch-contract validation (same as --check)",
+    ),
+    EnvVar(
+        "JAX_COORDINATOR_ADDRESS",
+        "str",
+        None,
+        "multi-host coordinator address for jax.distributed.initialize",
+    ),
+    EnvVar(
+        "JAX_NUM_PROCESSES",
+        "int",
+        None,
+        "multi-host process count for jax.distributed.initialize",
+    ),
+    EnvVar(
+        "JAX_PROCESS_ID",
+        "int",
+        None,
+        "this host's process index for jax.distributed.initialize",
+    ),
+)
+
+_REGISTRY = {v.name: v for v in ENV_VARS}
+
+_FLAG_TRUE = ("1", "true", "yes", "on")
+_FLAG_FALSE = ("0", "false", "no", "off", "")
+
+
+def _declared(name: str, kind: str) -> EnvVar:
+    var = _REGISTRY.get(name)
+    if var is None or var.kind != kind:
+        raise KeyError(
+            f"{name} is not a declared {kind} env var; add it to "
+            "utils.platform.ENV_VARS (seqlint SEQ002 keeps reads here)"
+        )
+    return var
+
+
+def env_str(name: str, default: str | None = None) -> str | None:
+    """Raw string accessor for a declared env var."""
+    var = _declared(name, "str")
+    raw = os.environ.get(name)
+    if raw is None:
+        return default if default is not None else var.default
+    return raw
+
+
+def env_int(name: str, default: int | None = None) -> int | None:
+    """Integer accessor; raises one uniform actionable ValueError on a
+    malformed value (each former call site improvised its own)."""
+    var = _declared(name, "int")
+    raw = os.environ.get(name)
+    if raw is None or raw == "":
+        return default if default is not None else var.default
+    try:
+        return int(raw)
+    except ValueError:
+        raise ValueError(
+            f"{name} must be an integer, got {raw!r} ({var.doc})"
+        ) from None
+
+
+def env_float(name: str, default: float | None = None) -> float | None:
+    """Float accessor with the same uniform error contract."""
+    var = _declared(name, "float")
+    raw = os.environ.get(name)
+    if raw is None or raw == "":
+        return default if default is not None else var.default
+    try:
+        return float(raw)
+    except ValueError:
+        raise ValueError(
+            f"{name} must be a number, got {raw!r} ({var.doc})"
+        ) from None
+
+
+def env_flag(name: str, default: bool | None = None) -> bool:
+    """Boolean accessor: 1/true/yes/on vs 0/false/no/off (empty =
+    unset); anything else is an error, not a silent False."""
+    var = _declared(name, "flag")
+    raw = os.environ.get(name)
+    if raw is None:
+        return bool(default if default is not None else var.default)
+    low = raw.strip().lower()
+    if low in _FLAG_TRUE:
+        return True
+    if low in _FLAG_FALSE:
+        return False
+    raise ValueError(
+        f"{name} must be a boolean flag (1/0/true/false/yes/no/on/off), "
+        f"got {raw!r} ({var.doc})"
+    )
 
 
 def apply_platform_override() -> None:

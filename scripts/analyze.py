@@ -1,0 +1,99 @@
+#!/usr/bin/env python3
+"""`make analyze` driver: run the full static-analysis gate on CPU.
+
+Four passes (docs/ARCHITECTURE.md §9), in cheapest-first order so the
+common failure (a lint regression) reports before jax even imports:
+
+1. seqlint        — repo-specific AST rules over the package tree.
+2. VMEM audit     — exhaustive sweep of every kernel config the
+                    dispatch choosers can emit vs the per-core budget.
+3. contract audit — jax.eval_shape over every registered scorer entry
+                    point (the shard_map wrapper needs a mesh, hence
+                    the 8-virtual-device CPU backend forced below).
+4. ruff / mypy    — only when installed (the container may not ship
+                    them); the baselines live in pyproject.toml.
+
+Exit 0 iff every pass is clean.  Runs in a few seconds, no TPU.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import subprocess
+import sys
+
+# Force the CPU backend with enough virtual devices for the shard_map
+# contract BEFORE jax initialises (same idiom as tests/conftest.py).
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    from mpi_openmp_cuda_tpu.analysis import SeqcheckError, contracts, vmem
+    from mpi_openmp_cuda_tpu.analysis.seqlint import run_or_raise
+
+    failures = 0
+
+    print("== seqlint ==")
+    try:
+        nfiles = run_or_raise()
+    except SeqcheckError as exc:
+        print(exc)
+        failures += 1
+    else:
+        print(f"clean: {nfiles} files, 0 findings")
+
+    print("\n== vmem audit ==")
+    try:
+        n, worst = vmem.audit_chooser_space()
+    except SeqcheckError as exc:
+        print(exc)
+        failures += 1
+    else:
+        print(f"clean: {n} emittable configs within budget; tightest:")
+        print(f"  {worst.describe()}")
+        print(f"  headroom {worst.headroom_bytes / (1 << 20):.2f} MiB")
+
+    print("\n== entry-point contracts ==")
+    try:
+        rows = contracts.audit_entry_points()
+    except SeqcheckError as exc:
+        print(exc)
+        failures += 1
+    else:
+        for row in rows:
+            print(f"  {row}")
+        print(f"clean: {len(rows)} contract x bucket evaluations")
+
+    # Optional generic tooling: gate on availability, never on import —
+    # the deployment container does not ship ruff/mypy.
+    for tool, argv in (
+        ("ruff", ["ruff", "check", "mpi_openmp_cuda_tpu"]),
+        ("mypy", ["mypy", "mpi_openmp_cuda_tpu"]),
+    ):
+        print(f"\n== {tool} ==")
+        if shutil.which(tool) is None:
+            print(f"{tool} not installed; skipped")
+            continue
+        rc = subprocess.call(argv, cwd=os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        if rc != 0:
+            failures += 1
+
+    print(
+        "\nanalyze: "
+        + ("FAILED" if failures else "OK")
+        + (f" ({failures} pass(es) failed)" if failures else "")
+    )
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

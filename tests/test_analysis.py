@@ -1,0 +1,368 @@
+"""Static-analysis subsystem tests (PR 3): contract gates, the VMEM
+footprint audit, the recompile detector, and the env-var registry.
+
+The five seeded violations of the ISSUE 3 acceptance list each get a
+dedicated test asserting BOTH the distinct exception subclass and an
+actionable message naming the violated bound.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from mpi_openmp_cuda_tpu.analysis import (
+    ContractViolation,
+    ExactnessViolation,
+    FeedViolation,
+    LintError,
+    RowpackViolation,
+    SeqcheckError,
+    SuperblockViolation,
+    VmemBudgetError,
+)
+from mpi_openmp_cuda_tpu.analysis import contracts, recompile, vmem
+
+
+# --------------------------------------------------------------------------
+# Seeded contract violations (ISSUE 3 acceptance: each caught by its
+# owning pass with a distinct actionable error).
+# --------------------------------------------------------------------------
+
+
+class TestSeededViolations:
+    def test_overflow_past_max_exact_value(self):
+        # 40000 > max_exact_value(2048) = 4095: f32 prefix partials round.
+        with pytest.raises(ExactnessViolation) as ei:
+            contracts.validate_dispatch(
+                feed="f32", maxv=40000, l1p=512, l2p=2048, sb=4, l2s=None
+            )
+        msg = str(ei.value)
+        assert "max_exact_value" in msg and "4095" in msg
+        assert "gather" in msg  # names the fix, not just the breach
+
+    def test_wrong_feed_dtype(self):
+        # i8 holds |v| <= 127; 3000 needs the f32 feed.
+        with pytest.raises(FeedViolation) as ei:
+            contracts.validate_dispatch(
+                feed="i8", maxv=3000, l1p=512, l2p=128, sb=4, l2s=None
+            )
+        msg = str(ei.value)
+        assert "i8" in msg and "3000" in msg and "f32" in msg
+
+    def test_rowpack_epilogue_gate_breach(self):
+        # 3 * 64 * 3000 = 576000 >= 2^19: the packed argmax key collides.
+        with pytest.raises(RowpackViolation) as ei:
+            contracts.validate_dispatch(
+                feed="f32", maxv=3000, l1p=512, l2p=128, sb=4, l2s=64
+            )
+        msg = str(ei.value)
+        assert "2^19" in msg and "576000" in msg
+
+    def test_oversized_superblock(self):
+        # 7 does not divide nbn = 24; 48 exceeds the klb key budget.
+        with pytest.raises(SuperblockViolation) as ei:
+            contracts.validate_dispatch(
+                feed="f32", maxv=100, l1p=3072, l2p=128, sb=7, l2s=None
+            )
+        assert "nbn % sb == 0" in str(ei.value)
+        with pytest.raises(SuperblockViolation) as ei:
+            contracts.check_superblock(48, 48)
+        assert "sb <= 24" in str(ei.value)
+
+    def test_vmem_over_budget(self):
+        # A legal config against an artificially tiny budget: the model
+        # itself reports the breach with the per-component breakdown.
+        with pytest.raises(VmemBudgetError) as ei:
+            vmem.check_config(
+                nbn=24, nbi=16, feed="f32", sb=4, pp=2, budget=1 << 20
+            )
+        msg = str(ei.value)
+        assert "VMEM budget" in msg and "MiB" in msg
+
+    def test_violations_are_distinct_contract_subclasses(self):
+        kinds = {
+            ExactnessViolation,
+            FeedViolation,
+            RowpackViolation,
+            SuperblockViolation,
+        }
+        assert len(kinds) == 4
+        for k in kinds:
+            assert issubclass(k, ContractViolation)
+            assert issubclass(k, SeqcheckError)
+        assert issubclass(VmemBudgetError, SeqcheckError)
+        assert not issubclass(VmemBudgetError, ContractViolation)
+        assert issubclass(LintError, SeqcheckError)
+
+
+class TestConcreteGates:
+    def test_chooser_emitted_config_passes(self):
+        # What _score_local actually computes for a mid-size bucket must
+        # sail through: chooser output is contract-clean by construction.
+        from mpi_openmp_cuda_tpu.ops.dispatch import choose_rowpack
+        from mpi_openmp_cuda_tpu.ops.pallas_scorer import choose_superblock
+
+        l1p, l2p, maxv, feed = 1536, 128, 100, "i8"
+        lens = (100,) * 8
+        sb = choose_superblock(l1p // 128, l2p // 128, 1500, lens, feed)
+        l2s = choose_rowpack(feed, l2p, lens, maxv=maxv)
+        contracts.validate_dispatch(
+            feed=feed, maxv=maxv, l1p=l1p, l2p=l2p, sb=sb, l2s=l2s
+        )
+        est = vmem.check_config(
+            nbn=l1p // 128, nbi=l2p // 128, feed=feed, sb=sb, l2s=l2s
+        )
+        assert est.headroom_bytes > 0
+
+    def test_rowpack_requires_single_block_bucket(self):
+        with pytest.raises(RowpackViolation) as ei:
+            contracts.check_rowpack("i8", 256, 32, 100)
+        assert "L2P == 128" in str(ei.value)
+
+    def test_rowpack_none_is_always_legal(self):
+        contracts.check_rowpack("f32", 2048, None, 30000)
+
+    def test_unknown_feed_rejected(self):
+        with pytest.raises(FeedViolation):
+            contracts.check_feed("f64", 1)
+
+    def test_length_aware_ceiling(self):
+        # l2p = 128 affords the 32767 cap (PR 2's length-aware bound).
+        contracts.check_exactness(32767, 128)
+        with pytest.raises(ExactnessViolation):
+            contracts.check_exactness(32768, 128)
+
+
+# --------------------------------------------------------------------------
+# VMEM audit: the exhaustive chooser sweep must be violation-free.
+# --------------------------------------------------------------------------
+
+
+class TestVmemAudit:
+    def test_exhaustive_sweep_is_clean(self):
+        n, worst = vmem.audit_chooser_space()
+        assert n > 5000  # the full cross product, not a truncated sweep
+        assert worst.headroom_bytes >= 0
+        assert "MiB" in worst.describe()
+
+    def test_tiny_budget_reports_offenders(self):
+        with pytest.raises(VmemBudgetError) as ei:
+            vmem.audit_chooser_space(budget=1 << 20)
+        msg = str(ei.value)
+        assert "exceed" in msg
+        # The report names concrete configs and the remediation surface.
+        assert "sb=" in msg and "choose_superblock" in msg
+
+    def test_known_pressure_config_rejected(self):
+        # The config class the chooser gate trims (wide f32 at max nbn
+        # with a large pretiled superblock) must model over budget —
+        # this is the PR 2 spill assumption, now machine-checked.
+        assert not vmem.fits_budget(24, 5, "f32", 24)
+        assert vmem.fits_budget(24, 5, "f32", 12)
+
+    def test_chooser_candidates_subset_of_emittable(self):
+        from mpi_openmp_cuda_tpu.ops.pallas_scorer import (
+            choose_superblock,
+            emittable_superblocks,
+        )
+
+        for nbn, nbi, feed in ((24, 5, "f32"), (24, 16, "f32"), (12, 2, "bf16")):
+            sb = choose_superblock(
+                nbn, nbi, nbn * 128, (nbi * 128,) * 4, feed
+            )
+            assert sb in emittable_superblocks(nbn, nbi, feed)
+            assert vmem.fits_budget(nbn, nbi, feed, sb)
+
+    def test_estimate_matches_blockspec_arithmetic(self):
+        # Spot check the streamed-block term against the literal
+        # BlockSpec shapes: 2x (pp * nbi * 128 * 4 + pp * 128 * 4).
+        est = vmem.estimate_unpacked(8, 2, "i8", 4, 2)
+        assert est.stream_bytes == 2 * (2 * 2 * 128 * 4 + 2 * 128 * 4)
+        packed = vmem.estimate_packed(8, "i8", 4, 32)
+        assert packed.pp == 128 // 32
+        assert packed.kind == "packed"
+
+
+# --------------------------------------------------------------------------
+# Abstract entry-point contracts (eval_shape tier).
+# --------------------------------------------------------------------------
+
+
+class TestEntryContracts:
+    def test_audit_entry_points_passes(self):
+        rows = contracts.audit_entry_points()
+        # Every registered contract x every audit bucket.
+        assert len(rows) == len(contracts.ENTRY_CONTRACTS) * 3
+        assert all(r.endswith("OK") for r in rows)
+
+    def test_contract_mismatch_is_reported(self):
+        import dataclasses
+
+        bad = dataclasses.replace(
+            contracts.ENTRY_CONTRACTS[0],
+            out_shape=lambda b, nc, l1p, l2p: (b, 99),
+        )
+        orig = contracts.ENTRY_CONTRACTS
+        try:
+            contracts.ENTRY_CONTRACTS = (bad,)
+            with pytest.raises(ContractViolation) as ei:
+                contracts.audit_entry_points(buckets=((8, 2, 512, 128),))
+            assert "contract mismatch" in str(ei.value)
+        finally:
+            contracts.ENTRY_CONTRACTS = orig
+
+
+class TestCheckifiedBody:
+    # The tiny non-aligned bucket routes to the mm fallback inside the
+    # pallas body: no interpret-mode kernel compile (tier budget).
+    def _args(self, codes_val=3, maxv=2):
+        import jax.numpy as jnp
+
+        l1p, l2p = 96, 40
+        seq1ext = jnp.zeros((l1p + l2p + 1,), jnp.int32).at[:50].set(1)
+        rows = jnp.full((1, 4, l2p), codes_val, jnp.int32)
+        lens = jnp.full((1, 4), 30, jnp.int32)
+        val = jnp.full((27 * 27,), maxv, jnp.int32)
+        return seq1ext, jnp.int32(50), rows, lens, val
+
+    def test_clean_inputs_pass(self):
+        fn = contracts.checked_pallas_body()
+        err, out = fn(*self._args())
+        err.throw()  # no violation
+        assert out.shape == (1, 4, 3)
+
+    def test_alphabet_violation_caught(self):
+        fn = contracts.checked_pallas_body()
+        err, _ = fn(*self._args(codes_val=31))
+        with pytest.raises(Exception, match="alphabet"):
+            err.throw()
+
+
+# --------------------------------------------------------------------------
+# Recompile detector.
+# --------------------------------------------------------------------------
+
+
+class TestRecompileDetector:
+    def test_steady_state_zero(self):
+        import jax
+        import jax.numpy as jnp
+
+        f = jax.jit(lambda x: x * 2 + 1)
+        f(jnp.arange(8)).block_until_ready()  # warm
+        with recompile.assert_compiles(0):
+            f(jnp.arange(8)).block_until_ready()
+
+    def test_new_shape_recompile_caught(self):
+        import jax
+        import jax.numpy as jnp
+
+        f = jax.jit(lambda x: x * 3)
+        f(jnp.arange(4)).block_until_ready()
+        with pytest.raises(SeqcheckError, match="cache miss"):
+            with recompile.assert_compiles(0):
+                f(jnp.arange(16)).block_until_ready()  # new shape bucket
+
+    def test_count_compiles_delta(self):
+        import jax
+        import jax.numpy as jnp
+
+        f = jax.jit(lambda x: x - 7)
+        with recompile.count_compiles() as tally:
+            f(jnp.arange(32)).block_until_ready()
+        assert tally.count >= 1
+        frozen = tally.count
+        f(jnp.arange(64)).block_until_ready()  # outside the block
+        assert tally.count == frozen
+
+    def test_at_most_bound(self):
+        import jax
+        import jax.numpy as jnp
+
+        f = jax.jit(lambda x: x + 5)
+        with recompile.assert_compiles(at_most=4):
+            f(jnp.arange(128)).block_until_ready()
+
+    def test_kwarg_validation(self):
+        with pytest.raises(ValueError, match="exactly one"):
+            with recompile.assert_compiles():
+                pass
+        with pytest.raises(ValueError, match="exactly one"):
+            with recompile.assert_compiles(0, at_most=1):
+                pass
+
+
+# --------------------------------------------------------------------------
+# Env-var registry (SEQ002 satellite).
+# --------------------------------------------------------------------------
+
+
+class TestEnvRegistry:
+    def test_typed_accessors(self, monkeypatch):
+        from mpi_openmp_cuda_tpu.utils.platform import (
+            env_flag,
+            env_int,
+            env_str,
+        )
+
+        monkeypatch.setenv("TPU_SEQALIGN_STREAM_DEPTH", "9")
+        assert env_int("TPU_SEQALIGN_STREAM_DEPTH", 4) == 9
+        monkeypatch.delenv("TPU_SEQALIGN_STREAM_DEPTH", raising=False)
+        assert env_int("TPU_SEQALIGN_STREAM_DEPTH", 4) == 4
+        monkeypatch.setenv("SEQALIGN_FAULTS", "site:fail=1")
+        assert env_str("SEQALIGN_FAULTS") == "site:fail=1"
+        for raw, want in (("1", True), ("off", False), ("YES", True)):
+            monkeypatch.setenv("SEQALIGN_CHECK", raw)
+            assert env_flag("SEQALIGN_CHECK") is want
+
+    def test_uniform_parse_errors(self, monkeypatch):
+        from mpi_openmp_cuda_tpu.utils.platform import env_flag, env_int
+
+        monkeypatch.setenv("SEQALIGN_FAULT_RETRIES", "three")
+        with pytest.raises(ValueError, match="must be an integer"):
+            env_int("SEQALIGN_FAULT_RETRIES")
+        monkeypatch.setenv("SEQALIGN_CHECK", "maybe")
+        with pytest.raises(ValueError, match="boolean flag"):
+            env_flag("SEQALIGN_CHECK")
+
+    def test_undeclared_var_rejected(self):
+        from mpi_openmp_cuda_tpu.utils.platform import env_int, env_str
+
+        with pytest.raises(KeyError, match="ENV_VARS"):
+            env_str("SEQALIGN_NOT_A_KNOB")
+        with pytest.raises(KeyError, match="ENV_VARS"):
+            # Declared, but as the wrong kind: int accessor on a str var.
+            env_int("SEQALIGN_FAULTS")
+
+    def test_registry_docs_complete(self):
+        from mpi_openmp_cuda_tpu.utils.platform import ENV_VARS
+
+        assert len(ENV_VARS) >= 10
+        for var in ENV_VARS:
+            assert var.doc, f"{var.name} has no doc line"
+            assert var.kind in ("str", "int", "float", "flag")
+
+
+# --------------------------------------------------------------------------
+# The --check / SEQALIGN_CHECK dispatch hook.
+# --------------------------------------------------------------------------
+
+
+class TestDispatchCheckHook:
+    def test_env_flag_resolution(self, monkeypatch):
+        from mpi_openmp_cuda_tpu.ops.dispatch import AlignmentScorer
+
+        monkeypatch.delenv("SEQALIGN_CHECK", raising=False)
+        assert AlignmentScorer(backend="oracle").check is False
+        monkeypatch.setenv("SEQALIGN_CHECK", "1")
+        assert AlignmentScorer(backend="oracle").check is True
+        # An explicit argument beats the env var.
+        assert AlignmentScorer(backend="oracle", check=False).check is False
+
+    def test_cli_flag_parses(self):
+        from mpi_openmp_cuda_tpu.io.cli import build_arg_parser
+
+        args = build_arg_parser().parse_args(["--check"])
+        assert args.check is True
+        assert build_arg_parser().parse_args([]).check is False
