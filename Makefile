@@ -18,7 +18,7 @@ PY_CFLAGS  := $(shell $(PYCONFIG) --includes)
 PY_LDFLAGS := $(shell $(PYCONFIG) --ldflags --embed)
 INPUT      ?= /root/reference/input5.txt
 
-.PHONY: build run run2 runOn2 test chaos analyze bench bench-table bench-gather check clean
+.PHONY: build run run2 runOn2 test chaos chaos-kill analyze bench bench-table bench-gather check clean
 
 build: final
 
@@ -68,16 +68,28 @@ test:
 
 # Chaos tier: the fast suite under an ambient deterministic fault spec
 # (resilience/faults.py).  Every CLI run absorbs two transient
-# chunk-scoring faults and one journal-append fault inside the
-# SEQALIGN_FAULT_RETRIES floor, so the goldens must stay byte-identical;
-# tests that assert exact attempt counts or fail-stop at rc 1 carry the
-# no_chaos marker and are skipped (conftest).  The near-zero backoff
-# base keeps the injected retries from inflating the tier wall.
+# chunk-scoring faults, one journal-append fault, AND one injected
+# dispatch hang (classified by the ambient SEQALIGN_DEADLINE_S watchdog)
+# inside the SEQALIGN_FAULT_RETRIES floor, so the goldens must stay
+# byte-identical; tests that assert exact attempt counts or fail-stop
+# exit codes carry the no_chaos marker and are skipped (conftest).  The
+# retry floor is 4: worst case one run absorbs the hang (1) plus both
+# chunk_scoring faults (2) on the same shared budget.  The near-zero
+# backoff base keeps the injected retries from inflating the tier wall.
 chaos:
 	JAX_PLATFORMS=cpu \
-	SEQALIGN_FAULTS="chunk_scoring:fail=2;journal_append:fail=1" \
-	SEQALIGN_FAULT_RETRIES=3 SEQALIGN_BACKOFF_BASE=0.01 \
+	SEQALIGN_FAULTS="chunk_scoring:fail=2;journal_append:fail=1;hang:dispatch:fail=1" \
+	SEQALIGN_FAULT_RETRIES=4 SEQALIGN_BACKOFF_BASE=0.01 \
+	SEQALIGN_DEADLINE_S=0.05 \
 	$(PYTHON) -m pytest tests/ -q
+
+# Kill-resume chaos tier: subprocess tests that SIGKILL a run mid-batch
+# at a scheduled journal append (kill:journal-append) and assert the
+# rerun with --resume is byte-identical (tests/test_survival.py; slow +
+# chaos_kill marked, so neither the default tier nor `make chaos` pays
+# the subprocess fan-out).
+chaos-kill:
+	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/ -q --runslow -m chaos_kill
 
 # Static-analysis gate (docs/ARCHITECTURE.md §9): seqlint, the
 # exhaustive VMEM chooser sweep, the eval_shape entry-point contract

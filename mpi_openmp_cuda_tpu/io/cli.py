@@ -19,9 +19,15 @@ from ..resilience.degrade import (
     run_degrading,
     verify_rows_against_oracle,
 )
+from ..resilience.drain import DrainInterrupt, drain_guard, drain_requested
 from ..resilience.faults import activate_faults, deactivate_faults
 from ..resilience.policy import RetryPolicy
-from ..utils.platform import env_flag, env_int, env_str
+from ..resilience.watchdog import (
+    DeadlineExpiredError,
+    activate_watchdog,
+    deactivate_watchdog,
+)
+from ..utils.platform import env_flag, env_float, env_int, env_str
 from ..utils.profiling import PhaseTimer, device_trace
 from .parse import load_problem
 from .printer import guarded_stdout, print_results, write_json_sidecar
@@ -39,6 +45,26 @@ def _positive_int(text: str) -> int:
     if value < 1:
         raise argparse.ArgumentTypeError(f"must be >= 1, got {value}")
     return value
+
+
+def _positive_float(text: str) -> float:
+    value = float(text)
+    if value <= 0:
+        raise argparse.ArgumentTypeError(f"must be > 0, got {value}")
+    return value
+
+
+# BSD sysexits the driver's supervisor can script against: 75 (EX_TEMPFAIL)
+# says "rerun me" — a drained preemption or a deadline-rooted exhaustion
+# leaves a resumable journal behind — while 65 (EX_DATAERR) stays the
+# fail-stop verdict for everything else and 64 (EX_USAGE) rejects flag
+# combinations before any expensive phase.  1 remains the broken-pipe
+# exit (downstream closed the stream; nothing of ours failed) and
+# argparse keeps its own 2.
+EX_OK = 0
+EX_USAGE = 64
+EX_FATAL = 65
+EX_TEMPFAIL = 75
 
 
 def build_arg_parser() -> argparse.ArgumentParser:
@@ -161,6 +187,28 @@ def build_arg_parser() -> argparse.ArgumentParser:
         "unless memory-bound (measured: scripts/stream_bench.py)",
     )
     p.add_argument(
+        "--deadline",
+        type=_positive_float,
+        default=None,
+        metavar="S",
+        help="watchdog deadline in seconds around device work and "
+        "coordinator collectives: a block that exceeds it surfaces a "
+        "transient deadline-expiry error into the normal --retries (and "
+        "--degrade) machinery instead of hanging silently; "
+        "SEQALIGN_DEADLINE_S supplies the value when this flag is "
+        "absent; a run whose failure is rooted in deadline expiry exits "
+        "75 (resumable) rather than 65",
+    )
+    p.add_argument(
+        "--resume",
+        action="store_true",
+        help="assert that the --journal file already exists and resume "
+        "from it (error if it is missing); plain --journal still resumes "
+        "opportunistically but silently starts fresh on an absent file — "
+        "after a preemption (exit 75 / SIGKILL) --resume makes a typo'd "
+        "path loud instead of rescoring the whole batch",
+    )
+    p.add_argument(
         "--check",
         action="store_true",
         help="validate every concrete dispatch decision against the "
@@ -174,6 +222,38 @@ def build_arg_parser() -> argparse.ArgumentParser:
 
 class FeatureUnavailableError(RuntimeError):
     pass
+
+
+def _is_resumable(e: BaseException | None) -> bool:
+    """True when a failure chain is rooted in a watchdog deadline expiry:
+    the input was never judged bad — the run was preempted by time — so
+    the supervisor contract is exit 75 (rerun, with --resume under
+    --journal) rather than the fatal 65.  Walks ``__cause__`` /
+    ``__context__`` because expiries surface wrapped (RetryExhaustedError
+    chains the last attempt's error as its cause)."""
+    seen: set[int] = set()
+    while e is not None and id(e) not in seen:
+        seen.add(id(e))
+        if isinstance(e, DeadlineExpiredError):
+            return True
+        e = e.__cause__ or e.__context__
+    return False
+
+
+def _check_resume(args) -> None:
+    """``--resume`` turns resuming from an option into an assertion: the
+    journal file must already exist.  Plain ``--journal`` starting fresh
+    on an absent file is right for a FIRST run, but after a preemption a
+    mistyped path would silently rescore everything — the opposite of
+    what the operator asked for."""
+    import os
+
+    if args.resume and not os.path.exists(args.journal):
+        raise FileNotFoundError(
+            f"--resume: journal {args.journal!r} does not exist (a first "
+            "run takes --journal alone; --resume asserts there is prior "
+            "progress to reuse)"
+        )
 
 
 def _build_policy(args) -> tuple[RetryPolicy, str | None]:
@@ -397,6 +477,7 @@ def _run_streaming(
         journal, seq_hash, mismatch_error, done = None, None, None, {}
         if args.journal:
             try:
+                _check_resume(args)
 
                 def _imp():
                     from ..utils.journal import (
@@ -576,7 +657,14 @@ def _run_streaming(
                 )
                 pendings = collections.deque()
                 end_sent = False
+                drained_at = None
                 for start, codes in header.iter_chunks(args.stream):
+                    if drain_requested():
+                        # Preemption drain: stop ADMITTING chunks; the
+                        # in-flight window below still materialises (and
+                        # journals) normally, then the run exits 75.
+                        drained_at = start
+                        break
                     cur = _submit(start, codes)
                     if cur[0] is not None:
                         try:
@@ -601,6 +689,24 @@ def _run_streaming(
                     end_sent = True
                 while pendings:
                     _finish(*pendings.popleft())
+                if drained_at is not None:
+                    # Drained: in-flight chunks are journalled (fsync'd on
+                    # append) but NOTHING goes to stdout — the fail-stop
+                    # contract holds, and on multi-host the end sentinel
+                    # above already released the workers cleanly.
+                    if journal is not None:
+                        journal.append_event("drain")
+                        raise DrainInterrupt(
+                            f"stream preempted before sequence {drained_at}"
+                            " of "
+                            f"{header.num_seq2}; scored chunks are in the "
+                            "journal — rerun with --resume to finish"
+                        )
+                    raise DrainInterrupt(
+                        f"stream preempted before sequence {drained_at} of "
+                        f"{header.num_seq2}; no --journal, so a rerun "
+                        "starts over"
+                    )
             except BaseException:
                 if multi and not end_sent:
                     # Any coordinator-side failure (parse, journal
@@ -649,13 +755,20 @@ def run(argv: list[str] | None = None) -> int:
         ("--selfcheck", args.selfcheck, "selfcheck re-verifies against "
          "the fully-materialised problem"),
     )):
-        return 1
+        return EX_USAGE
     if args.degrade and _reject_combos("--degrade", (
         ("--distributed", args.distributed, "the backend choice is the "
          "SPMD program itself; a lone host degrading its backend "
          "desynchronises the collective schedules"),
     )):
-        return 1
+        return EX_USAGE
+    if args.resume and not args.journal:
+        print(
+            "mpi_openmp_cuda_tpu: error: --resume requires --journal PATH "
+            "(the journal is what a resume resumes from)",
+            file=sys.stderr,
+        )
+        return EX_USAGE
 
     guard = None
     out_stream = None  # None -> sys.stdout
@@ -671,12 +784,26 @@ def run(argv: list[str] | None = None) -> int:
             if not suppress:
                 raise
 
+    _drain = None
     try:
         # Arm the run's retry policy and (optional) fault registry first:
         # a malformed --faults/env spec or retry floor fails fast through
         # the normal error path below, before any expensive phase.
         policy, fault_spec = _build_policy(args)
         activate_faults(fault_spec)
+        deadline = (
+            args.deadline
+            if args.deadline is not None
+            else env_float("SEQALIGN_DEADLINE_S")
+        )
+        if deadline:
+            activate_watchdog(deadline)
+        # Preemption drain: SIGTERM/SIGINT (or a pre-armed SEQALIGN_DRAIN)
+        # finishes in-flight chunks, flushes the journal, and exits 75.
+        # Armed for the whole run, disarmed (handlers restored) in the
+        # finally below so library callers never inherit our handlers.
+        _drain = drain_guard()
+        _drain.__enter__()
         coordinator = True
         dist = None
         if args.distributed:
@@ -741,9 +868,11 @@ def run(argv: list[str] | None = None) -> int:
                 # schedule a broadcast fact: the coordinator loads its
                 # journal's done-set and every host derives the identical
                 # pending list + chunking, so the collective schedules
-                # cannot diverge.  Only the coordinator touches the file.
+                # cannot diverge.  Only the coordinator touches the file
+                # (so only it can assert --resume's file-exists contract).
                 if coordinator:
                     try:
+                        _check_resume(args)
                         done = journal.load_done(problem)
                     except Exception:
                         dist.broadcast_index_set(None, failed=True)
@@ -753,6 +882,8 @@ def run(argv: list[str] | None = None) -> int:
                     done = {
                         int(i): None for i in dist.broadcast_index_set(None)
                     }
+            else:
+                _check_resume(args)
 
         def _score_once(sc):
             if journal is not None:
@@ -773,15 +904,33 @@ def run(argv: list[str] | None = None) -> int:
                 problem.seq1_codes, problem.seq2_codes, problem.weights, rows
             )
 
+        beacon_s = env_float("SEQALIGN_BEACON_S")
         with timer.phase("score"), device_trace(args.trace):
-            results = run_degrading(
-                policy,
-                deg,
-                lambda: _score_once(deg.scorer),
-                _score_once,
-                "scoring",
-                verify=_batch_verify if deg.enabled else None,
-            )
+            if args.distributed and beacon_s and not args.journal:
+                # Lost-shard rescue tier: trade the SPMD collective gather
+                # (where one dead worker hangs every peer) for per-process
+                # local shards posted to the coordination-service board; a
+                # worker that misses the beacon deadline has its index-set
+                # rescored locally on the coordinator.  --journal takes
+                # precedence (its resume schedule IS the collective
+                # schedule); workers return None and print nothing.
+                results = dist.scatter_gather_rescue(
+                    problem.seq1_codes,
+                    problem.seq2_codes,
+                    problem.weights,
+                    policy=policy,
+                    beacon_s=beacon_s,
+                    backend=args.backend,
+                )
+            else:
+                results = run_degrading(
+                    policy,
+                    deg,
+                    lambda: _score_once(deg.scorer),
+                    _score_once,
+                    "scoring",
+                    verify=_batch_verify if deg.enabled else None,
+                )
         # Coordinator-only: one host's oracle re-verification suffices,
         # and under --journal workers hold schedule placeholders (zeros)
         # for resumed rows, not results.
@@ -815,17 +964,28 @@ def run(argv: list[str] | None = None) -> int:
         # buffered results can itself raise (e.g. BrokenPipeError under
         # `... | head`), and must hit the handlers below.
         _close_guard(suppress=False)
-        return 0
+        return EX_OK
+    except DrainInterrupt as e:
+        # A requested preemption, not a failure: nothing was printed
+        # (fail-stop stdout), everything scored so far is fsync'd in the
+        # journal, and 75 tells the supervisor a rerun will finish the job.
+        print(f"mpi_openmp_cuda_tpu: drained: {e}", file=sys.stderr)
+        return EX_TEMPFAIL
     except BrokenPipeError:
         return 1
     except Exception as e:  # fail-stop: diagnose on stderr, nonzero exit (C11)
         print(f"mpi_openmp_cuda_tpu: error: {e}", file=sys.stderr)
-        return 1
+        return EX_TEMPFAIL if _is_resumable(e) else EX_FATAL
     finally:
         # Error paths: restore fd 1 without letting a secondary flush
-        # failure mask the original exception.  Faults are armed per run:
-        # disarm so library callers after a CLI run see no ambient faults.
+        # failure mask the original exception.  Faults/watchdog/drain are
+        # armed per run: disarm (and join the watchdog thread, restore the
+        # signal handlers) so library callers after a CLI run see no
+        # ambient runtime.
         deactivate_faults()
+        deactivate_watchdog()
+        if _drain is not None:
+            _drain.__exit__(None, None, None)
         _close_guard(suppress=True)
 
 

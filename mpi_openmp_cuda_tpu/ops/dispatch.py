@@ -19,6 +19,7 @@ import numpy as np
 
 from ..models.encoding import encode_normalized, pad_to
 from ..resilience.faults import fire as _fault
+from ..resilience.watchdog import guard as _deadline_guard
 from ..utils.constants import ALPHABET_SIZE, BUF_SIZE_SEQ1, BUF_SIZE_SEQ2
 from .oracle import score_batch_oracle
 from .values import value_table
@@ -403,8 +404,9 @@ class PendingResult:
             f()
 
     def result(self) -> np.ndarray:
-        _fault("chunk_scoring")
-        return np.asarray(self.raw).reshape(-1, 3)[: self.count]
+        with _deadline_guard("chunk result gather"):
+            _fault("chunk_scoring")
+            return np.asarray(self.raw).reshape(-1, 3)[: self.count]
 
 
 @dataclass(frozen=True)
@@ -423,6 +425,10 @@ class BucketedPending:
             pend.prefetch()
 
     def result(self) -> np.ndarray:
+        with _deadline_guard("bucketed result gather"):
+            return self._result()
+
+    def _result(self) -> np.ndarray:
         import jax
 
         _fault("chunk_scoring")
@@ -531,7 +537,8 @@ class AlignmentScorer:
         Multi-length-bucket batches return a :class:`BucketedPending`
         (same ``.result()`` contract, input order restored).
         """
-        _fault("chunk_dispatch")
+        with _deadline_guard("chunk dispatch"):
+            _fault("chunk_dispatch")
         if not seq2_codes:
             return PendingResult(np.zeros((0, 3), dtype=np.int32), 0)
         if self.backend == "oracle":

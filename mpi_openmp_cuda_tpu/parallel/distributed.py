@@ -12,8 +12,28 @@ reference's ``MPI_Bcast`` of seq1/weights/sizes (main.c:149-152).
 
 from __future__ import annotations
 
+import functools
+
 from ..resilience.faults import fire as _fault
+from ..resilience.watchdog import guard as _deadline_guard
 from ..utils.platform import env_int, env_str
+
+
+def _guarded(describe: str):
+    """Arm the run's watchdog (if any) around a coordinator collective:
+    the broadcast half of the ``block_until_ready`` / broadcast / gather
+    boundary set the --deadline contract names.  A no-op context manager
+    when no watchdog is armed."""
+
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            with _deadline_guard(describe):
+                return fn(*args, **kwargs)
+
+        return wrapper
+
+    return deco
 
 
 def initialize_distributed(
@@ -65,6 +85,7 @@ def process_count() -> int:
     return jax.process_count()
 
 
+@_guarded("problem broadcast")
 def broadcast_problem(problem, *, failed: bool = False):
     """Broadcast a parsed Problem from process 0 to all processes.
 
@@ -158,6 +179,7 @@ def _bcast(arr):
     return np.asarray(multihost_utils.broadcast_one_to_all(arr))
 
 
+@_guarded("resume index-set broadcast")
 def broadcast_index_set(indices=None, *, failed: bool = False):
     """Two-phase broadcast of an int32 index array from the coordinator
     (workers pass ``None``); returns the array on every process.
@@ -199,6 +221,7 @@ def broadcast_index_set(indices=None, *, failed: bool = False):
     return _bcast(payload) if n else payload
 
 
+@_guarded("stream header broadcast")
 def broadcast_stream_meta(meta=None, *, failed: bool = False):
     """Broadcast a --stream run's fixed state (weights, seq1_codes,
     num_seq2) from the coordinator; workers pass ``None`` and receive the
@@ -234,6 +257,7 @@ def broadcast_stream_meta(meta=None, *, failed: bool = False):
     return [int(x) for x in weights], seq1, n
 
 
+@_guarded("chunk broadcast")
 def broadcast_chunk(codes=None, *, end: bool = False, failed: bool = False):
     """Broadcast one streaming chunk's (possibly journal-reduced) code
     arrays from the coordinator; workers pass ``None``.
@@ -286,3 +310,114 @@ def broadcast_chunk(codes=None, *, end: bool = False, failed: bool = False):
         lens[i] = c.size
     rows, lens = (_bcast(a) for a in (rows, lens))
     return [rows[i, : int(lens[i])] for i in range(n)]
+
+
+def scatter_gather_rescue(
+    seq1_codes,
+    seq2_codes,
+    weights,
+    *,
+    policy,
+    beacon_s: float,
+    backend: str = "xla",
+    board=None,
+    process_id: int | None = None,
+    num_processes: int | None = None,
+    run_tag: str = "batch0",
+    log=None,
+):
+    """Host-level scatter/gather scoring with lost-shard rescue (the
+    ``SEQALIGN_BEACON_S`` tier for ``--distributed`` batch runs).
+
+    The SPMD sharded path gathers results inside a collective, so a dead
+    worker hangs every peer until the coordination-service teardown and
+    the whole batch dies — the reference's MPI_Gatherv failure mode
+    (main.c:190-197) in TPU clothes.  This tier trades the collective
+    for the reference's *scatter* shape made survivable:
+
+    1. Every process derives the same contiguous index ledger
+       (:func:`resilience.rescue.shard_index_sets` — MPI_Scatter parity)
+       and scores its OWN shard on a LOCAL scorer.  No collectives:
+       a dead worker cannot hang anyone.
+    2. Each process posts a liveness beacon + its rows to the
+       coordination-service KV board (process 0's sidecar server, which
+       outlives dead workers).
+    3. The coordinator gathers each worker's shard under the beacon
+       deadline (watchdog-guarded); a timeout identifies exactly which
+       index-set the missing worker owned.
+    4. Orphaned indices are rescored locally through the degradation
+       chain (:func:`resilience.rescue.rescue_orphans`, local XLA
+       backend) — the run completes with byte-identical output, minus
+       the dead worker's speedup.
+
+    Returns the full [N, 3] int32 rows on the coordinator, None on
+    workers (they print nothing — main.c:199-211 semantics).
+    ``board`` / ``process_id`` / ``num_processes`` are injectable so the
+    lost-worker protocol is testable single-process (a worker that never
+    posted to a MemoryBoard IS a lost worker, deterministically).
+    """
+    import sys
+
+    import jax
+    import numpy as np
+
+    from ..ops.dispatch import AlignmentScorer
+    from ..resilience import rescue
+
+    pid = jax.process_index() if process_id is None else int(process_id)
+    nprocs = (
+        jax.process_count() if num_processes is None else int(num_processes)
+    )
+    log = log or (lambda msg: print(msg, file=sys.stderr))
+    if board is None:
+        board = (
+            rescue.MemoryBoard()
+            if nprocs == 1
+            else rescue.CoordinationBoard(beacon_s)
+        )
+    ledger = rescue.shard_index_sets(len(seq2_codes), nprocs)
+    mine = ledger[pid]
+    scorer = AlignmentScorer(backend=backend)
+    my_rows = (
+        scorer.score_codes(
+            seq1_codes, [seq2_codes[i] for i in mine], weights
+        )
+        if mine
+        else np.zeros((0, 3), dtype=np.int32)
+    )
+    rescue.post_shard(board, run_tag, pid, my_rows)
+    if pid != 0:
+        return None
+
+    out = np.zeros((len(seq2_codes), 3), dtype=np.int32)
+    if mine:
+        out[mine] = my_rows
+    lost = []
+    for w in range(1, nprocs):
+        idx = ledger[w]
+        if not idx:
+            continue
+        with _deadline_guard(f"shard gather (worker {w})"):
+            rows = rescue.fetch_shard(
+                board, run_tag, w, len(idx), timeout_s=beacon_s
+            )
+        if rows is None:
+            lost.append(w)
+            continue
+        out[idx] = rows
+    if lost:
+        orphans = [i for w in lost for i in ledger[w]]
+        log(
+            f"mpi_openmp_cuda_tpu: warning: worker(s) {lost} missed the "
+            f"{beacon_s:g}s beacon deadline; rescuing {len(orphans)} "
+            "orphaned sequence(s) on the coordinator's local backend"
+        )
+        out[orphans] = rescue.rescue_orphans(
+            seq1_codes,
+            [seq2_codes[i] for i in orphans],
+            weights,
+            policy=policy,
+            backend=backend,
+            log=log,
+        )
+    return out

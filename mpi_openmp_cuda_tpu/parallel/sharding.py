@@ -30,6 +30,7 @@ from ..ops.dispatch import (
     choose_chunk_rows,
     pad_batch_rows,
 )
+from ..resilience.watchdog import guard as _deadline_guard
 from .mesh import BATCH_AXIS, batch_sharded, make_mesh, replicated
 
 
@@ -83,7 +84,8 @@ class ShardedPending:
                 f()
 
     def result(self) -> np.ndarray:
-        return _fetch_global(self.out)[: self.count]
+        with _deadline_guard("sharded result gather"):
+            return _fetch_global(self.out)[: self.count]
 
 
 @dataclass

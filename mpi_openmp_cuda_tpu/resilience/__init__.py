@@ -20,6 +20,22 @@ scattered per-call-site handling migrated into:
   retry budget on the same chunk, fall down the backend chain
   (pallas -> xla -> xla-gather) with a logged warning, re-verifying the
   first degraded chunk against the host oracle (``--degrade``).
+* :mod:`.watchdog` — wall-clock deadlines (``--deadline`` /
+  ``SEQALIGN_DEADLINE_S``) around device work and coordinator
+  collectives: a monitor thread arms before each blocking boundary and
+  an expiry surfaces as the *transient*
+  :class:`~.watchdog.DeadlineExpiredError`, feeding the same
+  retry -> degrade chain as any raised fault.
+* :mod:`.drain` — graceful preemption: SIGTERM/SIGINT (or
+  ``SEQALIGN_DRAIN``) sets a drain flag checked at chunk boundaries;
+  in-flight results are flushed to the journal and the run exits 75
+  (EX_TEMPFAIL, resumable with ``--resume``).  A second signal
+  force-exits.
+* :mod:`.rescue` — lost-shard recovery for ``--distributed`` batch
+  runs (``SEQALIGN_BEACON_S``): per-process liveness beacons + result
+  posts on the coordination-service board, a deterministic shard
+  ledger naming the missing worker's index-set, and coordinator-side
+  rescoring of the orphans through the degradation chain.
 
 Everything here is pure stdlib + numpy-free at import time, so the
 instrumented modules (``ops``, ``io``, ``utils``, ``parallel``) can
@@ -34,16 +50,39 @@ from .faults import (
     deactivate_faults,
     fire,
 )
+from .drain import (
+    DrainInterrupt,
+    drain_guard,
+    drain_requested,
+    request_drain,
+)
 from .policy import FATAL_ERROR_TYPES, RetryExhaustedError, RetryPolicy
+from .watchdog import (
+    DeadlineExpiredError,
+    HangWithoutDeadlineError,
+    Watchdog,
+    activate_watchdog,
+    active_watchdog,
+    deactivate_watchdog,
+)
 
 __all__ = [
     "FATAL_ERROR_TYPES",
+    "DeadlineExpiredError",
+    "DrainInterrupt",
     "FaultRegistry",
+    "HangWithoutDeadlineError",
     "InjectedFatalFaultError",
     "InjectedFaultError",
     "RetryExhaustedError",
     "RetryPolicy",
+    "Watchdog",
     "activate_faults",
+    "activate_watchdog",
+    "active_watchdog",
     "deactivate_faults",
-    "fire",
+    "deactivate_watchdog",
+    "drain_guard",
+    "drain_requested",
+    "request_drain",
 ]

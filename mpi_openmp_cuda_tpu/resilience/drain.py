@@ -1,0 +1,135 @@
+"""Graceful preemption drain: SIGTERM/SIGINT -> flush -> resumable exit.
+
+The reference dies mid-batch on any signal, losing every scored result
+(fail-stop, `main.c` has no handlers).  On preemptible fleets SIGTERM is
+not an error — it is a *deadline*: the scheduler will follow with
+SIGKILL shortly, and the only useful response is to stop starting new
+work, flush what finished, and exit with a code the orchestrator can
+distinguish from failure.
+
+Mechanics:
+
+* :class:`drain_guard` installs SIGTERM/SIGINT handlers for the span of
+  one CLI run (main thread only; previous handlers are restored on
+  exit, so in-process callers — the test suite — never leak handlers).
+* The first signal sets a module flag; :func:`drain_requested` is
+  checked at every **chunk boundary** (the batch journal loop in
+  ``utils/journal.py`` and the ``--stream`` submit loop in
+  ``io/cli.py``) — never mid-collective, so multi-host schedules cannot
+  desynchronise.  ``SEQALIGN_DRAIN=1`` pre-arms the flag (deterministic
+  testing of the drain path without signals).
+* The boundary raises :class:`DrainInterrupt` after in-flight results
+  are flushed + fsync'd and a resumable-exit record is appended to the
+  journal; the CLI maps it to exit code 75 (``EX_TEMPFAIL``:
+  "temporary; rerun with ``--resume``") versus 65 for fatal errors.
+* A **second** signal during the drain force-exits immediately
+  (``os._exit(128 + signum)``): the operator escalated, obey.
+
+:class:`DrainInterrupt` derives from ``BaseException`` deliberately —
+the retry policy's transient net (``except Exception``) must not catch
+and retry a preemption, and the degradation chain must not "absorb" it.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import sys
+import threading
+
+
+class DrainInterrupt(BaseException):
+    """A drain request reached a chunk boundary: stop cleanly, exit 75.
+
+    BaseException (like KeyboardInterrupt): preemption must sail through
+    the retry/degrade machinery untouched.
+    """
+
+
+# One flag per process, like the fault registry: the CLI owns the run.
+_requested = False
+_signals = 0
+
+
+def drain_requested() -> bool:
+    """The chunk-boundary check (no clock, no syscall: one global read —
+    the decision input is an external signal, never time)."""
+    return _requested
+
+
+def request_drain(why: str, log=None) -> None:
+    """Set the drain flag (idempotent); logged once on the transition."""
+    global _requested
+    if not _requested:
+        _requested = True
+        (log or _stderr)(
+            f"mpi_openmp_cuda_tpu: drain requested ({why}); finishing "
+            "in-flight chunks, flushing the journal, then exiting 75 "
+            "(resumable) — a second signal force-exits"
+        )
+
+
+def _stderr(msg: str) -> None:
+    print(msg, file=sys.stderr)
+
+
+class drain_guard:
+    """Context manager installing the drain handlers for one run.
+
+    ``prearm=None`` reads ``SEQALIGN_DRAIN`` (typed env registry): a
+    pre-armed run drains at its first chunk boundary, which makes the
+    whole drain -> flush -> 75 -> ``--resume`` path an ordinary
+    deterministic test.  Handlers install only on the main thread
+    (CPython restriction) and the previous handlers are restored on
+    exit; the flag is reset on both entry and exit so consecutive
+    in-process runs never inherit a stale drain.
+    """
+
+    def __init__(self, *, prearm: bool | None = None, log=None):
+        self._prearm = prearm
+        self._log = log or _stderr
+        self._saved: list[tuple[int, object]] = []
+
+    def __enter__(self):
+        global _requested, _signals
+        prearm = self._prearm
+        if prearm is None:
+            from ..utils.platform import env_flag
+
+            prearm = env_flag("SEQALIGN_DRAIN")
+        _requested = bool(prearm)
+        _signals = 0
+        if threading.current_thread() is threading.main_thread():
+            for sig in (signal.SIGTERM, signal.SIGINT):
+                try:
+                    self._saved.append((sig, signal.signal(sig, self._on_signal)))
+                except (ValueError, OSError):  # pragma: no cover - exotic hosts
+                    continue
+        return self
+
+    def __exit__(self, *exc):
+        global _requested, _signals
+        saved, self._saved = self._saved, []
+        for sig, old in saved:
+            try:
+                signal.signal(sig, old)
+            except (ValueError, OSError):  # pragma: no cover
+                continue
+        _requested = False
+        _signals = 0
+        return False
+
+    def _on_signal(self, signum, frame) -> None:
+        global _signals
+        _signals += 1
+        if _signals > 1:
+            # Second signal during the drain: the operator (or the
+            # scheduler's escalation) means NOW.  os._exit skips every
+            # finally/atexit — flushed journal chunks are already
+            # fsync'd, so nothing durable is lost.
+            os._exit(128 + signum)
+        try:
+            name = signal.Signals(signum).name
+        except ValueError:  # pragma: no cover
+            name = f"signal {signum}"
+        request_drain(name, self._log)

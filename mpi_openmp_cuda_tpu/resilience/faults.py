@@ -45,6 +45,28 @@ Instrumented sites:
 ``broadcast_index_set``
 ``broadcast_stream_meta``
 ========================  ====================================================
+
+Survival-layer sites (PR 4) piggyback on those fire points but model a
+*different* failure shape — the operation never returns, or the process
+dies — instead of a raised error:
+
+========================  ====================================================
+``hang:dispatch``         ``chunk_dispatch`` never returns: blocks until the
+                          armed watchdog deadline, then surfaces the transient
+                          :class:`~.watchdog.DeadlineExpiredError`
+``hang:gather``           same at ``chunk_scoring`` (result materialisation —
+                          the ``block_until_ready`` / gather boundary)
+``hang:broadcast``        same at every ``broadcast_*`` coordinator collective
+``kill:journal-append``   SIGKILL this process at the scheduled
+                          ``journal_append`` — a deterministic mid-batch
+                          preemption for the kill-resume chaos tier
+========================  ====================================================
+
+Hang sites require an armed watchdog (``--deadline`` /
+``SEQALIGN_DEADLINE_S``); firing one without it is a fatal chaos-spec
+error (:class:`~.watchdog.HangWithoutDeadlineError`) — the alternative
+is a run that blocks forever.  ``kind=`` is meaningless for hang/kill
+sites and rejected.
 """
 
 from __future__ import annotations
@@ -61,8 +83,26 @@ KNOWN_SITES = frozenset(
         "broadcast_chunk",
         "broadcast_index_set",
         "broadcast_stream_meta",
+        "hang:dispatch",
+        "hang:gather",
+        "hang:broadcast",
+        "kill:journal-append",
     }
 )
+
+# Survival-site aliases: which *fire point* each hang/kill site rides.
+# The underlying site's fire() consults the alias schedule with the
+# alias's OWN invocation counter, so "hang:broadcast:fail=1,after=2"
+# means "the third broadcast of any kind hangs".
+_HANG_SITES = {
+    "chunk_dispatch": "hang:dispatch",
+    "chunk_scoring": "hang:gather",
+    "broadcast_problem": "hang:broadcast",
+    "broadcast_chunk": "hang:broadcast",
+    "broadcast_index_set": "hang:broadcast",
+    "broadcast_stream_meta": "hang:broadcast",
+}
+_KILL_SITES = {"journal_append": "kill:journal-append"}
 
 
 class InjectedFaultError(RuntimeError):
@@ -94,6 +134,11 @@ def parse_spec(spec: str) -> dict[str, SiteFaults]:
             continue
         site, sep, body = entry.partition(":")
         site = site.strip()
+        if site in ("hang", "kill"):
+            # Survival sites carry a colon in the NAME (hang:dispatch):
+            # re-partition so the first body segment joins the site.
+            sub, sep2, rest = body.partition(":")
+            site, sep, body = f"{site}:{sub.strip()}", sep2, rest
         if not sep or not body.strip():
             raise ValueError(
                 f"bad --faults entry {entry!r}: want site:fail=N[,after=M]"
@@ -132,6 +177,11 @@ def parse_spec(spec: str) -> dict[str, SiteFaults]:
                 kv[key] = n
         if "fail" not in kv:
             raise ValueError(f"--faults entry for {site!r} needs fail=N")
+        if "kind" in kv and site.partition(":")[0] in ("hang", "kill"):
+            raise ValueError(
+                f"--faults site {site!r} does not take kind= (a hang is "
+                "classified by the watchdog; a kill has no classification)"
+            )
         if site in sites:
             raise ValueError(f"duplicate --faults site {site!r}")
         sites[site] = SiteFaults(**kv)
@@ -146,11 +196,18 @@ class FaultRegistry:
         self.counts: dict[str, int] = {}
         self.injected = 0
 
-    def fire(self, site: str) -> None:
+    def _scheduled(self, site: str) -> bool:
+        """Bump ``site``'s invocation counter; True when this invocation
+        falls inside its scheduled [after, after+fail) window."""
         n = self.counts.get(site, 0)
         self.counts[site] = n + 1
         sf = self.sites.get(site)
-        if sf is not None and sf.after <= n < sf.after + sf.fail:
+        return sf is not None and sf.after <= n < sf.after + sf.fail
+
+    def fire(self, site: str) -> None:
+        n = self.counts.get(site, 0)
+        sf = self.sites.get(site)
+        if self._scheduled(site):
             self.injected += 1
             cls = (
                 InjectedFatalFaultError
@@ -160,6 +217,25 @@ class FaultRegistry:
             raise cls(
                 f"injected {sf.kind} fault at site {site!r} (invocation {n})"
             )
+        # Survival-site aliases ride this fire point with their OWN
+        # counters (counted only while armed, so schedules stay exact).
+        hang = _HANG_SITES.get(site)
+        if hang is not None and hang in self.sites and self._scheduled(hang):
+            self.injected += 1
+            from . import watchdog
+
+            # Blocks until the armed watchdog's deadline, then raises the
+            # transient DeadlineExpiredError (fatal if no watchdog armed).
+            watchdog.hang_until_deadline(hang)
+        kill = _KILL_SITES.get(site)
+        if kill is not None and kill in self.sites and self._scheduled(kill):
+            import os
+            import signal
+
+            # A deterministic preemption: SIGKILL is uncatchable, exactly
+            # like the scheduler's escalation.  Flushed journal chunks
+            # are already fsync'd; the in-flight chunk is lost by design.
+            os.kill(os.getpid(), signal.SIGKILL)
 
 
 # The armed registry.  Module-global, single-threaded by construction:

@@ -1,0 +1,186 @@
+"""Lost-shard rescue: beacons, the shard ledger, orphan rescoring.
+
+The reference's distribution tier is ``MPI_Scatter`` + ``MPI_Gatherv``
+(main.c:174-197): rank 0 owns the index ledger implicitly, and a dead
+rank kills the job inside the gather.  The TPU-native rescue tier
+(driven by :func:`parallel.distributed.scatter_gather_rescue`) keeps
+the scatter semantics but makes the gather survivable:
+
+* :func:`shard_index_sets` — the coordinator-side **ledger**: the same
+  deterministic contiguous split on every process, so "which index-set
+  did the missing worker own" is a pure function, not a negotiation.
+* A **board** — a tiny key-value bulletin each process posts its
+  liveness beacon and result rows to.  :class:`CoordinationBoard` backs
+  it with jax.distributed's coordination-service KV store (the one
+  multi-host channel that still works when a *peer* is dead — a
+  collective would hang); :class:`MemoryBoard` is the in-process
+  equivalent for single-process runs and simulated-loss tests, where a
+  missing key IS a missed deadline (deterministic, no clock).
+* :func:`fetch_shard` — the per-worker gather: beacon first, rows
+  second, timeout (``SEQALIGN_BEACON_S``) identifying the lost worker.
+  All timing lives in the board's blocking get (the monitoring
+  boundary); nothing here reads a clock (seqlint SEQ005).
+* :func:`rescue_orphans` — coordinator-side rescoring of the orphaned
+  indices on a LOCAL scorer through the PR 1 degradation chain
+  (xla -> xla-gather), so the run completes with byte-identical output
+  minus the dead worker's speedup.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+import numpy as np
+
+from .degrade import BackendDegrader, run_degrading
+
+
+def shard_index_sets(total: int, parts: int) -> list[list[int]]:
+    """The scatter ledger: a contiguous, balanced split of ``total``
+    sequence indices over ``parts`` workers (MPI_Scatter parity,
+    main.c:174 — earlier workers take the remainder).  Deterministic on
+    every process, so ledger agreement needs no communication."""
+    if parts < 1:
+        raise ValueError(f"shard ledger needs >= 1 worker, got {parts}")
+    base, extra = divmod(int(total), parts)
+    out, start = [], 0
+    for p in range(parts):
+        n = base + (1 if p < extra else 0)
+        out.append(list(range(start, start + n)))
+        start += n
+    return out
+
+
+class MemoryBoard:
+    """In-process bulletin board.
+
+    Used by single-process runs and by the simulated-lost-worker tests:
+    a worker that never posted simply has no key, and ``get`` returns
+    None immediately — absence is the deterministic analogue of a
+    missed wall-clock deadline.
+    """
+
+    def __init__(self):
+        self._kv: dict[str, str] = {}
+
+    def post(self, key: str, value: str) -> None:
+        self._kv[key] = value
+
+    def get(self, key: str, timeout_s: float | None = None) -> str | None:
+        return self._kv.get(key)
+
+
+class CoordinationBoard:
+    """jax.distributed coordination-service KV board (multi-host).
+
+    The coordination service is process 0's sidecar server, so it
+    outlives any dead *worker* — exactly the channel a lost-shard gather
+    needs.  ``get`` blocks up to the beacon deadline inside the service
+    client (the monitoring boundary; no clock reads here) and returns
+    None on timeout, which the caller treats as "worker lost".
+    """
+
+    def __init__(self, timeout_s: float):
+        if timeout_s <= 0:
+            raise ValueError(
+                f"beacon deadline must be > 0 seconds, got {timeout_s}"
+            )
+        self.timeout_s = float(timeout_s)
+
+    @staticmethod
+    def _client():
+        from jax._src import distributed as jax_distributed
+
+        client = jax_distributed.global_state.client
+        if client is None:
+            raise RuntimeError(
+                "no jax.distributed coordination service: the beacon board "
+                "needs --distributed (or use MemoryBoard single-process)"
+            )
+        return client
+
+    def post(self, key: str, value: str) -> None:
+        self._client().key_value_set(key, value)
+
+    def get(self, key: str, timeout_s: float | None = None) -> str | None:
+        timeout = self.timeout_s if timeout_s is None else float(timeout_s)
+        try:
+            return self._client().blocking_key_value_get(
+                key, int(timeout * 1000)
+            )
+        except Exception:
+            return None  # timeout == lost worker; the ledger names it
+
+
+def _beacon_key(run_tag: str, pid: int) -> str:
+    return f"seqalign/{run_tag}/beacon/{int(pid)}"
+
+
+def _rows_key(run_tag: str, pid: int) -> str:
+    return f"seqalign/{run_tag}/rows/{int(pid)}"
+
+
+def post_shard(board, run_tag: str, pid: int, rows) -> None:
+    """Worker side: liveness beacon first (cheap, lands even if the rows
+    post is what the worker dies inside), then the scored rows."""
+    board.post(_beacon_key(run_tag, pid), "scored")
+    rows = np.asarray(rows, dtype=np.int32)
+    board.post(_rows_key(run_tag, pid), json.dumps(rows.tolist()))
+
+
+def fetch_shard(
+    board, run_tag: str, pid: int, expect_n: int, timeout_s: float | None = None
+) -> np.ndarray | None:
+    """Coordinator side: gather one worker's shard under the beacon
+    deadline.  Returns the [expect_n, 3] rows, or None when the worker
+    is lost (no beacon, no rows, or rows of the wrong shape — a torn
+    post is rescored, never trusted)."""
+    if board.get(_beacon_key(run_tag, pid), timeout_s) is None:
+        return None
+    raw = board.get(_rows_key(run_tag, pid), timeout_s)
+    if raw is None:
+        return None
+    try:
+        rows = np.asarray(json.loads(raw), dtype=np.int32)
+    except (json.JSONDecodeError, ValueError):
+        return None
+    if rows.shape != (int(expect_n), 3):
+        return None
+    return rows
+
+
+def rescue_orphans(
+    seq1_codes,
+    orphan_codes,
+    weights,
+    *,
+    policy,
+    backend: str = "xla",
+    log=None,
+):
+    """Rescore a lost worker's orphaned sequences on a LOCAL scorer.
+
+    Runs through the degradation chain starting at ``backend`` (the
+    local XLA backend by default — the rescue path must not depend on
+    the same kernel runtime that may have taken the worker down), under
+    the run's retry policy.  Returns [len(orphan_codes), 3] int32 rows.
+    """
+    from ..ops.dispatch import AlignmentScorer
+
+    log = log or (lambda msg: print(msg, file=sys.stderr))
+    start = "xla" if backend in ("pallas", "auto") else backend
+    deg = BackendDegrader(
+        AlignmentScorer(backend=start),
+        lambda b: AlignmentScorer(backend=b),
+        enabled=True,
+        log=log,
+    )
+    return run_degrading(
+        policy,
+        deg,
+        lambda: deg.scorer.score_codes(seq1_codes, orphan_codes, weights),
+        lambda sc: sc.score_codes(seq1_codes, orphan_codes, weights),
+        "orphan rescue",
+        budget=policy.new_budget(),
+    )

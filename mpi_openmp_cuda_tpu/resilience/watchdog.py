@@ -1,0 +1,225 @@
+"""Wall-clock deadlines around device work and coordinator collectives.
+
+The reference's failure model assumes an operation either returns or
+raises (`cudaFunctions.cu:15-33`).  On real fleets there is a third
+outcome: it never comes back — a wedged device runtime blocking in
+``block_until_ready``, a collective whose peer was preempted.  The PR 1
+retry/degrade machinery only sees *raised* errors, so a hang starves it.
+
+This module closes that gap with a single monitor thread per run
+(``--deadline`` / ``SEQALIGN_DEADLINE_S``):
+
+* Each blocking boundary — result materialisation (the
+  ``block_until_ready`` analogue in ``ops/dispatch.py`` /
+  ``parallel/sharding.py``) and each coordinator broadcast in
+  ``parallel/distributed.py`` — arms a :meth:`Watchdog.guard` before
+  entering and disarms on exit.
+* The monitor waits on a ``threading.Condition`` with a timeout — note
+  no wall-clock *reads* anywhere: like ``time.sleep``, a condition
+  timeout delays, it does not decide, so the deterministic-path lint
+  (seqlint SEQ005) holds structurally and all timing stays at this one
+  monitoring boundary.
+* Expiry is classified **transient**: :class:`DeadlineExpiredError` is a
+  ``RuntimeError``, so the existing :class:`~.policy.RetryPolicy`
+  retries it and the :class:`~.degrade.BackendDegrader` chain absorbs a
+  persistently-hanging backend, exactly like a raised fault.
+
+Honesty note: Python cannot unwind a C call that genuinely never
+returns.  For *injected* hangs (the ``hang:*`` fault sites in
+:mod:`.faults`) the hang itself waits on the armed guard's expiry event
+and then raises, which makes the whole deadline -> retry -> degrade
+path deterministically chaos-testable; for a *real* hang the monitor
+logs a loud warning naming the stuck operation so an orchestrator (or
+the drain handler, :mod:`.drain`) can act on it.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import sys
+import threading
+
+#: The monitor thread's name: tests assert no thread with this name
+#: survives a clean CLI exit (the joined-on-stop contract).
+THREAD_NAME = "seqalign-watchdog"
+
+
+class DeadlineExpiredError(RuntimeError):
+    """A guarded operation outlived the watchdog deadline.  RuntimeError
+    == transient: the retry policy absorbs it and the degradation chain
+    sits behind that, the same path as any raised device fault."""
+
+
+class HangWithoutDeadlineError(ValueError):
+    """A ``hang:*`` fault site fired with no watchdog armed.  ValueError
+    == fatal (never retried): a chaos spec that injects hangs without
+    ``--deadline`` would hang the run forever, which is a configuration
+    error, not a fault to absorb."""
+
+
+class _Arm:
+    """One armed guard: the operation description plus the event the
+    monitor sets at expiry (injected hangs block on it)."""
+
+    __slots__ = ("describe", "expired")
+
+    def __init__(self, describe: str):
+        self.describe = describe
+        self.expired = threading.Event()
+
+
+class Watchdog:
+    """One monitor thread watching one armed operation at a time.
+
+    The instrumented boundaries are all on the driver thread (the same
+    single-threaded-by-construction argument as the fault registry), so
+    a single arm slot suffices; nested guards no-op under the outer
+    deadline.  ``stop()`` joins the thread — a run must not leave a
+    dangling monitor behind (asserted by the test suite).
+    """
+
+    def __init__(self, deadline_s: float, *, log=None):
+        if deadline_s <= 0:
+            raise ValueError(
+                f"watchdog deadline must be > 0 seconds, got {deadline_s}"
+            )
+        self.deadline_s = float(deadline_s)
+        self.expiries = 0
+        self._log = log or (lambda msg: print(msg, file=sys.stderr))
+        self._cond = threading.Condition()
+        self._arm: _Arm | None = None
+        self._stopped = False
+        self._thread: threading.Thread | None = None
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self) -> None:
+        if self._thread is None:
+            self._stopped = False
+            self._thread = threading.Thread(
+                target=self._monitor, name=THREAD_NAME, daemon=True
+            )
+            self._thread.start()
+
+    def stop(self) -> None:
+        """Stop and JOIN the monitor (idempotent)."""
+        with self._cond:
+            self._stopped = True
+            self._cond.notify_all()
+        thread, self._thread = self._thread, None
+        if thread is not None:
+            thread.join()
+
+    def _monitor(self) -> None:
+        with self._cond:
+            while not self._stopped:
+                if self._arm is None:
+                    self._cond.wait()
+                    continue
+                cur = self._arm
+                disarmed = self._cond.wait_for(
+                    lambda: self._stopped or self._arm is not cur,
+                    timeout=self.deadline_s,
+                )
+                if disarmed:
+                    continue
+                # Deadline hit while cur is still armed: signal expiry
+                # (an injected hang blocked on cur.expired now raises a
+                # transient DeadlineExpiredError into the retry policy),
+                # warn about the real-hang case, then wait for disarm.
+                self.expiries += 1
+                cur.expired.set()
+                self._log(
+                    f"mpi_openmp_cuda_tpu: warning: {cur.describe} exceeded "
+                    f"the {self.deadline_s:g}s watchdog deadline; if it "
+                    "never returns the process must be preempted externally "
+                    "(SIGTERM drains with journalled progress; see --resume)"
+                )
+                self._cond.wait_for(
+                    lambda: self._stopped or self._arm is not cur
+                )
+
+    # -- arming ------------------------------------------------------------
+    @contextlib.contextmanager
+    def guard(self, describe: str):
+        """Arm the monitor around one blocking operation.  Nested guards
+        are no-ops: the outermost deadline already covers them."""
+        with self._cond:
+            nested = self._arm is not None
+            if not nested:
+                token = _Arm(describe)
+                self._arm = token
+                self._cond.notify_all()
+        try:
+            yield
+        finally:
+            if not nested:
+                with self._cond:
+                    self._arm = None
+                    self._cond.notify_all()
+
+    def hang_until_expiry(self, site: str) -> None:
+        """The injected-hang behaviour (``hang:*`` fault sites): block on
+        the armed guard's expiry event, then surface the hang as the
+        transient :class:`DeadlineExpiredError` the retry policy absorbs.
+        With no guard armed the hang would block forever — fail fast."""
+        with self._cond:
+            token = self._arm
+        if token is None:
+            raise HangWithoutDeadlineError(
+                f"injected hang at {site!r} outside any watchdog guard; "
+                "refusing to block forever (this is a chaos-spec bug)"
+            )
+        token.expired.wait()
+        raise DeadlineExpiredError(
+            f"injected hang at {site!r}: {token.describe} exceeded the "
+            f"{self.deadline_s:g}s watchdog deadline"
+        )
+
+
+# The armed watchdog.  Module-global like the fault registry: armed per
+# run by the CLI, cleared in its finally, so library callers never see
+# an ambient deadline.
+_active: Watchdog | None = None
+
+
+def activate_watchdog(deadline_s: float, *, log=None) -> Watchdog:
+    """Arm (and start) a fresh watchdog for one run; returns it so the
+    caller can inspect ``expiries`` afterwards."""
+    global _active
+    deactivate_watchdog()
+    _active = Watchdog(deadline_s, log=log)
+    _active.start()
+    return _active
+
+
+def deactivate_watchdog() -> None:
+    """Stop + join the run's watchdog (no-op when none armed)."""
+    global _active
+    wd, _active = _active, None
+    if wd is not None:
+        wd.stop()
+
+
+def active_watchdog() -> Watchdog | None:
+    return _active
+
+
+def guard(describe: str):
+    """Instrumentation hook for the blocking boundaries: a context
+    manager arming the run's watchdog, or a no-op when none is armed."""
+    wd = _active
+    if wd is None:
+        return contextlib.nullcontext()
+    return wd.guard(describe)
+
+
+def hang_until_deadline(site: str) -> None:
+    """Entry point for the ``hang:*`` fault sites (see :mod:`.faults`)."""
+    wd = _active
+    if wd is None:
+        raise HangWithoutDeadlineError(
+            f"injected hang at {site!r} with no watchdog armed; hang "
+            "faults need --deadline (or SEQALIGN_DEADLINE_S) so the run "
+            "can classify the hang instead of blocking forever"
+        )
+    wd.hang_until_expiry(site)

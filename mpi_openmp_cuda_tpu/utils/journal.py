@@ -32,6 +32,7 @@ import os
 
 import numpy as np
 
+from ..resilience.drain import DrainInterrupt, drain_requested
 from ..resilience.faults import fire as _fault
 
 _FORMAT = "mpi_openmp_cuda_tpu.journal.v1"
@@ -51,6 +52,15 @@ def _read_records(path, fmt, fingerprint, parse_rec, foreign_hint="", mismatch_h
 
     ``parse_rec(rec) -> (key, value)``; malformed lines (a torn tail from a
     mid-write kill) are skipped — those sequences simply get rescored.
+    Event records (``{"event": ...}`` — e.g. the drain's resumable-exit
+    marker) are skipped the same way: they are audit state, not results.
+
+    Kill-shaped header damage is repaired, never escalated: a zero-length
+    file, a header-only file, and a torn (newline-less, nothing-after-it)
+    header line all read as an EMPTY journal — the header is fsync'd
+    before the first record, so none of those shapes can hold resumable
+    state.  A malformed header WITH content after it is real corruption
+    and still fails fast.
     """
     if not os.path.exists(path):
         return {}
@@ -62,6 +72,11 @@ def _read_records(path, fmt, fingerprint, parse_rec, foreign_hint="", mismatch_h
         try:
             header = json.loads(header_line)
         except json.JSONDecodeError as e:
+            if not header_line.endswith("\n") and not f.read(1):
+                # Torn header from a mid-write kill: the header write is
+                # fsync'd before any record, so a torn header means no
+                # record was ever durable — fresh journal, not an error.
+                return {}
             raise JournalMismatchError(
                 f"journal {path!r}: unreadable header: {e}"
             ) from e
@@ -96,6 +111,17 @@ def _write_records(f, recs) -> None:
     _fault("journal_append")
     for rec in recs:
         f.write(json.dumps(rec) + "\n")
+    f.flush()
+    os.fsync(f.fileno())
+
+
+def _write_event(f, name: str) -> None:
+    """Append one flushed event record (e.g. the drain's resumable-exit
+    marker).  Deliberately NOT a fault site: the event is advisory audit
+    state written on the way out of an already-exceptional path — resume
+    works whether or not it landed, and an injected failure here would
+    only mask the drain in flight."""
+    f.write(json.dumps({"event": name}) + "\n")
     f.flush()
     os.fsync(f.fileno())
 
@@ -210,6 +236,11 @@ class StreamJournal:
             ),
         )
 
+    def append_event(self, name: str) -> None:
+        """Append a flushed event record (the drain path's resumable-exit
+        marker); the resume reader skips it like any non-result line."""
+        _write_event(self._f, name)
+
 
 def _repair_torn_tail(path: str) -> None:
     """Append a newline if a mid-write kill left a torn final line (gluing
@@ -291,6 +322,17 @@ class ResultJournal:
         # workers (append=None) must run literally the same code.
         def _run(append):
             for start in range(0, len(pending), self.chunk):
+                if append is not None and drain_requested():
+                    # Chunk-boundary drain (coordinator/single-process
+                    # only: workers run append=None and follow the
+                    # coordinator's schedule).  Everything scored so far
+                    # is already flushed + fsync'd; the caller appends
+                    # the resumable-exit record and the CLI exits 75.
+                    raise DrainInterrupt(
+                        f"{total - len(pending) + start} of {total} "
+                        "sequences journalled; rerun with --resume to "
+                        "score the rest"
+                    )
                 idx = pending[start : start + self.chunk]
                 rows = scorer.score_codes(
                     problem.seq1_codes,
@@ -324,5 +366,9 @@ class ResultJournal:
                 )
                 f.flush()
                 os.fsync(f.fileno())
-            _run(lambda idx, rows: self._append(f, idx, rows))
+            try:
+                _run(lambda idx, rows: self._append(f, idx, rows))
+            except DrainInterrupt:
+                _write_event(f, "drain")
+                raise
         return results

@@ -90,6 +90,27 @@ ENV_VARS: tuple[EnvVar, ...] = (
         "enable runtime dispatch-contract validation (same as --check)",
     ),
     EnvVar(
+        "SEQALIGN_DEADLINE_S",
+        "float",
+        None,
+        "watchdog deadline (seconds) around device work and coordinator "
+        "collectives (same as --deadline; expiry is a transient fault)",
+    ),
+    EnvVar(
+        "SEQALIGN_DRAIN",
+        "flag",
+        False,
+        "pre-arm the graceful-preemption drain: the run flushes and "
+        "exits 75 (resumable) at its first chunk boundary",
+    ),
+    EnvVar(
+        "SEQALIGN_BEACON_S",
+        "float",
+        None,
+        "liveness-beacon / shard-gather deadline (seconds) enabling the "
+        "lost-shard rescue tier under --distributed batch runs",
+    ),
+    EnvVar(
         "JAX_COORDINATOR_ADDRESS",
         "str",
         None,

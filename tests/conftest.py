@@ -144,6 +144,11 @@ def pytest_configure(config):
         "SEQALIGN_FAULTS chaos spec would perturb; skipped under `make "
         "chaos`",
     )
+    config.addinivalue_line(
+        "markers",
+        "chaos_kill: SIGKILL-mid-batch kill-resume subprocess tests "
+        "(slow-marked too); selected by `make chaos-kill`",
+    )
 
 
 def pytest_addoption(parser):

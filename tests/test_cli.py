@@ -90,7 +90,7 @@ def test_profile_goes_to_stderr_not_stdout(capsys):
 def test_malformed_input_fails_cleanly(tmp_path, capsys):
     bad = tmp_path / "bad.txt"
     bad.write_text("1 2 3\n")
-    out, err = run_inproc("--input", str(bad), capsys=capsys, rc_want=1)
+    out, err = run_inproc("--input", str(bad), capsys=capsys, rc_want=65)
     assert "error" in err.lower()
     assert out == ""
 
@@ -98,7 +98,7 @@ def test_malformed_input_fails_cleanly(tmp_path, capsys):
 def test_invalid_character_fails_cleanly(tmp_path, capsys):
     bad = tmp_path / "bad.txt"
     bad.write_text("1 2 3 4\nAB9C\n1\nAB\n")
-    out, err = run_inproc("--input", str(bad), capsys=capsys, rc_want=1)
+    out, err = run_inproc("--input", str(bad), capsys=capsys, rc_want=65)
     assert "invalid sequence character" in err
 
 

@@ -309,10 +309,10 @@ def test_two_process_stream_stale_journal_aborts_worker(tmp_path):
         "--stream", "2", "--journal", str(journal),
         stdin_path=fixture_path("mixedcase"),
     )
-    assert rc0 == 1
+    assert rc0 == 65
     assert out0 == ""
     assert "different problem" in err0
-    assert rc1 == 1, f"worker should abort, got rc={rc1}:\n{err1}"
+    assert rc1 == 65, f"worker should abort, got rc={rc1}:\n{err1}"
     assert out1 == ""
 
 
@@ -419,9 +419,9 @@ def test_two_process_parse_failure_aborts_worker_instead_of_hanging():
     (rc0, out0, err0), (rc1, out1, err1) = _launch_pair(
         coordinator_stdin="1 2 3\n"
     )
-    assert rc0 == 1
+    assert rc0 == 65
     assert out0 == ""
-    assert rc1 == 1, f"worker should abort, got rc={rc1}:\n{err1}"
+    assert rc1 == 65, f"worker should abort, got rc={rc1}:\n{err1}"
     assert "abort" in err1.lower() or "coordinator failed" in err1
 
 

@@ -159,7 +159,7 @@ def test_retries_exhausted_fails(monkeypatch, capsys):
     monkeypatch.setattr(AlignmentScorer, "score_codes", always_down)
     rc = cli.run(["--retries", "1", "--input", fixture_path("tiny")])
     captured = capsys.readouterr()
-    assert rc == 1
+    assert rc == 65
     assert captured.out == ""
     assert "persistent device loss" in captured.err
 
@@ -177,7 +177,7 @@ def test_retries_does_not_mask_value_errors(monkeypatch, capsys):
     monkeypatch.setattr(AlignmentScorer, "score_codes", bad_shape)
     rc = cli.run(["--retries", "5", "--input", fixture_path("tiny")])
     capsys.readouterr()
-    assert rc == 1
+    assert rc == 65
     assert calls["n"] == 1  # not retried
 
 
@@ -201,7 +201,7 @@ def test_bad_mesh_specs_fail_clearly(spec, capsys):
 
     rc = cli.run(["--mesh", spec, "--input", fixture_path("tiny")])
     captured = capsys.readouterr()
-    assert rc == 1
+    assert rc == 65
     assert captured.out == ""
     assert "bad --mesh spec" in captured.err
 

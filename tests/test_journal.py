@@ -179,3 +179,105 @@ def test_stream_journal_enter_without_load_validates_header(tmp_path):
     lines = open(path).read().splitlines()
     assert lines[: len(before.splitlines())] == before.splitlines()
     assert len(lines) == 3  # header + both records survived
+
+
+# -- kill-shaped journal damage (PR 4 hardening) ----------------------------
+
+
+def _header_line(problem) -> str:
+    return json.dumps(
+        {
+            "format": "mpi_openmp_cuda_tpu.journal.v1",
+            "fingerprint": problem_fingerprint(problem),
+            "num_seq2": problem.num_seq2,
+        }
+    )
+
+
+def test_zero_length_journal_reads_as_fresh(tmp_path):
+    # A kill between open("w") and the header write leaves a 0-byte file;
+    # the next run must treat it as a fresh journal, not corruption.
+    problem = _problem()
+    path = str(tmp_path / "j.jsonl")
+    open(path, "w").close()
+    scorer = CountingScorer()
+    rows = ResultJournal(path, chunk=3).score_with_resume(scorer, problem)
+    want = AlignmentScorer(backend="oracle").score_codes(
+        problem.seq1_codes, problem.seq2_codes, problem.weights
+    )
+    np.testing.assert_array_equal(rows, want)
+    assert sum(scorer.calls) == problem.num_seq2
+
+
+def test_header_only_journal_reads_as_fresh(tmp_path):
+    # Killed after the header fsync but before any record: no resumable
+    # state — everything rescored, journal still usable afterwards.
+    problem = _problem()
+    path = str(tmp_path / "j.jsonl")
+    with open(path, "w") as f:
+        f.write(_header_line(problem) + "\n")
+    journal = ResultJournal(path, chunk=3)
+    assert journal.load_done(problem) == {}
+    rows = journal.score_with_resume(CountingScorer(), problem)
+    want = AlignmentScorer(backend="oracle").score_codes(
+        problem.seq1_codes, problem.seq2_codes, problem.weights
+    )
+    np.testing.assert_array_equal(rows, want)
+
+
+def test_torn_header_reads_as_fresh(tmp_path):
+    # Killed MID header write (no trailing newline, nothing after it):
+    # the header is fsync'd before any record, so a torn header proves no
+    # record was ever durable — fresh journal, not an error.
+    problem = _problem()
+    path = str(tmp_path / "j.jsonl")
+    with open(path, "w") as f:
+        f.write(_header_line(problem)[:25])
+    assert ResultJournal(path).load_done(problem) == {}
+    rows = ResultJournal(path, chunk=3).score_with_resume(
+        CountingScorer(), problem
+    )
+    want = AlignmentScorer(backend="oracle").score_codes(
+        problem.seq1_codes, problem.seq2_codes, problem.weights
+    )
+    np.testing.assert_array_equal(rows, want)
+
+
+def test_malformed_header_with_records_still_rejected(tmp_path):
+    # A garbage header FOLLOWED by content is real corruption (no kill
+    # shape produces it: records only exist after the header fsync'd
+    # whole) — it must fail fast, never silently rescore over it.
+    problem = _problem()
+    path = str(tmp_path / "j.jsonl")
+    with open(path, "w") as f:
+        f.write("{this is not json\n")
+        f.write(json.dumps({"index": 0, "score": 1, "n": 0, "k": 0}) + "\n")
+    with pytest.raises(JournalMismatchError, match="unreadable header"):
+        ResultJournal(path).load_done(problem)
+
+
+def test_valid_records_survive_torn_tail(tmp_path):
+    # Header + 2 whole records + a torn third: both whole records must be
+    # reused (never truncated away with the tail) and the torn line is
+    # repaired in place so the resumed appends don't glue onto it.
+    problem = _problem()
+    path = str(tmp_path / "j.jsonl")
+    want = AlignmentScorer(backend="oracle").score_codes(
+        problem.seq1_codes, problem.seq2_codes, problem.weights
+    )
+    with open(path, "w") as f:
+        f.write(_header_line(problem) + "\n")
+        for i in range(2):
+            s, n, k = (int(x) for x in want[i])
+            f.write(json.dumps({"index": i, "score": s, "n": n, "k": k}) + "\n")
+        f.write('{"index": 2, "sc')
+    scorer = CountingScorer()
+    rows = ResultJournal(path, chunk=3).score_with_resume(scorer, problem)
+    np.testing.assert_array_equal(rows, want)
+    assert sum(scorer.calls) == problem.num_seq2 - 2  # 0 and 1 reused
+    with open(path) as f:
+        lines = f.read().splitlines()
+    # Whole file now parses line-by-line except the repaired torn stub.
+    assert json.loads(lines[0])["format"] == "mpi_openmp_cuda_tpu.journal.v1"
+    done = ResultJournal(path).load_done(problem)
+    assert sorted(done) == list(range(problem.num_seq2))

@@ -260,7 +260,7 @@ def test_batch_over_budget_faults_fail_stop(capsys):
         "--retries", "1",
         "--faults", "chunk_scoring:fail=5",
         capsys=capsys,
-        rc_want=1,
+        rc_want=65,
     )
     assert out == ""  # fail-stop: nothing on stdout
     assert "retry budget exhausted" in err
@@ -284,7 +284,7 @@ def test_stream_over_budget_faults_fail_stop(capsys):
         "--stream", "3",
         "--faults", "chunk_scoring:fail=99",
         capsys=capsys,
-        rc_want=1,
+        rc_want=65,
     )
     assert out == ""
     assert "retry budget exhausted" in err
@@ -309,7 +309,7 @@ def test_stream_chunk_budget_is_shared_across_stages(capsys):
         "--retries", "1",
         "--faults", spec,
         capsys=capsys,
-        rc_want=1,
+        rc_want=65,
     )
     assert out == "" and "retry budget exhausted" in err
 
@@ -334,7 +334,7 @@ def test_injected_fatal_fault_skips_retries(capsys):
         "--retries", "5",
         "--faults", "chunk_scoring:fail=1,kind=fatal",
         capsys=capsys,
-        rc_want=1,
+        rc_want=65,
     )
     assert out == ""
     assert "injected fatal fault" in err
@@ -346,7 +346,7 @@ def test_malformed_faults_spec_fails_fast(capsys):
         "--input", fixture_path("tiny"),
         "--faults", "warp_core:fail=1",
         capsys=capsys,
-        rc_want=1,
+        rc_want=65,
     )
     assert "error:" in err and "known sites" in err
 
@@ -372,7 +372,7 @@ def test_explicit_faults_override_env_without_floor(monkeypatch, capsys):
         "--input", fixture_path("tiny"),
         "--faults", "chunk_scoring:fail=1",
         capsys=capsys,
-        rc_want=1,
+        rc_want=65,
     )
     assert out == "" and "retry budget exhausted" in err
 
@@ -439,7 +439,7 @@ def test_degrade_chain_exhaustion_fails_stop(capsys):
         "--faults", "chunk_scoring:fail=99",
         "--degrade",
         capsys=capsys,
-        rc_want=1,
+        rc_want=65,
     )
     assert out == ""
     assert "degrading to 'xla-gather'" in err  # it DID try the chain
@@ -451,7 +451,7 @@ def test_degrade_rejected_under_distributed(capsys):
         "--degrade", "--distributed",
         "--input", fixture_path("tiny"),
         capsys=capsys,
-        rc_want=1,
+        rc_want=64,
     )
     assert "--distributed cannot be combined with --degrade" in err
 
@@ -470,7 +470,7 @@ def test_stream_journal_mid_fault_then_resume(tmp_path, capsys):
         "--journal", path,
         "--faults", "chunk_scoring:fail=99,after=2",
         capsys=capsys,
-        rc_want=1,
+        rc_want=65,
     )
     assert out == "" and "retry budget exhausted" in err
     with open(path) as f:

@@ -144,7 +144,7 @@ def test_cli_long_context_via_seq_mesh(tmp_path, capsys, rng):
 
     # Without a seq mesh the reference cap still applies (contract parity).
     rc = run(["--input", str(inp)])
-    assert rc == 1
+    assert rc == 65
     assert "exceeds BUF_SIZE_SEQ1" in capsys.readouterr().err
 
 

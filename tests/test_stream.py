@@ -53,7 +53,7 @@ def test_stream_rejects_selfcheck(tmp_path, capsys):
     path = reference_fixture("input5.txt")
     _, err = run_inproc(
         "--stream", "2", "--selfcheck", "--input", path, capsys=capsys,
-        rc_want=1,
+        rc_want=64,
     )
     assert "cannot be combined with --stream" in err
 
@@ -103,7 +103,7 @@ def test_stream_journal_rejects_changed_input(tmp_path, capsys):
     mutated.write_text(" ".join(text) + "\n")
     _, err = run_inproc(
         "--stream", "2", "--journal", j, "--input", str(mutated),
-        capsys=capsys, rc_want=1,
+        capsys=capsys, rc_want=65,
     )
     assert "does not match the input" in err
     # Different Seq1 entirely: header fingerprint mismatch.
@@ -111,7 +111,7 @@ def test_stream_journal_rejects_changed_input(tmp_path, capsys):
     mutated.write_text(" ".join(text) + "\n")
     _, err = run_inproc(
         "--stream", "2", "--journal", j, "--input", str(mutated),
-        capsys=capsys, rc_want=1,
+        capsys=capsys, rc_want=65,
     )
     assert "different problem" in err
 
@@ -124,11 +124,11 @@ def test_stream_journal_and_batch_journal_are_mutually_foreign(tmp_path, capsys)
     run_inproc("--stream", "2", "--journal", js, "--input", path, capsys=capsys)
     _, err = run_inproc(
         "--stream", "2", "--journal", jb, "--input", path, capsys=capsys,
-        rc_want=1,
+        rc_want=65,
     )
     assert "stream-journal" in err
     _, err = run_inproc(
-        "--journal", js, "--input", path, capsys=capsys, rc_want=1
+        "--journal", js, "--input", path, capsys=capsys, rc_want=65
     )
 
 
@@ -155,7 +155,7 @@ def test_stream_truncated_input_emits_nothing(tmp_path, capsys):
     bad = tmp_path / "trunc.txt"
     bad.write_text("10 2 3 4\nABCDEFGH\n5\nAB\nCD\n")
     out, err = run_inproc(
-        "--stream", "2", "--input", str(bad), capsys=capsys, rc_want=1
+        "--stream", "2", "--input", str(bad), capsys=capsys, rc_want=65
     )
     assert out == ""
     assert "ended at 2" in err
@@ -209,7 +209,7 @@ def test_stream_retries_transient_dispatch_failure(monkeypatch, capsys):
     monkeypatch.setattr(cli, "AlignmentScorer", flaky(2))
     rc = cli.run(["--stream", "2", "--input", path])
     cap = capsys.readouterr()
-    assert rc == 1
+    assert rc == 65
     assert cap.out == ""  # fail-stop: no partial results
 
 

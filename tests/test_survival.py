@@ -1,0 +1,524 @@
+"""Preemption-survival tests: watchdog deadlines, graceful drain,
+lost-shard rescue, and the exit-code contract (PR 4).
+
+Three failure shapes the PR 1 retry/degrade machinery could not see:
+
+* an operation that never RETURNS (``hang:*`` fault sites + the
+  ``--deadline`` watchdog classifying the hang as a transient fault);
+* a process asked to STOP (SIGTERM/SIGINT -> chunk-boundary drain ->
+  flushed journal -> exit 75 -> ``--resume``);
+* a *peer* process that DIED (the ``SEQALIGN_BEACON_S`` lost-shard
+  rescue tier: beacons + shard ledger + coordinator-side rescoring).
+
+The kill-resume tests (SIGKILL mid-batch via ``kill:journal-append``)
+run real subprocesses and are slow + chaos_kill marked: `make chaos-kill`.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+
+import numpy as np
+import pytest
+
+from conftest import run_cli_inproc as run_inproc
+from test_fixtures import fixture_path, golden
+
+from mpi_openmp_cuda_tpu.resilience import (
+    DeadlineExpiredError,
+    HangWithoutDeadlineError,
+    activate_faults,
+    activate_watchdog,
+    deactivate_faults,
+    deactivate_watchdog,
+)
+from mpi_openmp_cuda_tpu.resilience import drain as drain_mod
+from mpi_openmp_cuda_tpu.resilience import rescue
+from mpi_openmp_cuda_tpu.resilience.policy import RetryPolicy
+from mpi_openmp_cuda_tpu.resilience.watchdog import THREAD_NAME, guard
+
+
+@pytest.fixture(autouse=True)
+def _fast_backoff(monkeypatch):
+    # e2e retries must not sleep through real backoff.
+    monkeypatch.setenv("SEQALIGN_BACKOFF_BASE", "0")
+    # This module controls its own deadlines/drain explicitly; shed any
+    # ambient survival env (e.g. a `make chaos` shell).
+    monkeypatch.delenv("SEQALIGN_DEADLINE_S", raising=False)
+    monkeypatch.delenv("SEQALIGN_DRAIN", raising=False)
+    monkeypatch.delenv("SEQALIGN_BEACON_S", raising=False)
+
+
+def _watchdog_threads():
+    return [t for t in threading.enumerate() if t.name == THREAD_NAME]
+
+
+# -- watchdog unit ---------------------------------------------------------
+
+
+def test_guard_is_noop_without_watchdog():
+    with guard("anything"):
+        pass  # no watchdog armed: nullcontext, no thread
+    assert _watchdog_threads() == []
+
+
+def test_activate_deactivate_joins_monitor_thread():
+    wd = activate_watchdog(5.0)
+    try:
+        assert len(_watchdog_threads()) == 1
+        with wd.guard("covered op"):
+            pass
+    finally:
+        deactivate_watchdog()
+    assert _watchdog_threads() == []  # stop() JOINS, never leaks
+    assert wd.expiries == 0
+
+
+def test_injected_hang_surfaces_transient_expiry(capsys):
+    wd = activate_watchdog(0.05)
+    try:
+        with wd.guard("covered op"):
+            with pytest.raises(DeadlineExpiredError, match="covered op"):
+                wd.hang_until_expiry("hang:test")
+    finally:
+        deactivate_watchdog()
+    assert wd.expiries == 1
+    assert isinstance(DeadlineExpiredError("x"), RuntimeError)  # transient
+
+
+def test_injected_hang_without_watchdog_is_fatal():
+    from mpi_openmp_cuda_tpu.resilience.watchdog import hang_until_deadline
+
+    with pytest.raises(HangWithoutDeadlineError, match="no watchdog"):
+        hang_until_deadline("hang:test")
+    assert isinstance(HangWithoutDeadlineError("x"), ValueError)  # fatal
+
+
+def test_hang_broadcast_site_fires_inside_guarded_broadcast():
+    # Single-process broadcast_problem still passes its fire point inside
+    # the @_guarded span, so hang:broadcast is classified by the watchdog.
+    from mpi_openmp_cuda_tpu.parallel import distributed as dist
+
+    activate_faults("hang:broadcast:fail=1")
+    wd = activate_watchdog(0.05)
+    try:
+        with pytest.raises(DeadlineExpiredError, match="problem broadcast"):
+            dist.broadcast_problem(object())
+    finally:
+        deactivate_watchdog()
+        deactivate_faults()
+    assert wd.expiries == 1
+
+
+# -- watchdog e2e ----------------------------------------------------------
+
+
+def test_hang_dispatch_retried_under_deadline(capsys):
+    out, err = run_inproc(
+        "--input", fixture_path("tiny"),
+        "--retries", "2",
+        "--deadline", "0.05",
+        "--faults", "hang:dispatch:fail=1",
+        capsys=capsys,
+    )
+    assert out == golden("tiny")  # byte-identical despite the hang
+    assert "watchdog deadline" in err and "retrying" in err
+    assert _watchdog_threads() == []  # joined on clean exit
+
+
+def test_hang_gather_retried_under_deadline(capsys):
+    out, err = run_inproc(
+        "--input", fixture_path("tiny"),
+        "--retries", "2",
+        "--deadline", "0.05",
+        "--faults", "hang:gather:fail=1",
+        capsys=capsys,
+    )
+    assert out == golden("tiny")
+    assert "watchdog deadline" in err
+
+
+def test_deadline_rooted_exhaustion_exits_resumable(capsys):
+    # Budget exhausted on deadline expiries: the input was never judged
+    # bad, so the exit is 75 (rerun), not the fatal 65.
+    out, err = run_inproc(
+        "--input", fixture_path("tiny"),
+        "--retries", "0",
+        "--deadline", "0.05",
+        "--faults", "hang:dispatch:fail=3",
+        capsys=capsys,
+        rc_want=75,
+    )
+    assert out == ""
+    assert "retry budget exhausted" in err
+    assert _watchdog_threads() == []
+
+
+@pytest.mark.no_chaos  # ambient SEQALIGN_DEADLINE_S would classify the hang
+def test_hang_without_deadline_fails_fast(capsys):
+    out, err = run_inproc(
+        "--input", fixture_path("tiny"),
+        "--retries", "5",
+        "--faults", "hang:dispatch:fail=1",
+        capsys=capsys,
+        rc_want=65,
+    )
+    assert out == ""
+    assert "no watchdog armed" in err
+    assert "retrying" not in err  # fatal: never retried
+
+
+# -- drain e2e -------------------------------------------------------------
+
+
+@pytest.mark.no_chaos  # exact journal contents; ambient hang has no deadline here
+def test_prearmed_drain_batch_journal_then_resume(tmp_path, monkeypatch, capsys):
+    path = str(tmp_path / "j.jsonl")
+    monkeypatch.setenv("SEQALIGN_DRAIN", "1")
+    out, err = run_inproc(
+        "--input", fixture_path("tiny"),
+        "--journal", path,
+        capsys=capsys,
+        rc_want=75,
+    )
+    assert out == ""  # fail-stop stdout even on a clean drain
+    assert "drained" in err and "--resume" in err
+    with open(path) as f:
+        lines = [json.loads(l) for l in f.read().splitlines()]
+    assert lines[0]["format"].endswith("journal.v1")
+    assert {"event": "drain"} in lines  # the resumable-exit record
+    monkeypatch.delenv("SEQALIGN_DRAIN")
+    out, _ = run_inproc(
+        "--input", fixture_path("tiny"),
+        "--journal", path, "--resume",
+        capsys=capsys,
+    )
+    assert out == golden("tiny")
+
+
+def test_prearmed_drain_without_journal_still_resumable_exit(monkeypatch, capsys):
+    # Batch mode without a journal: nothing durable to flush, but the
+    # supervisor contract (75 = rerun me) holds.
+    monkeypatch.setenv("SEQALIGN_DRAIN", "1")
+    out, err = run_inproc(
+        "--input", fixture_path("tiny"),
+        "--stream", "2",
+        capsys=capsys,
+        rc_want=75,
+    )
+    assert out == ""
+    assert "starts over" in err
+
+
+@pytest.mark.no_chaos  # exact journalled-record accounting; ambient hang has no deadline
+def test_sigterm_mid_stream_drains_then_resume(tmp_path, monkeypatch, capsys):
+    # A real signal, delivered synchronously between chunk submissions:
+    # the handler requests a drain, the loop stops admitting chunks, the
+    # in-flight window flushes to the journal, the run exits 75, and the
+    # --resume rerun reproduces the goldens byte-identically.
+    from mpi_openmp_cuda_tpu.ops.dispatch import AlignmentScorer
+
+    path = str(tmp_path / "j.jsonl")
+    calls = {"n": 0}
+    orig = AlignmentScorer.score_codes_async
+
+    def signalling(self, *a, **kw):
+        calls["n"] += 1
+        if calls["n"] == 2:
+            signal.raise_signal(signal.SIGTERM)
+        return orig(self, *a, **kw)
+
+    monkeypatch.setattr(AlignmentScorer, "score_codes_async", signalling)
+    out, err = run_inproc(
+        "--input", fixture_path("stress_small"),
+        "--stream", "3",
+        "--journal", path,
+        capsys=capsys,
+        rc_want=75,
+    )
+    assert out == ""
+    assert "drain requested (SIGTERM)" in err
+    assert "preempted before sequence" in err
+    with open(path) as f:
+        recs = [json.loads(l) for l in f.read().splitlines()]
+    assert {"event": "drain"} in recs
+    assert sum(1 for r in recs if "index" in r) >= 3  # in-flight flushed
+
+    monkeypatch.setattr(AlignmentScorer, "score_codes_async", orig)
+    out, _ = run_inproc(
+        "--input", fixture_path("stress_small"),
+        "--stream", "3",
+        "--journal", path, "--resume",
+        capsys=capsys,
+    )
+    assert out == golden("stress_small")
+
+
+@pytest.mark.no_chaos
+def test_cli_run_leaves_no_signal_handlers(capsys):
+    # The tier-1 guard: an in-process cli.run must restore SIGTERM/SIGINT
+    # exactly (the suite — and any library caller — never inherits the
+    # drain handlers), and must join its watchdog thread.
+    before = (signal.getsignal(signal.SIGTERM), signal.getsignal(signal.SIGINT))
+    run_inproc(
+        "--input", fixture_path("tiny"), "--deadline", "5", capsys=capsys
+    )
+    after = (signal.getsignal(signal.SIGTERM), signal.getsignal(signal.SIGINT))
+    assert after == before
+    assert _watchdog_threads() == []
+    assert not drain_mod.drain_requested()
+
+
+# -- exit-code contract ----------------------------------------------------
+
+
+@pytest.mark.no_chaos  # asserts exact codes an ambient spec would perturb
+def test_exit_code_contract(tmp_path, monkeypatch, capsys):
+    from mpi_openmp_cuda_tpu.io import cli
+
+    assert (cli.EX_OK, cli.EX_USAGE, cli.EX_FATAL, cli.EX_TEMPFAIL) == (
+        0, 64, 65, 75,
+    )
+    # 0: success.
+    run_inproc("--input", fixture_path("tiny"), capsys=capsys, rc_want=0)
+    # 64: flag-combination rejections, before any expensive phase.
+    _, err = run_inproc(
+        "--input", fixture_path("tiny"), "--resume",
+        capsys=capsys, rc_want=64,
+    )
+    assert "--resume requires --journal" in err
+    run_inproc(
+        "--input", fixture_path("tiny"), "--stream", "2", "--selfcheck",
+        capsys=capsys, rc_want=64,
+    )
+    # 65: fatal (bad input data).
+    bad = tmp_path / "bad.txt"
+    bad.write_text("1 2 3\n")
+    run_inproc("--input", str(bad), capsys=capsys, rc_want=65)
+    # 65: --resume asserting a journal that does not exist.
+    _, err = run_inproc(
+        "--input", fixture_path("tiny"),
+        "--journal", str(tmp_path / "nope.jsonl"), "--resume",
+        capsys=capsys, rc_want=65,
+    )
+    assert "does not exist" in err
+    # 75: resumable (pre-armed drain).
+    monkeypatch.setenv("SEQALIGN_DRAIN", "1")
+    run_inproc(
+        "--input", fixture_path("tiny"),
+        "--journal", str(tmp_path / "j.jsonl"),
+        capsys=capsys, rc_want=75,
+    )
+
+
+@pytest.mark.no_chaos  # exact record counts; ambient journal_append fault perturbs them
+def test_plain_journal_still_resumes_opportunistically(tmp_path, capsys):
+    # --resume is an assertion, not a requirement: a fresh path with
+    # plain --journal keeps working exactly as before this PR.
+    path = str(tmp_path / "fresh.jsonl")
+    out, _ = run_inproc(
+        "--input", fixture_path("tiny"), "--journal", path, capsys=capsys
+    )
+    assert out == golden("tiny")
+
+
+# -- lost-shard rescue -----------------------------------------------------
+
+
+def test_shard_index_sets_contiguous_balanced():
+    assert rescue.shard_index_sets(7, 3) == [[0, 1, 2], [3, 4], [5, 6]]
+    assert rescue.shard_index_sets(4, 4) == [[0], [1], [2], [3]]
+    assert rescue.shard_index_sets(2, 4) == [[0], [1], [], []]
+    assert rescue.shard_index_sets(0, 2) == [[], []]
+    ledger = rescue.shard_index_sets(103, 5)
+    assert [i for part in ledger for i in part] == list(range(103))
+    sizes = [len(p) for p in ledger]
+    assert max(sizes) - min(sizes) <= 1
+    with pytest.raises(ValueError, match=">= 1 worker"):
+        rescue.shard_index_sets(10, 0)
+
+
+def test_fetch_shard_rejects_torn_posts():
+    board = rescue.MemoryBoard()
+    assert rescue.fetch_shard(board, "r", 1, 3) is None  # no beacon: lost
+    board.post("seqalign/r/beacon/1", "scored")
+    assert rescue.fetch_shard(board, "r", 1, 3) is None  # beacon, no rows
+    board.post("seqalign/r/rows/1", "[[1, 2")  # torn JSON
+    assert rescue.fetch_shard(board, "r", 1, 3) is None
+    board.post("seqalign/r/rows/1", json.dumps([[1, 2, 3]]))  # wrong shape
+    assert rescue.fetch_shard(board, "r", 1, 3) is None
+    rows = [[1, 2, 3], [4, 5, 6], [7, 8, 9]]
+    board.post("seqalign/r/rows/1", json.dumps(rows))
+    np.testing.assert_array_equal(
+        rescue.fetch_shard(board, "r", 1, 3), np.asarray(rows, np.int32)
+    )
+
+
+def _rescue_problem():
+    from mpi_openmp_cuda_tpu.io.parse import load_problem
+
+    return load_problem(fixture_path("stress_small"))
+
+
+def test_rescue_all_workers_alive_matches_oracle():
+    from mpi_openmp_cuda_tpu.ops.dispatch import AlignmentScorer
+    from mpi_openmp_cuda_tpu.parallel import distributed as dist
+
+    problem = _rescue_problem()
+    want = AlignmentScorer(backend="oracle").score_codes(
+        problem.seq1_codes, problem.seq2_codes, problem.weights
+    )
+    board = rescue.MemoryBoard()
+    kw = dict(
+        policy=RetryPolicy(retries=0),
+        beacon_s=0.1,
+        board=board,
+        num_processes=3,
+        backend="oracle",
+    )
+    # Workers post first (their return value is None: they print nothing)
+    for pid in (1, 2):
+        assert (
+            dist.scatter_gather_rescue(
+                problem.seq1_codes, problem.seq2_codes, problem.weights,
+                process_id=pid, **kw
+            )
+            is None
+        )
+    out = dist.scatter_gather_rescue(
+        problem.seq1_codes, problem.seq2_codes, problem.weights,
+        process_id=0, **kw
+    )
+    np.testing.assert_array_equal(out, want)
+
+
+def test_rescue_lost_worker_rescored_on_coordinator():
+    from mpi_openmp_cuda_tpu.ops.dispatch import AlignmentScorer
+    from mpi_openmp_cuda_tpu.parallel import distributed as dist
+
+    problem = _rescue_problem()
+    want = AlignmentScorer(backend="oracle").score_codes(
+        problem.seq1_codes, problem.seq2_codes, problem.weights
+    )
+    board = rescue.MemoryBoard()
+    warnings = []
+    kw = dict(
+        policy=RetryPolicy(retries=0),
+        beacon_s=0.1,
+        board=board,
+        num_processes=3,
+        backend="oracle",
+        log=warnings.append,
+    )
+    # Worker 1 posts; worker 2 died before posting (absence on a
+    # MemoryBoard IS a missed beacon deadline, deterministically).
+    dist.scatter_gather_rescue(
+        problem.seq1_codes, problem.seq2_codes, problem.weights,
+        process_id=1, **kw
+    )
+    out = dist.scatter_gather_rescue(
+        problem.seq1_codes, problem.seq2_codes, problem.weights,
+        process_id=0, **kw
+    )
+    np.testing.assert_array_equal(out, want)  # byte-identical to oracle
+    assert any("worker(s) [2]" in w for w in warnings)  # names the lost one
+    lost_idx = rescue.shard_index_sets(problem.num_seq2, 3)[2]
+    assert any(str(len(lost_idx)) in w and "orphan" in w for w in warnings)
+
+
+# -- kill-resume (subprocess chaos tier: make chaos-kill) ------------------
+
+
+def _kill_env():
+    from test_cli import ENV
+
+    env = {k: v for k, v in ENV.items() if not k.startswith("SEQALIGN_")}
+    env["SEQALIGN_BACKOFF_BASE"] = "0"
+    return env
+
+
+def _make_big_input(path, n=150, seed=7):
+    rng = np.random.default_rng(seed)
+
+    def seq(length):
+        return "".join(chr(ord("A") + int(c)) for c in rng.integers(0, 26, length))
+
+    with open(path, "w") as f:
+        f.write("10 2 3 4\n")
+        f.write(seq(60) + "\n")
+        f.write(f"{n}\n")
+        for _ in range(n):
+            f.write(seq(int(rng.integers(20, 60))) + "\n")
+
+
+def _run_cli_subproc(*args, stdin_path, env):
+    from test_cli import REPO
+
+    with open(stdin_path) as f:
+        return subprocess.run(
+            [sys.executable, "-m", "mpi_openmp_cuda_tpu", "--backend", "xla", *args],
+            stdin=f, capture_output=True, text=True, env=env, cwd=REPO,
+        )
+
+
+@pytest.mark.slow
+@pytest.mark.chaos_kill
+def test_kill_mid_batch_then_resume_byte_identical(tmp_path):
+    # SIGKILL at the SECOND journal append (after=1): the first 64-record
+    # chunk is fsync'd, the in-flight chunk is lost by design, stdout is
+    # empty, and the --resume rerun is byte-identical to a clean run.
+    inp = str(tmp_path / "big.txt")
+    _make_big_input(inp)
+    env = _kill_env()
+    journal = str(tmp_path / "j.jsonl")
+    clean = _run_cli_subproc(stdin_path=inp, env=env)
+    assert clean.returncode == 0, clean.stderr
+
+    killed = _run_cli_subproc(
+        "--journal", journal,
+        "--faults", "kill:journal-append:fail=1,after=1",
+        stdin_path=inp, env=env,
+    )
+    assert killed.returncode == -signal.SIGKILL  # really killed, no unwind
+    assert killed.stdout == ""
+    with open(journal) as f:
+        recs = [json.loads(l) for l in f.read().splitlines() if l]
+    assert sum(1 for r in recs if "index" in r) == 64  # first chunk durable
+
+    resumed = _run_cli_subproc(
+        "--journal", journal, "--resume", stdin_path=inp, env=env
+    )
+    assert resumed.returncode == 0, resumed.stderr
+    assert resumed.stdout == clean.stdout  # byte-identical
+
+
+@pytest.mark.slow
+@pytest.mark.chaos_kill
+def test_kill_mid_stream_then_resume_byte_identical(tmp_path):
+    inp = str(tmp_path / "big.txt")
+    _make_big_input(inp)
+    env = _kill_env()
+    journal = str(tmp_path / "js.jsonl")
+    clean = _run_cli_subproc("--stream", "16", stdin_path=inp, env=env)
+    assert clean.returncode == 0, clean.stderr
+
+    killed = _run_cli_subproc(
+        "--stream", "16", "--journal", journal,
+        "--faults", "kill:journal-append:fail=1,after=2",
+        stdin_path=inp, env=env,
+    )
+    assert killed.returncode == -signal.SIGKILL
+    assert killed.stdout == ""  # fail-stop: nothing printed pre-kill
+    with open(journal) as f:
+        recs = [json.loads(l) for l in f.read().splitlines() if l]
+    assert sum(1 for r in recs if "index" in r) == 32
+
+    resumed = _run_cli_subproc(
+        "--stream", "16", "--journal", journal, "--resume",
+        stdin_path=inp, env=env,
+    )
+    assert resumed.returncode == 0, resumed.stderr
+    assert resumed.stdout == clean.stdout
