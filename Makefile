@@ -18,7 +18,7 @@ PY_CFLAGS  := $(shell $(PYCONFIG) --includes)
 PY_LDFLAGS := $(shell $(PYCONFIG) --ldflags --embed)
 INPUT      ?= /root/reference/input5.txt
 
-.PHONY: build run run2 runOn2 test chaos chaos-kill analyze bench bench-table bench-gather check clean
+.PHONY: build run run2 runOn2 test chaos chaos-kill analyze metrics-smoke bench bench-table bench-gather check clean
 
 build: final
 
@@ -97,6 +97,12 @@ chaos-kill:
 # deployment container does not ship them).  CPU-only, a few seconds.
 analyze:
 	$(PYTHON) scripts/analyze.py
+
+# Observability smoke gate (docs/ARCHITECTURE.md §10): one CLI run on
+# the tiny fixture with --metrics --metrics-out, then schema-validate
+# the JSON run report and its Prometheus sidecar.  CPU-only, seconds.
+metrics-smoke:
+	JAX_PLATFORMS=cpu $(PYTHON) scripts/metrics_smoke.py
 
 # Full coverage in TWO pytest processes: the fast tier, then the
 # slow-marked tests alone.  A single combined process segfaults jaxlib's

@@ -876,7 +876,11 @@ def main() -> None:
     _val = value_table(problem.weights).reshape(-1)
     formulation = effective_backend(backend, _val, _batch.l2p)
     # The JSON record is printed AFTER the MFU accounting below so the MFU
-    # fields can join it; stdout stays exactly one line either way.
+    # fields can join it; stdout stays exactly one line either way.  The
+    # record rides the shared run-report envelope (kind="bench") so bench
+    # blobs and --metrics-out run reports validate against one schema.
+    from mpi_openmp_cuda_tpu.obs.metrics import wrap_report
+
     record = {
         "metric": f"equivalent brute-force char comparisons/s/chip, {workload}",
         "value": round(value, 1),
@@ -994,7 +998,7 @@ def main() -> None:
             f" probe={probe_min:.0f}TFLOP/s real={real_tflops:.0f}TFLOP/s"
             f" mfu_feed={real_tflops / roof:.2f} ({roof_kind} {roof:.0f})"
         )
-    print(json.dumps(record))
+    print(json.dumps(wrap_report("bench", record)))
     print(
         f"[bench] backend={backend} device={jax.devices()[0].device_kind} "
         f"workload={workload} elements={elements} steady_wall={wall:.4f}s "

@@ -28,6 +28,7 @@ from __future__ import annotations
 
 import contextlib
 
+from ..obs.events import publish
 from . import SeqcheckError
 
 _COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
@@ -38,6 +39,9 @@ _state = {"registered": False, "count": 0}
 def _listener(event: str, duration: float, **kwargs) -> None:
     if event == _COMPILE_EVENT:
         _state["count"] += 1
+        # Mirror every backend compile onto the obs bus (armed runs count
+        # it as the `recompiles` counter; otherwise one attribute check).
+        publish("recompile")
 
 
 def _ensure_registered() -> None:

@@ -26,6 +26,10 @@ SEQ005   no wall-clock reads (``time.time``/``monotonic``/
          resilience / journal decision paths — fault injection and
          replay must be time-independent (``time.sleep`` is fine: it
          delays, it does not decide).
+SEQ006   no direct ``print(..., file=sys.stderr)`` in the instrumented
+         modules (resilience/, journal, dispatch, distributed) — route
+         diagnostics through ``obs.events.log_line`` so an armed
+         observability plane sees every line the operator sees (PR 5).
 =======  ==================================================================
 
 Suppression: append ``# seqlint: disable=SEQ00N`` to the offending line
@@ -60,6 +64,16 @@ _DETERMINISTIC_PATHS = ("resilience/", "utils/journal.py")
 
 #: The single legal home for environment reads (SEQ002).
 _ENV_HOME = "utils/platform.py"
+
+#: Modules whose stderr diagnostics must flow through the event bus so
+#: an armed observability plane mirrors them (SEQ006); ``obs/events.py``
+#: itself holds the one blessed ``print`` (the log_line seam).
+_INSTRUMENTED_PATHS = (
+    "resilience/",
+    "utils/journal.py",
+    "ops/dispatch.py",
+    "parallel/distributed.py",
+)
 
 _WALLCLOCK_ATTRS = {
     ("time", "time"),
@@ -144,6 +158,9 @@ class _Linter(ast.NodeVisitor):
         self.is_env_home = rel.endswith(_ENV_HOME)
         self.in_deterministic = any(
             p in rel for p in _DETERMINISTIC_PATHS
+        )
+        self.in_instrumented = any(
+            p in rel for p in _INSTRUMENTED_PATHS
         )
 
     # -- bookkeeping -------------------------------------------------------
@@ -293,6 +310,28 @@ class _Linter(ast.NodeVisitor):
                     "journal path; decisions must replay identically — "
                     "derive from the seeded policy state instead",
                 )
+
+        # SEQ006: direct stderr prints in instrumented modules.
+        if (
+            self.in_instrumented
+            and isinstance(func, ast.Name)
+            and func.id == "print"
+        ):
+            for kw in node.keywords:
+                v = kw.value
+                if (
+                    kw.arg == "file"
+                    and isinstance(v, ast.Attribute)
+                    and v.attr == "stderr"
+                ):
+                    self._emit(
+                        "SEQ006",
+                        node,
+                        "direct stderr print in an instrumented module "
+                        "bypasses the observability plane; emit through "
+                        "obs.events.log_line (same bytes on stderr, plus "
+                        "a `log` event when the bus is armed)",
+                    )
         self.generic_visit(node)
 
     # -- SEQ002: os.environ subscripts / membership ------------------------

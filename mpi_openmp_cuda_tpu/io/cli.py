@@ -12,6 +12,9 @@ from __future__ import annotations
 import argparse
 import sys
 
+from ..obs import arm_observability, disarm_observability
+from ..obs import export as obs_export
+from ..obs.metrics import gauge as obs_gauge
 from ..ops.dispatch import AlignmentScorer
 from ..resilience.degrade import (
     BackendDegrader,
@@ -209,6 +212,35 @@ def build_arg_parser() -> argparse.ArgumentParser:
         "path loud instead of rescoring the whole batch",
     )
     p.add_argument(
+        "--metrics",
+        action="store_true",
+        help="arm the observability plane: resilience counters, config "
+        "gauges and per-phase spans collected for the run "
+        "(SEQALIGN_METRICS; implied by --metrics-out and --heartbeat); "
+        "off by default, and when off every instrumentation site is a "
+        "single attribute check — no allocation on the hot path",
+    )
+    p.add_argument(
+        "--metrics-out",
+        default=None,
+        metavar="PATH",
+        help="write the versioned JSON run report to PATH (plus a "
+        "PATH.prom Prometheus text sidecar) when the run exits — "
+        "including failed (65) and preempted (75) exits, so the last "
+        "report of a crashed run still tells the story "
+        "(SEQALIGN_METRICS_OUT; implies --metrics)",
+    )
+    p.add_argument(
+        "--heartbeat",
+        type=_positive_float,
+        default=None,
+        metavar="S",
+        help="emit a one-line '[obs] chunk I/N retries=R degraded=D' "
+        "status to stderr from the watchdog monitor thread after every "
+        "S quiet seconds (SEQALIGN_HEARTBEAT_S; implies --metrics and "
+        "composes with --deadline on the same monitor thread)",
+    )
+    p.add_argument(
         "--check",
         action="store_true",
         help="validate every concrete dispatch decision against the "
@@ -274,6 +306,29 @@ def _build_policy(args) -> tuple[RetryPolicy, str | None]:
         if fault_spec:
             retries = max(retries, env_int("SEQALIGN_FAULT_RETRIES", 0))
     return RetryPolicy(retries=retries), fault_spec
+
+
+def _build_obs(args) -> tuple[bool, str | None, float | None]:
+    """Resolve the observability plane's configuration.
+
+    Mirrors :func:`_build_policy`: each flag falls back to its declared
+    env var.  Any of ``--metrics`` / ``--metrics-out`` / ``--heartbeat``
+    arms the plane — asking for the report (or the heartbeat that reads
+    it) IS asking for the counters.
+    """
+    metrics_out = args.metrics_out or env_str("SEQALIGN_METRICS_OUT")
+    heartbeat_s = (
+        args.heartbeat
+        if args.heartbeat is not None
+        else env_float("SEQALIGN_HEARTBEAT_S")
+    )
+    enabled = bool(
+        args.metrics
+        or env_flag("SEQALIGN_METRICS")
+        or metrics_out
+        or heartbeat_s
+    )
+    return enabled, metrics_out or None, heartbeat_s
 
 
 def _make_degrader(args, scorer) -> BackendDegrader:
@@ -447,6 +502,7 @@ def _run_streaming(
         # All scoring below goes through deg.scorer: a mid-stream
         # degradation replaces the scorer for every later chunk too.
         deg = _make_degrader(args, _make_scorer(args, dist is not None))
+    obs_gauge("backend", deg.scorer.backend)
 
     all_results = [] if args.json else None
     lines = io.StringIO()
@@ -474,6 +530,8 @@ def _run_streaming(
             dist.broadcast_stream_meta(
                 (header.weights, header.seq1_codes, header.num_seq2)
             )
+        # Denominator for the heartbeat's "chunk I/N" and the run report.
+        obs_gauge("chunks_total", -(-header.num_seq2 // args.stream))
         journal, seq_hash, mismatch_error, done = None, None, None, {}
         if args.journal:
             try:
@@ -736,7 +794,6 @@ def run(argv: list[str] | None = None) -> int:
     apply_platform_override()
     enable_compilation_cache()
     args = build_arg_parser().parse_args(argv)
-    timer = PhaseTimer(enabled=args.profile)
     # Static argument-compatibility checks: fail before any expensive phase
     # (a multi-host job should not complete init + broadcast just to learn
     # its flags conflict).
@@ -785,19 +842,39 @@ def run(argv: list[str] | None = None) -> int:
                 raise
 
     _drain = None
+    registry = recorder = None
+    metrics_out = None
+    rc: int | None = None
     try:
         # Arm the run's retry policy and (optional) fault registry first:
         # a malformed --faults/env spec or retry floor fails fast through
         # the normal error path below, before any expensive phase.
         policy, fault_spec = _build_policy(args)
+        # The observability plane arms before anything that can publish
+        # into it (faults, watchdog, scoring); the finally below flushes
+        # the run report on EVERY exit path, 65 and 75 included.
+        obs_on, metrics_out, heartbeat_s = _build_obs(args)
+        if obs_on:
+            registry, recorder = arm_observability()
+        # The --profile timer shares the armed span recorder, so profile
+        # phases and the run report's span section are one measurement.
+        timer = PhaseTimer(enabled=args.profile, recorder=recorder)
         activate_faults(fault_spec)
         deadline = (
             args.deadline
             if args.deadline is not None
             else env_float("SEQALIGN_DEADLINE_S")
-        )
-        if deadline:
-            activate_watchdog(deadline)
+        ) or None
+        if deadline or heartbeat_s:
+            # Heartbeat-only (deadline None) is legal: the monitor thread
+            # then enforces nothing and only emits the status line.
+            activate_watchdog(
+                deadline,
+                heartbeat_s=heartbeat_s,
+                heartbeat=(
+                    obs_export.heartbeat_callback() if heartbeat_s else None
+                ),
+            )
         # Preemption drain: SIGTERM/SIGINT (or a pre-armed SEQALIGN_DRAIN)
         # finishes in-flight chunks, flushes the journal, and exits 75.
         # Armed for the whole run, disarmed (handlers restored) in the
@@ -825,7 +902,7 @@ def run(argv: list[str] | None = None) -> int:
                 dist.initialize_distributed()
                 coordinator = dist.is_coordinator()
         if args.stream:
-            code = _run_streaming(
+            rc = _run_streaming(
                 args,
                 timer,
                 policy,
@@ -834,7 +911,7 @@ def run(argv: list[str] | None = None) -> int:
                 out_stream=out_stream,
             )
             _close_guard(suppress=False)
-            return code
+            return rc
         with timer.phase("parse"):
             # Only the coordinator touches stdin (reference ROOT semantics);
             # workers receive the parsed problem via broadcast.
@@ -854,6 +931,7 @@ def run(argv: list[str] | None = None) -> int:
             # Scoring goes through deg.scorer so a --degrade fallback
             # replaces the backend for the retry that follows it.
             deg = _make_degrader(args, _make_scorer(args, args.distributed))
+        obs_gauge("backend", deg.scorer.backend)
         journal, done = None, None
         if args.journal:
 
@@ -964,19 +1042,40 @@ def run(argv: list[str] | None = None) -> int:
         # buffered results can itself raise (e.g. BrokenPipeError under
         # `... | head`), and must hit the handlers below.
         _close_guard(suppress=False)
-        return EX_OK
+        rc = EX_OK
+        return rc
     except DrainInterrupt as e:
         # A requested preemption, not a failure: nothing was printed
         # (fail-stop stdout), everything scored so far is fsync'd in the
         # journal, and 75 tells the supervisor a rerun will finish the job.
         print(f"mpi_openmp_cuda_tpu: drained: {e}", file=sys.stderr)
-        return EX_TEMPFAIL
+        rc = EX_TEMPFAIL
+        return rc
     except BrokenPipeError:
-        return 1
+        rc = 1
+        return rc
     except Exception as e:  # fail-stop: diagnose on stderr, nonzero exit (C11)
         print(f"mpi_openmp_cuda_tpu: error: {e}", file=sys.stderr)
-        return EX_TEMPFAIL if _is_resumable(e) else EX_FATAL
+        rc = EX_TEMPFAIL if _is_resumable(e) else EX_FATAL
+        return rc
     finally:
+        # Report flush comes FIRST, while the run's exit code is known and
+        # before the plane disarms: a failed (65) or preempted (75) run
+        # still leaves its report behind — often the only evidence of what
+        # the retries and degradations did.  A flush failure warns on
+        # stderr; it must never mask the run's own verdict.
+        if registry is not None:
+            try:
+                obs_export.flush_run_report(
+                    registry, recorder, metrics_out, exit_code=rc
+                )
+            except Exception as flush_err:  # pragma: no cover - FS-dependent
+                print(
+                    "mpi_openmp_cuda_tpu: warning: run report not written "
+                    f"({flush_err})",
+                    file=sys.stderr,
+                )
+            disarm_observability()
         # Error paths: restore fd 1 without letting a secondary flush
         # failure mask the original exception.  Faults/watchdog/drain are
         # armed per run: disarm (and join the watchdog thread, restore the

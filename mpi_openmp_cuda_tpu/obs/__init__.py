@@ -1,0 +1,43 @@
+"""Observability plane: metrics, spans, events, exports (ISSUE 5).
+
+The package is the single sink for everything the system previously
+muttered to stderr: the resilience stack publishes events onto
+:mod:`.events`, :mod:`.metrics` folds them into counters, :mod:`.spans`
+times the run's phases and per-chunk work, and :mod:`.export` writes
+the versioned run report / Prometheus sidecar and the heartbeat line.
+
+Everything is **disabled by default**: until :func:`arm_observability`
+runs (the CLI arms per run under ``--metrics``/``--metrics-out``/
+``--heartbeat``), every instrumentation hook in the package is a single
+attribute check and allocates nothing.
+"""
+
+from __future__ import annotations
+
+from . import events, export, metrics, spans  # noqa: F401  (re-exports)
+
+
+def arm_observability(clock=None, span_clock=None):
+    """Arm the full plane for one run: a fresh registry subscribed to a
+    fresh bus, plus a fresh span recorder.  Returns ``(registry,
+    recorder)``.  Also registers the backend-compile listener so
+    recompiles land on the bus (best-effort: a jax-less install still
+    gets counters and spans)."""
+    registry = metrics.activate_metrics(clock)
+    bus = events.activate_bus()
+    bus.subscribe(registry.record_event)
+    recorder = spans.activate_spans(span_clock)
+    try:
+        from ..analysis.recompile import compile_count
+
+        compile_count()  # registering the listener is its side effect
+    except Exception:
+        pass
+    return registry, recorder
+
+
+def disarm_observability() -> None:
+    """Tear the plane down (the CLI's finally; idempotent)."""
+    spans.deactivate_spans()
+    events.deactivate_bus()
+    metrics.deactivate_metrics()

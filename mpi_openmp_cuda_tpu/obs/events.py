@@ -1,0 +1,96 @@
+"""The run-scoped event bus: one sink for everything the resilience
+stack used to mutter to stderr.
+
+The bus follows the fault registry's arming pattern
+(:mod:`..resilience.faults`): module-global, armed per run by the CLI,
+disarmed in its ``finally``, and a **single attribute check** when off —
+so library callers and the hot path pay nothing unless observability
+was asked for.
+
+Publishers (all rare/failure paths, never per-element work):
+
+=========================  ==============================================
+``retry.attempt``          every caught transient failure
+                           (:meth:`~..resilience.policy.RetryPolicy.run`)
+``retry.backoff``          each nonzero backoff sleep (``delay`` field)
+``degrade.transition``     each fall down the backend chain
+                           (:meth:`~..resilience.degrade.BackendDegrader.step`)
+``watchdog.expiry``        a guarded operation outlived the deadline
+``watchdog.guard``         guard arm/disarm (``state`` field)
+``drain.request``          the first drain signal of a run
+``rescue.beacon_miss``     a worker missed the beacon deadline
+``rescue.orphans``         orphaned sequences being rescored (``count``)
+``fault.injected``         each deterministically injected fault
+``recompile``              a backend compile (``analysis/recompile.py``)
+``log``                    every :func:`log_line` diagnostic (``line``)
+=========================  ==============================================
+
+Subscribers are synchronous and must not raise; the
+:class:`~.metrics.MetricsRegistry` subscribes its
+:meth:`~.metrics.MetricsRegistry.record_event` to turn the stream into
+counters.  Events are *in addition to* the existing stderr diagnostics,
+never instead of them — the chaos suite's goldens assert on those lines.
+
+:func:`log_line` is the blessed default logger for instrumented modules
+(seqlint SEQ006 forbids direct ``print(..., file=sys.stderr)`` there):
+byte-identical stderr output, but the line also rides the bus so run
+reports can count diagnostics.
+"""
+
+from __future__ import annotations
+
+import sys
+
+
+class EventBus:
+    """A synchronous fan-out of ``(event, fields)`` to subscribers."""
+
+    __slots__ = ("_subscribers",)
+
+    def __init__(self):
+        self._subscribers: list = []
+
+    def subscribe(self, fn) -> None:
+        """Register ``fn(event: str, fields: dict)``; called in
+        subscription order on every publish."""
+        self._subscribers.append(fn)
+
+    def publish(self, event: str, fields: dict) -> None:
+        for fn in self._subscribers:
+            fn(event, fields)
+
+
+# The armed bus.  Module-global like the fault registry: the CLI owns
+# the run; unit tests arm/disarm their own.
+_active: EventBus | None = None
+
+
+def activate_bus() -> EventBus:
+    """Arm a fresh bus for one run; returns it for subscriptions."""
+    global _active
+    _active = EventBus()
+    return _active
+
+
+def deactivate_bus() -> None:
+    global _active
+    _active = None
+
+
+def active_bus() -> EventBus | None:
+    return _active
+
+
+def publish(event: str, **fields) -> None:
+    """Instrumentation hook: fan out to the armed bus, else no-op."""
+    if _active is not None:
+        _active.publish(event, fields)
+
+
+def log_line(msg: str) -> None:
+    """Print ``msg`` to stderr exactly as the old inline defaults did,
+    mirroring it onto the armed bus as a ``log`` event first.  The
+    default ``log=`` seam for every instrumented module (SEQ006)."""
+    if _active is not None:
+        _active.publish("log", {"line": msg})
+    print(msg, file=sys.stderr)

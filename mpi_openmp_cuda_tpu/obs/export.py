@@ -1,0 +1,134 @@
+"""Report writing, the heartbeat line, and the multi-host metrics plane.
+
+Three consumers of the armed registry:
+
+* :func:`flush_run_report` — the CLI's exit hook: writes the JSON run
+  report at ``--metrics-out`` plus a Prometheus text sidecar at
+  ``<out>.prom``.  Called from the run's ``finally``, so a failed run
+  (exit 65) and a drained run (exit 75) still flush their reports.
+* :func:`heartbeat_callback` — the periodic ``[obs] ...`` stderr line
+  the watchdog monitor thread emits between operations
+  (``--heartbeat`` / ``SEQALIGN_HEARTBEAT_S``).
+* :func:`post_host_snapshot` / :func:`gather_fleet` — under
+  ``--distributed``, per-host snapshots ride the same board machinery
+  as the lost-shard rescue (:mod:`..resilience.rescue`): each worker
+  posts its snapshot next to its rows, the coordinator folds them into
+  the ``hosts`` section of one merged fleet report.  A worker that died
+  simply has no snapshot key — absence over negotiation, exactly the
+  beacon contract.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+from . import metrics as _metrics
+from .events import log_line
+
+
+def flush_run_report(
+    registry,
+    spans,
+    path: str | None,
+    *,
+    exit_code: int | None = None,
+    meta: dict | None = None,
+) -> dict | None:
+    """Write the run report (and ``.prom`` sidecar) for one finished
+    run; no-op without a path or registry.  Returns the report dict.
+
+    Writes are tmp-file + rename so a preemption mid-flush leaves the
+    previous report intact, never a torn JSON document (the journal's
+    torn-tail lesson applied to reports)."""
+    if registry is None or path is None:
+        return None
+    rec = _metrics.run_report(
+        registry, spans=spans, exit_code=exit_code, meta=meta
+    )
+    _atomic_write(path, json.dumps(rec, indent=2, sort_keys=True) + "\n")
+    _atomic_write(path + ".prom", _metrics.to_prometheus(registry.snapshot()))
+    return rec
+
+
+def _atomic_write(path: str, text: str) -> None:
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        f.write(text)
+    os.replace(tmp, path)
+
+
+# -- heartbeat -------------------------------------------------------------
+
+
+def heartbeat_line(snapshot: dict) -> str:
+    """One ``[obs]`` status line from a registry snapshot (the format in
+    the README's observability walkthrough)."""
+    c = snapshot.get("counters", {})
+    g = snapshot.get("gauges", {})
+    total = g.get("chunks_total", "?")
+    degraded = "yes" if c.get("degrade_transitions") else "no"
+    return (
+        f"[obs] chunk {c.get('chunks_dispatched', 0)}/{total} "
+        f"retries={c.get('retry_attempts', 0)} degraded={degraded}"
+    )
+
+
+def heartbeat_callback(log=None):
+    """The zero-argument emitter the watchdog's monitor thread calls on
+    each quiet heartbeat interval."""
+    emit = log or log_line
+
+    def beat() -> None:
+        reg = _metrics.active_metrics()
+        if reg is not None:
+            emit(heartbeat_line(reg.snapshot()))
+
+    return beat
+
+
+# -- the multi-host metrics plane ------------------------------------------
+
+
+def _metrics_key(run_tag: str, pid: int) -> str:
+    return f"seqalign/{run_tag}/metrics/{int(pid)}"
+
+
+def post_host_snapshot(board, run_tag: str, pid: int) -> None:
+    """Worker side: post this host's snapshot to the board (no-op with
+    metrics off — a run where only some hosts enabled metrics still
+    completes; the coordinator just reports the posters)."""
+    reg = _metrics.active_metrics()
+    if reg is None:
+        return
+    board.post(_metrics_key(run_tag, pid), json.dumps(reg.snapshot()))
+
+
+def gather_fleet(
+    board,
+    run_tag: str,
+    num_processes: int,
+    *,
+    skip=(),
+    timeout_s: float | None = None,
+) -> None:
+    """Coordinator side: fold every posted host snapshot into the armed
+    registry's fleet section.  ``skip`` lists workers already known lost
+    (no point waiting out their timeout twice); a missing or torn
+    snapshot is simply omitted, mirroring :func:`..resilience.rescue.
+    fetch_shard`'s absence-over-negotiation contract."""
+    reg = _metrics.active_metrics()
+    if reg is None:
+        return
+    for w in range(int(num_processes)):
+        if w in skip:
+            continue
+        raw = board.get(_metrics_key(run_tag, w), timeout_s)
+        if raw is None:
+            continue
+        try:
+            snap = json.loads(raw)
+        except json.JSONDecodeError:
+            continue
+        if isinstance(snap, dict):
+            reg.record_fleet(w, snap)

@@ -1,0 +1,294 @@
+"""Process-wide metrics: counters, gauges, histograms, run reports.
+
+:class:`MetricsRegistry` is the run's single numeric sink.  It takes an
+**injectable monotonic clock** so tests use a fake clock and stay
+byte-deterministic — and so every wall-clock read of the observability
+plane lives in this file and :mod:`.spans`, never in the deterministic
+``resilience/`` / ``utils/journal.py`` paths (seqlint SEQ005 is scoped
+per file; those modules only ever hand us *events*, not times).
+
+Two export formats share one serializer:
+
+* the **versioned JSON run report** (``--metrics-out``), shape
+  ``{"schema": ..., "schema_version": N, "kind": ..., ...}`` — the same
+  envelope ``bench.py`` wraps its result blob in, so ``BENCH_*.json``
+  and run reports validate against the one :func:`validate_report`;
+* a **Prometheus text-format** sidecar (``<out>.prom``), counters as
+  ``seqalign_<name>_total``, histograms as summaries.
+
+Like the fault registry, the module-global hooks (:func:`inc` /
+:func:`gauge` / :func:`observe`) are a single attribute check when no
+registry is armed — the hot path pays nothing with metrics off.
+"""
+
+from __future__ import annotations
+
+import time
+
+#: The one report envelope (run reports AND bench blobs).
+RUN_REPORT_SCHEMA = "mpi_openmp_cuda_tpu.run-report"
+RUN_REPORT_VERSION = 1
+
+# The event -> counter mapping (the bus side of the catalogue documented
+# in docs/ARCHITECTURE.md §10).  Events not listed here carry their own
+# handling in record_event.
+_EVENT_COUNTERS = {
+    "retry.attempt": "retry_attempts",
+    "degrade.transition": "degrade_transitions",
+    "watchdog.expiry": "deadline_expiries",
+    "drain.request": "drain_requests",
+    "fault.injected": "faults_injected",
+    "recompile": "recompiles",
+    "log": "log_lines",
+}
+
+
+class MetricsRegistry:
+    """One run's counters/gauges/histograms behind an injectable clock.
+
+    ``clock`` must be monotonic (``time.monotonic`` by default); tests
+    pass a fake.  All mutation is plain dict arithmetic under the GIL —
+    the only off-thread writer is the watchdog monitor's expiry event,
+    for which per-key increments are atomic enough.
+    """
+
+    def __init__(self, clock=time.monotonic):
+        self._clock = clock
+        self._start = clock()
+        self.counters: dict[str, int | float] = {}
+        self.gauges: dict[str, int | float | str] = {}
+        self.histograms: dict[str, dict[str, float]] = {}
+        # Per-host snapshots gathered by the coordinator under
+        # --distributed (obs/export.py): process id -> snapshot dict.
+        self.fleet: dict[str, dict] = {}
+
+    def inc(self, name: str, n: int | float = 1) -> None:
+        self.counters[name] = self.counters.get(name, 0) + n
+
+    def gauge(self, name: str, value) -> None:
+        self.gauges[name] = value
+
+    def observe(self, name: str, value: float) -> None:
+        h = self.histograms.get(name)
+        if h is None:
+            self.histograms[name] = {
+                "count": 1, "sum": value, "min": value, "max": value,
+            }
+            return
+        h["count"] += 1
+        h["sum"] += value
+        h["min"] = min(h["min"], value)
+        h["max"] = max(h["max"], value)
+
+    def uptime_s(self) -> float:
+        return self._clock() - self._start
+
+    # -- the bus subscriber ------------------------------------------------
+    def record_event(self, event: str, fields: dict) -> None:
+        """Turn one bus event into counters (subscribed by the CLI)."""
+        name = _EVENT_COUNTERS.get(event)
+        if name is not None:
+            self.inc(name)
+            return
+        if event == "retry.backoff":
+            self.inc("backoff_waits")
+            self.observe("backoff_delay_s", float(fields["delay"]))
+        elif event == "watchdog.guard":
+            self.inc(
+                "guard_arms"
+                if fields.get("state") == "armed"
+                else "guard_disarms"
+            )
+        elif event == "rescue.beacon_miss":
+            self.inc("beacon_misses")
+        elif event == "rescue.orphans":
+            self.inc("rescued_sequences", int(fields.get("count", 0)))
+        else:
+            # Forward-compatible: an unmapped event still leaves a trace.
+            self.inc(f"events.{event}")
+
+    # -- snapshots ---------------------------------------------------------
+    def record_fleet(self, host, snapshot: dict) -> None:
+        self.fleet[str(host)] = snapshot
+
+    def snapshot(self) -> dict:
+        """A JSON-ready copy of the registry (no fleet: snapshots are
+        what the fleet section is MADE of)."""
+        return {
+            "uptime_s": round(self.uptime_s(), 6),
+            "counters": dict(self.counters),
+            "gauges": dict(self.gauges),
+            "histograms": {k: dict(v) for k, v in self.histograms.items()},
+        }
+
+
+# The armed registry (same lifecycle as the fault registry).
+_active: MetricsRegistry | None = None
+
+
+def activate_metrics(clock=None) -> MetricsRegistry:
+    """Arm a fresh registry for one run; returns it for inspection."""
+    global _active
+    _active = MetricsRegistry(clock if clock is not None else time.monotonic)
+    return _active
+
+
+def deactivate_metrics() -> None:
+    global _active
+    _active = None
+
+
+def active_metrics() -> MetricsRegistry | None:
+    return _active
+
+
+def inc(name: str, n: int | float = 1) -> None:
+    """Instrumentation hook: count on the armed registry, else no-op."""
+    if _active is not None:
+        _active.inc(name, n)
+
+
+def gauge(name: str, value) -> None:
+    if _active is not None:
+        _active.gauge(name, value)
+
+
+def observe(name: str, value: float) -> None:
+    if _active is not None:
+        _active.observe(name, value)
+
+
+def drain_snapshot() -> dict | None:
+    """The extra payload the journal's ``{"event": "drain"}`` record
+    carries when metrics are armed (None otherwise) — the journal itself
+    never reads a clock (SEQ005); the uptime inside comes from here."""
+    if _active is None:
+        return None
+    return {"metrics": _active.snapshot()}
+
+
+# -- the shared report serializer ------------------------------------------
+
+
+def wrap_report(kind: str, body: dict, *, meta: dict | None = None) -> dict:
+    """The one report envelope: ``bench.py`` wraps its blob with
+    ``kind="bench"``, the CLI's run report uses ``kind="run"`` — both
+    validate against :func:`validate_report`."""
+    rec: dict = {
+        "schema": RUN_REPORT_SCHEMA,
+        "schema_version": RUN_REPORT_VERSION,
+        "kind": kind,
+    }
+    if meta:
+        rec["meta"] = dict(meta)
+    rec.update(body)
+    return rec
+
+
+def run_report(
+    registry: MetricsRegistry,
+    *,
+    spans=None,
+    exit_code: int | None = None,
+    meta: dict | None = None,
+) -> dict:
+    """The ``--metrics-out`` JSON document for one finished run."""
+    body = registry.snapshot()
+    if spans is not None:
+        body["spans"] = {
+            "phases": [[name, round(dur, 6)] for name, dur in spans.phases()],
+            "totals": {
+                path: round(total, 6)
+                for path, total in sorted(spans.totals().items())
+            },
+        }
+    if exit_code is not None:
+        body["exit_code"] = int(exit_code)
+    if registry.fleet:
+        body["hosts"] = dict(registry.fleet)
+    return wrap_report("run", body, meta=meta)
+
+
+def validate_report(rec) -> None:
+    """Schema gate for any wrapped report (run or bench); raises one
+    ValueError naming every problem (``make metrics-smoke`` and the
+    chaos tests call this)."""
+    problems: list[str] = []
+    if not isinstance(rec, dict):
+        raise ValueError(f"report must be a JSON object, got {type(rec).__name__}")
+    if rec.get("schema") != RUN_REPORT_SCHEMA:
+        problems.append(f"schema: want {RUN_REPORT_SCHEMA!r}, got {rec.get('schema')!r}")
+    ver = rec.get("schema_version")
+    if not isinstance(ver, int) or ver < 1:
+        problems.append(f"schema_version: want int >= 1, got {ver!r}")
+    kind = rec.get("kind")
+    if not isinstance(kind, str) or not kind:
+        problems.append(f"kind: want a nonempty string, got {kind!r}")
+    if kind == "run":
+        for section in ("counters", "gauges", "histograms"):
+            if not isinstance(rec.get(section), dict):
+                problems.append(f"{section}: want an object, got {rec.get(section)!r}")
+        for name, v in (rec.get("counters") or {}).items():
+            if not isinstance(v, (int, float)) or isinstance(v, bool):
+                problems.append(f"counters[{name!r}]: want a number, got {v!r}")
+        for name, h in (rec.get("histograms") or {}).items():
+            if not isinstance(h, dict) or set(h) != {"count", "sum", "min", "max"}:
+                problems.append(
+                    f"histograms[{name!r}]: want count/sum/min/max, got {h!r}"
+                )
+        if not isinstance(rec.get("uptime_s"), (int, float)):
+            problems.append(f"uptime_s: want a number, got {rec.get('uptime_s')!r}")
+        if "exit_code" in rec and not isinstance(rec["exit_code"], int):
+            problems.append(f"exit_code: want an int, got {rec['exit_code']!r}")
+        spans = rec.get("spans")
+        if spans is not None:
+            if not isinstance(spans, dict) or not isinstance(
+                spans.get("phases"), list
+            ) or not isinstance(spans.get("totals"), dict):
+                problems.append(f"spans: want {{phases: [], totals: {{}}}}, got {spans!r}")
+    elif kind == "bench":
+        if "metric" not in rec or "value" not in rec:
+            problems.append("bench report: want metric and value fields")
+    if problems:
+        raise ValueError(
+            "invalid run report: " + "; ".join(problems)
+        )
+
+
+def _fmt_num(v) -> str:
+    return repr(v) if isinstance(v, float) else str(v)
+
+
+def to_prometheus(snapshot: dict, *, prefix: str = "seqalign") -> str:
+    """Prometheus text exposition of one registry snapshot: counters as
+    ``_total``, numeric gauges verbatim, string gauges as ``_info``
+    labels, histograms as summaries with min/max gauges."""
+    lines: list[str] = []
+    for name in sorted(snapshot.get("counters", ())):
+        m = f"{prefix}_{name.replace('.', '_')}_total"
+        lines.append(f"# TYPE {m} counter")
+        lines.append(f"{m} {_fmt_num(snapshot['counters'][name])}")
+    for name in sorted(snapshot.get("gauges", ())):
+        v = snapshot["gauges"][name]
+        m = f"{prefix}_{name.replace('.', '_')}"
+        if isinstance(v, (int, float)) and not isinstance(v, bool):
+            lines.append(f"# TYPE {m} gauge")
+            lines.append(f"{m} {_fmt_num(v)}")
+        else:
+            lines.append(f"# TYPE {m}_info gauge")
+            lines.append(f'{m}_info{{value="{v}"}} 1')
+    for name in sorted(snapshot.get("histograms", ())):
+        h = snapshot["histograms"][name]
+        m = f"{prefix}_{name.replace('.', '_')}"
+        lines.append(f"# TYPE {m} summary")
+        lines.append(f"{m}_count {_fmt_num(h['count'])}")
+        lines.append(f"{m}_sum {_fmt_num(h['sum'])}")
+        lines.append(f"# TYPE {m}_min gauge")
+        lines.append(f"{m}_min {_fmt_num(h['min'])}")
+        lines.append(f"# TYPE {m}_max gauge")
+        lines.append(f"{m}_max {_fmt_num(h['max'])}")
+    up = snapshot.get("uptime_s")
+    if up is not None:
+        lines.append(f"# TYPE {prefix}_uptime_seconds gauge")
+        lines.append(f"{prefix}_uptime_seconds {_fmt_num(up)}")
+    return "\n".join(lines) + "\n"

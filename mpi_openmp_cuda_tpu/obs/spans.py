@@ -1,0 +1,124 @@
+"""Nested wall-clock spans: the phase timer generalised.
+
+:class:`SpanRecorder` subsumes the old ``utils/profiling.PhaseTimer``
+(now a thin shim over this class): top-level spans ARE the profile
+phases (parse / setup / score / print, byte-compatible ``[profile]``
+report), and spans opened while another is live record under a dotted
+path (``score.chunk_gather``) — the per-chunk dispatch/gather spans
+``ops/dispatch.py`` emits nest under whatever phase the CLI has open.
+
+Honest device time: JAX dispatch is asynchronous, so a span around a
+dispatch call measures enqueue, not compute.  :func:`fence` calls
+``jax.block_until_ready`` on a value *when a recorder is armed* (no-op
+otherwise — the hot path must not lose pipelining to an observability
+default), so a gather span brackets the actual device wait.
+
+The clock is injectable (``time.perf_counter`` by default) and every
+read lives in this file — the deterministic ``resilience/`` and
+``utils/journal.py`` paths stay clock-free (seqlint SEQ005).
+
+Module hooks follow the fault-registry pattern: :func:`span` returns a
+shared ``nullcontext`` when no recorder is armed (zero allocation on
+the per-chunk path), and the CLI arms/disarms per run.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import sys
+import time
+
+
+class SpanRecorder:
+    """Records ``(dotted.path, seconds)`` spans in completion order.
+
+    Single-threaded by construction (the driver thread owns dispatch,
+    gather, and all CLI phases — the same argument as the fault
+    registry), so one stack suffices.
+    """
+
+    def __init__(self, clock=time.perf_counter):
+        self._clock = clock
+        self.spans: list[tuple[str, float]] = []
+        self._stack: list[str] = []
+
+    @contextlib.contextmanager
+    def span(self, name: str):
+        self._stack.append(name)
+        path = ".".join(self._stack)
+        start = self._clock()
+        try:
+            yield
+        finally:
+            dur = self._clock() - start
+            self._stack.pop()
+            self.spans.append((path, dur))
+
+    def phases(self) -> list[tuple[str, float]]:
+        """Top-level spans in completion order — exactly the old
+        ``PhaseTimer.phases`` contract."""
+        return [(p, d) for p, d in self.spans if "." not in p]
+
+    def totals(self) -> dict[str, float]:
+        """Total seconds per dotted path (repeated spans accumulate —
+        per-chunk gather spans sum into one ``score.chunk_gather``)."""
+        out: dict[str, float] = {}
+        for p, d in self.spans:
+            out[p] = out.get(p, 0.0) + d
+        return out
+
+    def report(self, out=None) -> None:
+        """The byte-compatible ``--profile`` report (top-level phases +
+        total), same format the old PhaseTimer printed."""
+        out = out or sys.stderr
+        phases = self.phases()
+        total = sum(d for _, d in phases)
+        for name, dur in phases:
+            print(f"[profile] {name:>16}: {dur * 1e3:10.2f} ms", file=out)
+        print(f"[profile] {'total':>16}: {total * 1e3:10.2f} ms", file=out)
+
+
+# The armed recorder; one shared nullcontext so a disarmed span() costs
+# no allocation (nullcontext enter/exit is stateless and reentrant).
+_active: SpanRecorder | None = None
+_NULL = contextlib.nullcontext()
+
+
+def activate_spans(clock=None) -> SpanRecorder:
+    """Arm a fresh recorder for one run; returns it (the CLI hands the
+    same recorder to the PhaseTimer shim so phases and spans agree)."""
+    global _active
+    _active = SpanRecorder(clock if clock is not None else time.perf_counter)
+    return _active
+
+
+def deactivate_spans() -> None:
+    global _active
+    _active = None
+
+
+def active_spans() -> SpanRecorder | None:
+    return _active
+
+
+def span(name: str):
+    """Instrumentation hook: a span on the armed recorder, else the
+    shared no-op context."""
+    rec = _active
+    if rec is None:
+        return _NULL
+    return rec.span(name)
+
+
+def fence(tree) -> None:
+    """``jax.block_until_ready(tree)`` when a recorder is armed, so the
+    enclosing span sees the device wait; no-op (one attribute check)
+    otherwise — and a no-op on jax-less installs, where values are
+    already host-side."""
+    if _active is None:
+        return
+    try:
+        import jax
+    except Exception:
+        return
+    jax.block_until_ready(tree)

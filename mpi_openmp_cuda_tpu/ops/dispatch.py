@@ -18,6 +18,9 @@ from dataclasses import dataclass
 import numpy as np
 
 from ..models.encoding import encode_normalized, pad_to
+from ..obs.events import log_line
+from ..obs.metrics import gauge as _obs_gauge, inc as _obs_inc
+from ..obs.spans import fence as _obs_fence, span as _obs_span
 from ..resilience.faults import fire as _fault
 from ..resilience.watchdog import guard as _deadline_guard
 from ..utils.constants import ALPHABET_SIZE, BUF_SIZE_SEQ1, BUF_SIZE_SEQ2
@@ -169,13 +172,10 @@ def resolve_auto_backend() -> str:
             # Never silent: a broken pallas build on TPU downgrades the
             # default path 26x — the operator must see why this host
             # chose 'xla'.
-            import sys
-
-            print(
+            log_line(
                 "mpi_openmp_cuda_tpu: warning: backend 'auto' fell back to "
                 f"'xla' on a TPU host (pallas import failed: {e}); pass an "
-                "explicit --backend to silence or to fail fast",
-                file=sys.stderr,
+                "explicit --backend to silence or to fail fast"
             )
             return "xla"
     return "xla"
@@ -406,7 +406,12 @@ class PendingResult:
     def result(self) -> np.ndarray:
         with _deadline_guard("chunk result gather"):
             _fault("chunk_scoring")
-            return np.asarray(self.raw).reshape(-1, 3)[: self.count]
+            # The fence pins async device time onto this span instead of
+            # letting it leak into whichever host op touches the array
+            # first; both are single attribute checks when obs is off.
+            with _obs_span("chunk_gather"):
+                _obs_fence(self.raw)
+                return np.asarray(self.raw).reshape(-1, 3)[: self.count]
 
 
 @dataclass(frozen=True)
@@ -426,7 +431,8 @@ class BucketedPending:
 
     def result(self) -> np.ndarray:
         with _deadline_guard("bucketed result gather"):
-            return self._result()
+            with _obs_span("chunk_gather"):
+                return self._result()
 
     def _result(self) -> np.ndarray:
         import jax
@@ -539,6 +545,7 @@ class AlignmentScorer:
         """
         with _deadline_guard("chunk dispatch"):
             _fault("chunk_dispatch")
+        _obs_inc("chunks_dispatched")
         if not seq2_codes:
             return PendingResult(np.zeros((0, 3), dtype=np.int32), 0)
         if self.backend == "oracle":
@@ -647,17 +654,18 @@ class AlignmentScorer:
     def _dispatch_batch(self, batch: "PaddedBatch", val_flat: np.ndarray):
         """Dispatch one shape-uniform padded batch on the configured path
         (local jitted or sharded); returns a pending."""
-        if self.sharding is None:
-            return self._score_local(batch, val_flat)
-        # ShardedPending: dispatch returns before the gather; the fetch
-        # (a collective on multi-host) happens at .result() (VERDICT r2
-        # item 6 — forcing here serialised --stream's overlap on meshes).
-        return self.sharding.score_async(
-            batch,
-            val_flat,
-            backend=self.backend,
-            chunk_budget=self.chunk_budget,
-        )
+        with _obs_span("chunk_dispatch"):
+            if self.sharding is None:
+                return self._score_local(batch, val_flat)
+            # ShardedPending: dispatch returns before the gather; the fetch
+            # (a collective on multi-host) happens at .result() (VERDICT r2
+            # item 6 — forcing here serialised --stream's overlap on meshes).
+            return self.sharding.score_async(
+                batch,
+                val_flat,
+                backend=self.backend,
+                chunk_budget=self.chunk_budget,
+            )
 
     def _score_local(self, batch: PaddedBatch, val_flat: np.ndarray) -> PendingResult:
         import jax.numpy as jnp
@@ -710,6 +718,12 @@ class AlignmentScorer:
                 l2s = choose_rowpack(
                     fm[1], batch.l2p, batch.len2, maxv=max_abs_value(val_flat)
                 )
+                # Concrete dispatch decisions as gauges: the run report
+                # names the program configuration the run actually ran.
+                _obs_gauge("config_feed", fm[1])
+                _obs_gauge("config_superblock", sb)
+                _obs_gauge("config_rowpack", l2s if l2s is not None else 0)
+                _obs_gauge("config_chunk", cb)
                 if self.check:
                     # The single point where every dispatch decision is
                     # concrete: feed, chunk, superblock, rowpack class.

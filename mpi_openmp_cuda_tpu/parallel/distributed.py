@@ -356,11 +356,11 @@ def scatter_gather_rescue(
     lost-worker protocol is testable single-process (a worker that never
     posted to a MemoryBoard IS a lost worker, deterministically).
     """
-    import sys
-
     import jax
     import numpy as np
 
+    from ..obs import export as obs_export
+    from ..obs.events import log_line
     from ..ops.dispatch import AlignmentScorer
     from ..resilience import rescue
 
@@ -368,7 +368,7 @@ def scatter_gather_rescue(
     nprocs = (
         jax.process_count() if num_processes is None else int(num_processes)
     )
-    log = log or (lambda msg: print(msg, file=sys.stderr))
+    log = log or log_line
     if board is None:
         board = (
             rescue.MemoryBoard()
@@ -386,6 +386,10 @@ def scatter_gather_rescue(
         else np.zeros((0, 3), dtype=np.int32)
     )
     rescue.post_shard(board, run_tag, pid, my_rows)
+    # The metrics plane rides the same board: each host's snapshot posts
+    # next to its rows (no-op with metrics off), so the coordinator's run
+    # report can carry a merged per-host `hosts` section.
+    obs_export.post_host_snapshot(board, run_tag, pid)
     if pid != 0:
         return None
 
@@ -405,6 +409,11 @@ def scatter_gather_rescue(
             lost.append(w)
             continue
         out[idx] = rows
+    # Fold posted host snapshots into the fleet report; workers already
+    # known lost are skipped rather than waiting out their timeout twice.
+    obs_export.gather_fleet(
+        board, run_tag, nprocs, skip=lost, timeout_s=beacon_s
+    )
     if lost:
         orphans = [i for w in lost for i in ledger[w]]
         log(

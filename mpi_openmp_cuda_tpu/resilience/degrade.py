@@ -24,8 +24,7 @@ statically rejects ``--degrade --distributed``, the same stance as
 
 from __future__ import annotations
 
-import sys
-
+from ..obs.events import log_line, publish
 from .policy import RetryExhaustedError, RetryPolicy
 
 # The fallback order.  'xla' is the MXU matmul formulation (with its own
@@ -71,7 +70,7 @@ class BackendDegrader:
         self._make = make_scorer
         self.enabled = enabled
         self.verified = False  # first degraded chunk oracle-checked yet?
-        self._log = log or (lambda msg: print(msg, file=sys.stderr))
+        self._log = log or log_line
 
     def step(self) -> str | None:
         """Fall one link down the chain; returns the new backend name, or
@@ -79,6 +78,7 @@ class BackendDegrader:
         nxt = DEGRADE_CHAIN.get(self.scorer.backend)
         if nxt is None:
             return None
+        publish("degrade.transition", frm=self.scorer.backend, to=nxt)
         self._log(
             f"mpi_openmp_cuda_tpu: warning: backend {self.scorer.backend!r} "
             f"exhausted its retry budget; degrading to {nxt!r} (the first "

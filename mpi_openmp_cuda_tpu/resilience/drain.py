@@ -34,8 +34,9 @@ from __future__ import annotations
 
 import os
 import signal
-import sys
 import threading
+
+from ..obs.events import log_line, publish
 
 
 class DrainInterrupt(BaseException):
@@ -62,15 +63,12 @@ def request_drain(why: str, log=None) -> None:
     global _requested
     if not _requested:
         _requested = True
-        (log or _stderr)(
+        publish("drain.request", why=why)
+        (log or log_line)(
             f"mpi_openmp_cuda_tpu: drain requested ({why}); finishing "
             "in-flight chunks, flushing the journal, then exiting 75 "
             "(resumable) — a second signal force-exits"
         )
-
-
-def _stderr(msg: str) -> None:
-    print(msg, file=sys.stderr)
 
 
 class drain_guard:
@@ -87,7 +85,7 @@ class drain_guard:
 
     def __init__(self, *, prearm: bool | None = None, log=None):
         self._prearm = prearm
-        self._log = log or _stderr
+        self._log = log or log_line
         self._saved: list[tuple[int, object]] = []
 
     def __enter__(self):

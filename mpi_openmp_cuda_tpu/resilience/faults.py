@@ -73,6 +73,8 @@ from __future__ import annotations
 
 from dataclasses import dataclass
 
+from ..obs.events import publish
+
 KNOWN_SITES = frozenset(
     {
         "chunk_dispatch",
@@ -209,6 +211,7 @@ class FaultRegistry:
         sf = self.sites.get(site)
         if self._scheduled(site):
             self.injected += 1
+            publish("fault.injected", site=site, kind=sf.kind)
             cls = (
                 InjectedFatalFaultError
                 if sf.kind == "fatal"
@@ -222,6 +225,7 @@ class FaultRegistry:
         hang = _HANG_SITES.get(site)
         if hang is not None and hang in self.sites and self._scheduled(hang):
             self.injected += 1
+            publish("fault.injected", site=hang, kind="hang")
             from . import watchdog
 
             # Blocks until the armed watchdog's deadline, then raises the
@@ -229,6 +233,8 @@ class FaultRegistry:
             watchdog.hang_until_deadline(hang)
         kill = _KILL_SITES.get(site)
         if kill is not None and kill in self.sites and self._scheduled(kill):
+            self.injected += 1
+            publish("fault.injected", site=kill, kind="kill")
             import os
             import signal
 

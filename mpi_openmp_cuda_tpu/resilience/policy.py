@@ -29,8 +29,9 @@ the degradation chain (:mod:`.degrade`) keys on.
 from __future__ import annotations
 
 import random
-import sys
 import time
+
+from ..obs.events import log_line, publish
 
 # The single source of the transient-vs-fatal classification (previously
 # a docstring contract in io/cli.py:_retrying).
@@ -83,7 +84,7 @@ class RetryPolicy:
         self.backoff_cap = float(backoff_cap)
         self.seed = int(seed)
         self._sleep = sleep
-        self._log = log or (lambda msg: print(msg, file=sys.stderr))
+        self._log = log or log_line
 
     # -- pieces ------------------------------------------------------------
     @staticmethod
@@ -128,6 +129,10 @@ class RetryPolicy:
                 raise
             except Exception as e:
                 used[0] += 1
+                # Every caught transient failure is one retry attempt —
+                # including the one that exhausts the budget, so a
+                # fail=N fault spec reports retry_attempts == N exactly.
+                publish("retry.attempt", site=describe, attempt=used[0])
                 if used[0] > self.retries:
                     raise RetryExhaustedError(
                         f"{describe}: retry budget exhausted after "
@@ -140,6 +145,7 @@ class RetryPolicy:
                     f"failed ({e}); retrying{suffix}"
                 )
                 if delay > 0:
+                    publish("retry.backoff", site=describe, delay=delay)
                     self._sleep(delay)
 
     def materialise(self, promise, rescore, describe: str, budget):

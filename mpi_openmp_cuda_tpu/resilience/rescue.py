@@ -29,10 +29,10 @@ the scatter semantics but makes the gather survivable:
 from __future__ import annotations
 
 import json
-import sys
 
 import numpy as np
 
+from ..obs.events import log_line, publish
 from .degrade import BackendDegrader, run_degrading
 
 
@@ -136,6 +136,13 @@ def fetch_shard(
     deadline.  Returns the [expect_n, 3] rows, or None when the worker
     is lost (no beacon, no rows, or rows of the wrong shape — a torn
     post is rescored, never trusted)."""
+    rows = _fetch_shard(board, run_tag, pid, expect_n, timeout_s)
+    if rows is None:
+        publish("rescue.beacon_miss", worker=pid)
+    return rows
+
+
+def _fetch_shard(board, run_tag, pid, expect_n, timeout_s):
     if board.get(_beacon_key(run_tag, pid), timeout_s) is None:
         return None
     raw = board.get(_rows_key(run_tag, pid), timeout_s)
@@ -168,7 +175,8 @@ def rescue_orphans(
     """
     from ..ops.dispatch import AlignmentScorer
 
-    log = log or (lambda msg: print(msg, file=sys.stderr))
+    log = log or log_line
+    publish("rescue.orphans", count=len(orphan_codes))
     start = "xla" if backend in ("pallas", "auto") else backend
     deg = BackendDegrader(
         AlignmentScorer(backend=start),

@@ -36,8 +36,9 @@ the drain handler, :mod:`.drain`) can act on it.
 from __future__ import annotations
 
 import contextlib
-import sys
 import threading
+
+from ..obs.events import log_line, publish
 
 #: The monitor thread's name: tests assert no thread with this name
 #: survives a clean CLI exit (the joined-on-stop contract).
@@ -78,14 +79,31 @@ class Watchdog:
     dangling monitor behind (asserted by the test suite).
     """
 
-    def __init__(self, deadline_s: float, *, log=None):
-        if deadline_s <= 0:
+    def __init__(
+        self,
+        deadline_s: float | None,
+        *,
+        log=None,
+        heartbeat_s: float | None = None,
+        heartbeat=None,
+    ):
+        if deadline_s is not None and deadline_s <= 0:
             raise ValueError(
                 f"watchdog deadline must be > 0 seconds, got {deadline_s}"
             )
-        self.deadline_s = float(deadline_s)
+        if deadline_s is None and heartbeat_s is None:
+            raise ValueError(
+                "watchdog needs a deadline, a heartbeat interval, or both"
+            )
+        if heartbeat_s is not None and heartbeat_s <= 0:
+            raise ValueError(
+                f"heartbeat interval must be > 0 seconds, got {heartbeat_s}"
+            )
+        self.deadline_s = None if deadline_s is None else float(deadline_s)
+        self.heartbeat_s = None if heartbeat_s is None else float(heartbeat_s)
+        self._heartbeat = heartbeat
         self.expiries = 0
-        self._log = log or (lambda msg: print(msg, file=sys.stderr))
+        self._log = log or log_line
         self._cond = threading.Condition()
         self._arm: _Arm | None = None
         self._stopped = False
@@ -109,11 +127,25 @@ class Watchdog:
         if thread is not None:
             thread.join()
 
+    def _beat(self) -> None:
+        """Emit one heartbeat line (the obs plane's periodic status).
+        No clock reads: cadence comes from condition-wait timeouts, the
+        same delay-not-decide stance as the deadline itself."""
+        if self._heartbeat is not None:
+            self._heartbeat()
+
     def _monitor(self) -> None:
+        hb = self.heartbeat_s
         with self._cond:
             while not self._stopped:
-                if self._arm is None:
-                    self._cond.wait()
+                if self._arm is None or self.deadline_s is None:
+                    # Idle (or heartbeat-only mode, where armed guards
+                    # carry no deadline): sleep a heartbeat interval —
+                    # forever when none is configured — and emit the
+                    # status line on each quiet timeout.
+                    notified = self._cond.wait(timeout=hb)
+                    if not notified and not self._stopped:
+                        self._beat()
                     continue
                 cur = self._arm
                 disarmed = self._cond.wait_for(
@@ -128,6 +160,7 @@ class Watchdog:
                 # warn about the real-hang case, then wait for disarm.
                 self.expiries += 1
                 cur.expired.set()
+                publish("watchdog.expiry", site=cur.describe)
                 self._log(
                     f"mpi_openmp_cuda_tpu: warning: {cur.describe} exceeded "
                     f"the {self.deadline_s:g}s watchdog deadline; if it "
@@ -149,6 +182,8 @@ class Watchdog:
                 token = _Arm(describe)
                 self._arm = token
                 self._cond.notify_all()
+        if not nested:
+            publish("watchdog.guard", state="armed", site=describe)
         try:
             yield
         finally:
@@ -156,6 +191,7 @@ class Watchdog:
                 with self._cond:
                     self._arm = None
                     self._cond.notify_all()
+                publish("watchdog.guard", state="disarmed", site=describe)
 
     def hang_until_expiry(self, site: str) -> None:
         """The injected-hang behaviour (``hang:*`` fault sites): block on
@@ -164,10 +200,12 @@ class Watchdog:
         With no guard armed the hang would block forever — fail fast."""
         with self._cond:
             token = self._arm
-        if token is None:
+        if token is None or self.deadline_s is None:
             raise HangWithoutDeadlineError(
-                f"injected hang at {site!r} outside any watchdog guard; "
-                "refusing to block forever (this is a chaos-spec bug)"
+                f"injected hang at {site!r} outside any deadline-armed "
+                "watchdog guard; refusing to block forever (this is a "
+                "chaos-spec bug — a heartbeat-only watchdog enforces no "
+                "deadline)"
             )
         token.expired.wait()
         raise DeadlineExpiredError(
@@ -182,12 +220,22 @@ class Watchdog:
 _active: Watchdog | None = None
 
 
-def activate_watchdog(deadline_s: float, *, log=None) -> Watchdog:
+def activate_watchdog(
+    deadline_s: float | None,
+    *,
+    log=None,
+    heartbeat_s: float | None = None,
+    heartbeat=None,
+) -> Watchdog:
     """Arm (and start) a fresh watchdog for one run; returns it so the
-    caller can inspect ``expiries`` afterwards."""
+    caller can inspect ``expiries`` afterwards.  ``deadline_s=None``
+    with a heartbeat runs the monitor in heartbeat-only mode (status
+    lines, no deadline enforcement)."""
     global _active
     deactivate_watchdog()
-    _active = Watchdog(deadline_s, log=log)
+    _active = Watchdog(
+        deadline_s, log=log, heartbeat_s=heartbeat_s, heartbeat=heartbeat
+    )
     _active.start()
     return _active
 

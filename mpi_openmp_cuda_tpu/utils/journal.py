@@ -32,6 +32,7 @@ import os
 
 import numpy as np
 
+from ..obs.metrics import drain_snapshot
 from ..resilience.drain import DrainInterrupt, drain_requested
 from ..resilience.faults import fire as _fault
 
@@ -120,8 +121,18 @@ def _write_event(f, name: str) -> None:
     marker).  Deliberately NOT a fault site: the event is advisory audit
     state written on the way out of an already-exceptional path — resume
     works whether or not it landed, and an injected failure here would
-    only mask the drain in flight."""
-    f.write(json.dumps({"event": name}) + "\n")
+    only mask the drain in flight.
+
+    When the obs plane is armed the record also carries a metrics
+    snapshot (counters at the moment of the drain) — the resume reader
+    skips event records wholesale, so the payload costs nothing on
+    resume.  The snapshot's timing comes from the obs clock; this module
+    stays clock-free (seqlint SEQ005)."""
+    rec = {"event": name}
+    payload = drain_snapshot()
+    if payload:
+        rec.update(payload)
+    f.write(json.dumps(rec) + "\n")
     f.flush()
     os.fsync(f.fileno())
 

@@ -111,6 +111,29 @@ ENV_VARS: tuple[EnvVar, ...] = (
         "lost-shard rescue tier under --distributed batch runs",
     ),
     EnvVar(
+        "SEQALIGN_METRICS",
+        "flag",
+        False,
+        "arm the observability plane: counters/spans collected for the "
+        "run (same as --metrics; implied by SEQALIGN_METRICS_OUT)",
+    ),
+    EnvVar(
+        "SEQALIGN_METRICS_OUT",
+        "str",
+        None,
+        "write the versioned JSON run report (plus a .prom Prometheus "
+        "text sidecar) here on exit, including exits 65/75 (same as "
+        "--metrics-out)",
+    ),
+    EnvVar(
+        "SEQALIGN_HEARTBEAT_S",
+        "float",
+        None,
+        "emit a periodic '[obs] ...' status line from the watchdog "
+        "monitor thread every this-many quiet seconds (same as "
+        "--heartbeat; implies --metrics)",
+    ),
+    EnvVar(
         "JAX_COORDINATOR_ADDRESS",
         "str",
         None,

@@ -1,41 +1,47 @@
 """Per-phase timing + profiler harness (SURVEY §5 tracing/profiling gap).
 
 The reference has no timers at all (the vendored StopWatch helpers are dead
-code).  This provides the phase wall-clock harness (parse / setup / score /
-print) and an optional ``jax.profiler`` trace context for TPU runs.
+code).  Since the observability PR the real timing engine is
+:mod:`..obs.spans`; :class:`PhaseTimer` stays as a thin shim over
+:class:`~..obs.spans.SpanRecorder` preserving the ``--profile`` contract
+(byte-compatible ``[profile]`` stderr report, a ``phases`` list of
+``(name, seconds)`` tuples).  The CLI hands the shim the run's armed
+recorder so profile phases and the run report's span section are one
+measurement, not two.
 """
 
 from __future__ import annotations
 
 import contextlib
 import sys
-import time
-from dataclasses import dataclass, field
+
+from ..obs.spans import SpanRecorder
 
 
-@dataclass
 class PhaseTimer:
-    """Accumulates named wall-clock phases; reports to stderr when enabled."""
+    """Accumulates named wall-clock phases; reports to stderr when enabled.
 
-    enabled: bool = False
-    phases: list[tuple[str, float]] = field(default_factory=list)
+    A shim over :class:`~..obs.spans.SpanRecorder`: ``phase()`` opens a
+    top-level span, ``phases`` exposes the completed top-level spans,
+    ``report()`` prints the historical byte-exact format.  Pass
+    ``recorder=`` to share the obs plane's armed recorder.
+    """
 
-    @contextlib.contextmanager
+    def __init__(self, enabled: bool = False, recorder: SpanRecorder | None = None):
+        self.enabled = bool(enabled)
+        self._recorder = recorder if recorder is not None else SpanRecorder()
+
+    @property
+    def phases(self) -> list[tuple[str, float]]:
+        return self._recorder.phases()
+
     def phase(self, name: str):
-        start = time.perf_counter()
-        try:
-            yield
-        finally:
-            self.phases.append((name, time.perf_counter() - start))
+        return self._recorder.span(name)
 
     def report(self, out=None) -> None:
         if not self.enabled:
             return
-        out = out or sys.stderr
-        total = sum(d for _, d in self.phases)
-        for name, dur in self.phases:
-            print(f"[profile] {name:>16}: {dur * 1e3:10.2f} ms", file=out)
-        print(f"[profile] {'total':>16}: {total * 1e3:10.2f} ms", file=out)
+        self._recorder.report(out or sys.stderr)
 
 
 @contextlib.contextmanager
@@ -44,8 +50,15 @@ def device_trace(log_dir: str | None):
     if log_dir is None:
         yield
         return
-    import jax
-
+    try:
+        import jax
+    except ModuleNotFoundError as e:
+        # A clear diagnostic instead of an ImportError traceback: --trace
+        # is the only profiling feature that hard-requires jax.
+        raise RuntimeError(
+            "--trace needs jax (jax.profiler) which is not installed in "
+            "this environment; install the jax extra or drop --trace"
+        ) from e
     with jax.profiler.trace(log_dir):
         yield
 

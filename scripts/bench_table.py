@@ -23,6 +23,7 @@ the 8-virtual-device CPU mesh, see BASELINE.md):
 from __future__ import annotations
 
 import argparse
+import json
 import os
 import sys
 import time
@@ -165,13 +166,63 @@ def row(config: str, hw: str, m: dict) -> str:
     return f"| {config} | {hw} ({m['backend']}) | {measured} | {vs} |"
 
 
+def load_bench_records(path: str) -> list[dict]:
+    """Parse recorded ``bench.py`` stdout into bare measurement records.
+
+    Accepts both blob shapes: the historical bare JSON record, and the
+    shared run-report envelope (``kind="bench"``) that bench.py emits
+    since the observability plane landed.  Wrapped records are gated
+    through :func:`validate_report` and unwrapped so callers see one
+    shape either way.
+    """
+    from mpi_openmp_cuda_tpu.obs.metrics import validate_report
+
+    records = []
+    with open(path, encoding="utf-8") as fh:
+        for line in fh:
+            line = line.strip()
+            if not line.startswith("{"):
+                continue
+            rec = json.loads(line)
+            if "schema" in rec:
+                validate_report(rec)
+                rec = {
+                    k: v
+                    for k, v in rec.items()
+                    if k not in ("schema", "schema_version", "kind", "meta")
+                }
+            records.append(rec)
+    return records
+
+
+def recorded_row(rec: dict) -> str:
+    vs = rec.get("vs_baseline")
+    return (
+        f"| {rec['metric']} | {rec['value']:.4g} {rec.get('unit', '')} "
+        f"| {f'{vs:.3g}x' if isinstance(vs, (int, float)) else 'n/a'} |"
+    )
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--cpu", action="store_true", help="measure the CPU config row only")
     # 1024 amortised reps, matching bench.py: the device-time increment
     # must dominate the host link's ±25 ms jitter (see bench.py).
     ap.add_argument("--reps", type=int, default=1024)
+    ap.add_argument(
+        "--from-json",
+        metavar="PATH",
+        help="tabulate previously recorded bench.py output (either blob "
+        "shape: bare record or run-report envelope) instead of measuring",
+    )
     args = ap.parse_args()
+
+    if args.from_json:
+        print("| Metric | Value | vs baseline |")
+        print("|---|---|---|")
+        for rec in load_bench_records(args.from_json):
+            print(recorded_row(rec))
+        return
 
     print("| Config | Hardware | Measured | vs est. reference (2.0e9 elem/s) |")
     print("|---|---|---|---|")

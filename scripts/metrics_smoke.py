@@ -1,0 +1,108 @@
+"""End-to-end smoke gate for the observability plane (``make metrics-smoke``).
+
+Runs the CLI on the tiny fixture with ``--metrics --metrics-out``, then
+gates every artifact the plane promises:
+
+* the JSON run report parses, passes ``obs.metrics.validate_report``,
+  carries ``kind="run"`` with ``exit_code`` 0, and counted at least one
+  dispatched chunk;
+* the per-phase span section is present with non-negative durations;
+* the ``.prom`` sidecar renders the same counters in Prometheus text
+  format.
+
+Exit 0 on success, 1 with every problem listed on failure — same
+all-problems-at-once reporting style as seqlint and validate_report.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from mpi_openmp_cuda_tpu.obs.metrics import validate_report  # noqa: E402
+
+FIXTURE = os.path.join(REPO, "tests", "fixtures", "tiny.txt")
+
+
+def main() -> int:
+    out_dir = tempfile.mkdtemp(prefix="metrics_smoke_")
+    report_path = os.path.join(out_dir, "run.json")
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    with open(FIXTURE, "rb") as fh:
+        proc = subprocess.run(
+            [
+                sys.executable,
+                "-m",
+                "mpi_openmp_cuda_tpu",
+                "--metrics",
+                "--metrics-out",
+                report_path,
+            ],
+            stdin=fh,
+            capture_output=True,
+            cwd=REPO,
+            env=env,
+            timeout=600,
+        )
+    if proc.returncode != 0:
+        sys.stderr.write(proc.stderr.decode(errors="replace"))
+        print(f"metrics-smoke: FAIL: CLI exited {proc.returncode}")
+        return 1
+
+    problems: list[str] = []
+    try:
+        with open(report_path, encoding="utf-8") as fh:
+            rec = json.load(fh)
+    except (OSError, json.JSONDecodeError) as e:
+        print(f"metrics-smoke: FAIL: no readable report at {report_path}: {e}")
+        return 1
+    try:
+        validate_report(rec)
+    except ValueError as e:
+        problems.append(str(e))
+    else:
+        if rec["kind"] != "run":
+            problems.append(f'kind: want "run", got {rec["kind"]!r}')
+        if rec.get("exit_code") != 0:
+            problems.append(f"exit_code: want 0, got {rec.get('exit_code')!r}")
+        if not rec["counters"].get("chunks_dispatched"):
+            problems.append("counters.chunks_dispatched: want > 0")
+        spans = rec.get("spans") or {}
+        if not spans.get("phases"):
+            problems.append("spans.phases: want at least one recorded phase")
+        if any(dur < 0 for _, dur in spans.get("phases", [])):
+            problems.append("spans.phases: negative duration")
+
+    prom_path = report_path + ".prom"
+    try:
+        with open(prom_path, encoding="utf-8") as fh:
+            prom = fh.read()
+    except OSError as e:
+        problems.append(f"prom sidecar: {e}")
+    else:
+        if "seqalign_chunks_dispatched_total" not in prom:
+            problems.append(
+                "prom sidecar: missing seqalign_chunks_dispatched_total"
+            )
+
+    if problems:
+        for p in problems:
+            print(f"metrics-smoke: FAIL: {p}")
+        return 1
+    print(
+        "metrics-smoke: OK "
+        f"(chunks={rec['counters']['chunks_dispatched']}, "
+        f"phases={len(rec['spans']['phases'])}, report={report_path})"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

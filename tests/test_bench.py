@@ -44,9 +44,19 @@ def test_bench_emits_contract_json_line():
     assert len(lines) == 1, f"expected exactly one stdout line, got {lines!r}"
     rec = json.loads(lines[0])
     # Required driver-contract keys; the probe/MFU fields join on the
-    # pallas backend (real TPU runs).
+    # pallas backend (real TPU runs).  Since PR 5 the record rides the
+    # shared run-report envelope (kind="bench") and must validate
+    # against the one schema gate.
+    from mpi_openmp_cuda_tpu.obs.metrics import (
+        RUN_REPORT_SCHEMA,
+        validate_report,
+    )
+
+    validate_report(rec)
+    assert rec["schema"] == RUN_REPORT_SCHEMA and rec["kind"] == "bench"
     assert set(rec) >= {"metric", "value", "unit", "vs_baseline"}
-    assert set(rec) <= {"metric", "value", "unit", "vs_baseline",
+    assert set(rec) <= {"schema", "schema_version", "kind",
+                        "metric", "value", "unit", "vs_baseline",
                         "e2e_first_run_s", "e2e_warm_s",
                         "real_tflops", "kernel_feed", "mfu_vs_probe",
                         "mxu_probe_bf16_tflops", "probe_quiet_ref_tflops",

@@ -1,0 +1,512 @@
+"""Observability plane tests (ISSUE 5): fake-clock counter/span goldens,
+the Prometheus textfile golden, report schema gates, the heartbeat, the
+multi-host fleet plane, and e2e runs whose report counters exactly match
+an injected fault spec — including the exit-75 drain flush.
+
+Counter-exact e2e tests carry ``no_chaos``: an ambient ``make chaos``
+fault spec would add its own retries/faults to the accounting.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import time
+
+import numpy as np
+import pytest
+
+from conftest import run_cli_inproc as run_inproc
+from test_fixtures import fixture_path, golden
+
+from mpi_openmp_cuda_tpu.obs import (
+    arm_observability,
+    disarm_observability,
+    events,
+    export as obs_export,
+    metrics,
+    spans,
+)
+from mpi_openmp_cuda_tpu.obs.metrics import (
+    RUN_REPORT_SCHEMA,
+    MetricsRegistry,
+    run_report,
+    to_prometheus,
+    validate_report,
+    wrap_report,
+)
+from mpi_openmp_cuda_tpu.obs.spans import SpanRecorder
+from mpi_openmp_cuda_tpu.utils.profiling import PhaseTimer
+
+
+class FakeClock:
+    """Deterministic monotonic clock for byte-stable goldens."""
+
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+@pytest.fixture(autouse=True)
+def _clean_plane(monkeypatch):
+    # e2e retries must not sleep through real backoff, and no ambient
+    # metrics config may leak in; the plane itself is disarmed on the
+    # way out so an assertion failure cannot poison later tests.
+    monkeypatch.setenv("SEQALIGN_BACKOFF_BASE", "0")
+    monkeypatch.delenv("SEQALIGN_METRICS", raising=False)
+    monkeypatch.delenv("SEQALIGN_METRICS_OUT", raising=False)
+    monkeypatch.delenv("SEQALIGN_HEARTBEAT_S", raising=False)
+    yield
+    disarm_observability()
+
+
+# -- registry unit (fake clock) --------------------------------------------
+
+
+def test_registry_snapshot_golden():
+    clock = FakeClock()
+    reg = MetricsRegistry(clock)
+    reg.inc("retry_attempts")
+    reg.inc("retry_attempts")
+    reg.gauge("backend", "xla")
+    reg.observe("backoff_delay_s", 0.5)
+    reg.observe("backoff_delay_s", 1.5)
+    clock.advance(2.0)
+    assert reg.snapshot() == {
+        "uptime_s": 2.0,
+        "counters": {"retry_attempts": 2},
+        "gauges": {"backend": "xla"},
+        "histograms": {
+            "backoff_delay_s": {"count": 2, "sum": 2.0, "min": 0.5, "max": 1.5}
+        },
+    }
+
+
+def test_record_event_counter_catalogue():
+    # Every bus event maps to its documented counter (ARCHITECTURE §10).
+    reg = MetricsRegistry(FakeClock())
+    for event in (
+        "retry.attempt",
+        "degrade.transition",
+        "watchdog.expiry",
+        "drain.request",
+        "fault.injected",
+        "recompile",
+        "log",
+    ):
+        reg.record_event(event, {})
+    reg.record_event("retry.backoff", {"delay": 0.5})
+    reg.record_event("watchdog.guard", {"state": "armed"})
+    reg.record_event("watchdog.guard", {"state": "disarmed"})
+    reg.record_event("rescue.beacon_miss", {"worker": 2})
+    reg.record_event("rescue.orphans", {"count": 7})
+    reg.record_event("mystery", {})
+    assert reg.counters == {
+        "retry_attempts": 1,
+        "degrade_transitions": 1,
+        "deadline_expiries": 1,
+        "drain_requests": 1,
+        "faults_injected": 1,
+        "recompiles": 1,
+        "log_lines": 1,
+        "backoff_waits": 1,
+        "guard_arms": 1,
+        "guard_disarms": 1,
+        "beacon_misses": 1,
+        "rescued_sequences": 7,
+        "events.mystery": 1,
+    }
+    assert reg.histograms["backoff_delay_s"] == {
+        "count": 1, "sum": 0.5, "min": 0.5, "max": 0.5,
+    }
+
+
+def test_module_hooks_are_noops_when_disarmed():
+    assert metrics.active_metrics() is None
+    metrics.inc("x")
+    metrics.gauge("g", 1)
+    metrics.observe("h", 1.0)
+    events.publish("retry.attempt")
+    assert metrics.drain_snapshot() is None
+    # Disarmed span() hands back ONE shared nullcontext: no allocation.
+    assert spans.span("a") is spans.span("b")
+    spans.fence(np.arange(3))  # no recorder: must not touch jax
+
+
+def test_arm_observability_wires_bus_into_registry():
+    registry, recorder = arm_observability(FakeClock(), FakeClock())
+    assert metrics.active_metrics() is registry
+    assert spans.active_spans() is recorder
+    events.publish("retry.attempt")
+    events.publish("retry.backoff", delay=0.5)
+    events.publish("rescue.orphans", count=7)
+    assert registry.counters["retry_attempts"] == 1
+    assert registry.counters["backoff_waits"] == 1
+    assert registry.counters["rescued_sequences"] == 7
+    disarm_observability()
+    assert metrics.active_metrics() is None
+    assert events.active_bus() is None
+    assert spans.active_spans() is None
+
+
+def test_log_line_rides_the_bus_and_keeps_stderr_bytes(capsys):
+    registry, _ = arm_observability(FakeClock(), FakeClock())
+    events.log_line("diag line")
+    assert capsys.readouterr().err == "diag line\n"  # byte-identical stderr
+    assert registry.counters["log_lines"] == 1
+    disarm_observability()
+    events.log_line("still prints")  # disarmed: plain stderr, no count
+    assert capsys.readouterr().err == "still prints\n"
+
+
+# -- spans (fake clock) ----------------------------------------------------
+
+
+def test_span_recorder_nested_dotted_paths():
+    clock = FakeClock()
+    rec = SpanRecorder(clock)
+    with rec.span("score"):
+        clock.advance(1.0)
+        with rec.span("chunk_gather"):
+            clock.advance(0.25)
+        with rec.span("chunk_gather"):
+            clock.advance(0.25)
+    with rec.span("print"):
+        clock.advance(0.5)
+    assert rec.spans == [
+        ("score.chunk_gather", 0.25),
+        ("score.chunk_gather", 0.25),
+        ("score", 1.5),
+        ("print", 0.5),
+    ]
+    assert rec.phases() == [("score", 1.5), ("print", 0.5)]
+    assert rec.totals() == {
+        "score.chunk_gather": 0.5,
+        "score": 1.5,
+        "print": 0.5,
+    }
+
+
+def test_phase_timer_shim_report_bytes():
+    # The historical PhaseTimer [profile] format, byte-for-byte.
+    clock = FakeClock()
+    timer = PhaseTimer(enabled=True, recorder=SpanRecorder(clock))
+    with timer.phase("parse"):
+        clock.advance(0.0125)
+    assert timer.phases == [("parse", 0.0125)]
+    buf = io.StringIO()
+    timer.report(out=buf)
+    assert buf.getvalue() == (
+        "[profile]            parse:      12.50 ms\n"
+        "[profile]            total:      12.50 ms\n"
+    )
+
+
+def test_phase_timer_disabled_prints_nothing():
+    timer = PhaseTimer(enabled=False)
+    with timer.phase("parse"):
+        pass
+    buf = io.StringIO()
+    timer.report(out=buf)
+    assert buf.getvalue() == ""
+
+
+# -- report schema + Prometheus golden -------------------------------------
+
+
+def test_run_report_roundtrip_validates():
+    clock = FakeClock()
+    registry, recorder = arm_observability(clock, clock)
+    events.publish("retry.attempt")
+    with spans.span("score"):
+        clock.advance(1.0)
+    rec = run_report(registry, spans=recorder, exit_code=0)
+    validate_report(rec)
+    assert rec["schema"] == RUN_REPORT_SCHEMA
+    assert rec["kind"] == "run"
+    assert rec["counters"] == {"retry_attempts": 1}
+    assert rec["spans"] == {
+        "phases": [["score", 1.0]],
+        "totals": {"score": 1.0},
+    }
+    assert rec["exit_code"] == 0
+
+
+def test_wrap_report_bench_kind_validates():
+    rec = wrap_report("bench", {"metric": "eps", "value": 1.0}, meta={"h": 1})
+    validate_report(rec)
+    assert rec["meta"] == {"h": 1}
+
+
+def test_validate_report_lists_every_problem():
+    with pytest.raises(ValueError) as ei:
+        validate_report({
+            "schema": "nope",
+            "schema_version": 0,
+            "kind": "run",
+            "counters": {"a": "x"},
+            "gauges": {},
+            "histograms": {"h": {"count": 1}},
+            "uptime_s": "later",
+            "exit_code": "zero",
+        })
+    msg = str(ei.value)
+    for frag in (
+        "schema:",
+        "schema_version:",
+        "counters['a']",
+        "histograms['h']",
+        "uptime_s:",
+        "exit_code:",
+    ):
+        assert frag in msg, msg
+
+
+def test_prometheus_textfile_golden():
+    snapshot = {
+        "uptime_s": 2.0,
+        "counters": {"retry_attempts": 2},
+        "gauges": {"backend": "xla", "chunks_total": 5},
+        "histograms": {
+            "backoff_delay_s": {"count": 2, "sum": 2.0, "min": 0.5, "max": 1.5}
+        },
+    }
+    assert to_prometheus(snapshot) == (
+        "# TYPE seqalign_retry_attempts_total counter\n"
+        "seqalign_retry_attempts_total 2\n"
+        "# TYPE seqalign_backend_info gauge\n"
+        'seqalign_backend_info{value="xla"} 1\n'
+        "# TYPE seqalign_chunks_total gauge\n"
+        "seqalign_chunks_total 5\n"
+        "# TYPE seqalign_backoff_delay_s summary\n"
+        "seqalign_backoff_delay_s_count 2\n"
+        "seqalign_backoff_delay_s_sum 2.0\n"
+        "# TYPE seqalign_backoff_delay_s_min gauge\n"
+        "seqalign_backoff_delay_s_min 0.5\n"
+        "# TYPE seqalign_backoff_delay_s_max gauge\n"
+        "seqalign_backoff_delay_s_max 1.5\n"
+        "# TYPE seqalign_uptime_seconds gauge\n"
+        "seqalign_uptime_seconds 2.0\n"
+    )
+
+
+def test_flush_run_report_writes_json_and_prom(tmp_path):
+    clock = FakeClock()
+    registry, recorder = arm_observability(clock, clock)
+    registry.inc("chunks_dispatched")
+    path = str(tmp_path / "run.json")
+    rec = obs_export.flush_run_report(registry, recorder, path, exit_code=0)
+    with open(path) as f:
+        assert json.load(f) == rec
+    validate_report(rec)
+    with open(path + ".prom") as f:
+        assert "seqalign_chunks_dispatched_total 1" in f.read()
+    # No path / no registry: a silent no-op (metrics on, report off).
+    assert obs_export.flush_run_report(registry, recorder, None) is None
+    assert obs_export.flush_run_report(None, None, path) is None
+
+
+# -- heartbeat -------------------------------------------------------------
+
+
+def test_heartbeat_line_golden():
+    assert obs_export.heartbeat_line({
+        "counters": {"chunks_dispatched": 12, "retry_attempts": 1},
+        "gauges": {"chunks_total": 40},
+    }) == "[obs] chunk 12/40 retries=1 degraded=no"
+    assert obs_export.heartbeat_line({
+        "counters": {"degrade_transitions": 1},
+        "gauges": {},
+    }) == "[obs] chunk 0/? retries=0 degraded=yes"
+
+
+def test_heartbeat_callback_reads_armed_registry():
+    lines: list[str] = []
+    beat = obs_export.heartbeat_callback(log=lines.append)
+    beat()  # disarmed: silent
+    assert lines == []
+    registry, _ = arm_observability(FakeClock(), FakeClock())
+    registry.inc("chunks_dispatched", 12)
+    registry.gauge("chunks_total", 40)
+    registry.inc("retry_attempts")
+    beat()
+    assert lines == ["[obs] chunk 12/40 retries=1 degraded=no"]
+
+
+def test_watchdog_heartbeat_only_mode_beats():
+    from mpi_openmp_cuda_tpu.resilience.watchdog import (
+        activate_watchdog,
+        deactivate_watchdog,
+    )
+
+    beats: list[int] = []
+    activate_watchdog(None, heartbeat_s=0.005, heartbeat=lambda: beats.append(1))
+    try:
+        deadline = time.monotonic() + 2.0
+        while not beats and time.monotonic() < deadline:
+            time.sleep(0.005)
+    finally:
+        deactivate_watchdog()
+    assert beats, "heartbeat-only watchdog never emitted a beat"
+
+
+# -- the multi-host fleet plane --------------------------------------------
+
+
+def test_fleet_snapshots_ride_the_board():
+    from mpi_openmp_cuda_tpu.resilience.rescue import MemoryBoard
+
+    registry, recorder = arm_observability(FakeClock(), FakeClock())
+    registry.inc("chunks_dispatched")
+    board = MemoryBoard()
+    obs_export.post_host_snapshot(board, "tag", 1)
+    board.post("seqalign/tag/metrics/2", "{torn")  # torn JSON: omitted
+    obs_export.gather_fleet(board, "tag", 4, skip=(3,), timeout_s=0.01)
+    # 0 never posted, 2 is torn, 3 is skipped as already-lost: only 1.
+    assert set(registry.fleet) == {"1"}
+    assert registry.fleet["1"]["counters"]["chunks_dispatched"] == 1
+    rec = run_report(registry, spans=recorder, exit_code=0)
+    validate_report(rec)
+    assert rec["hosts"]["1"]["counters"]["chunks_dispatched"] == 1
+
+
+def test_fleet_plane_is_noop_when_disarmed():
+    from mpi_openmp_cuda_tpu.resilience.rescue import MemoryBoard
+
+    board = MemoryBoard()
+    obs_export.post_host_snapshot(board, "tag", 0)
+    obs_export.gather_fleet(board, "tag", 2)
+    assert board.get("seqalign/tag/metrics/0") is None
+
+
+# -- e2e: the acceptance contract ------------------------------------------
+
+
+@pytest.mark.no_chaos  # exact counter accounting
+def test_injected_fault_report_counts_match_spec(tmp_path, capsys):
+    # ISSUE 5 acceptance: 2 injected retries + 1 degrade -> a schema-valid
+    # report whose counters match the spec EXACTLY.
+    path = str(tmp_path / "run.json")
+    out, err = run_inproc(
+        "--input", fixture_path("tiny"),
+        "--retries", "1",
+        "--faults", "chunk_scoring:fail=2",
+        "--degrade",
+        "--metrics", "--metrics-out", path,
+        capsys=capsys,
+    )
+    assert out == golden("tiny")  # observability never perturbs results
+    with open(path) as f:
+        rec = json.load(f)
+    validate_report(rec)
+    assert rec["kind"] == "run"
+    assert rec["exit_code"] == 0
+    assert rec["counters"]["retry_attempts"] == 2
+    assert rec["counters"]["degrade_transitions"] == 1
+    assert rec["counters"]["faults_injected"] == 2
+    assert rec["counters"]["chunks_dispatched"] >= 1
+    assert "backend" in rec["gauges"]
+    # Per-phase spans: the batch pipeline's four phases, in order, and
+    # each phase's total matches its single span exactly.
+    phases = [name for name, _ in rec["spans"]["phases"]]
+    assert phases == ["parse", "setup", "score", "print"]
+    for name, dur in rec["spans"]["phases"]:
+        assert rec["spans"]["totals"][name] == dur
+    with open(path + ".prom") as f:
+        prom = f.read()
+    assert "seqalign_retry_attempts_total 2" in prom
+    assert "seqalign_degrade_transitions_total 1" in prom
+
+
+@pytest.mark.no_chaos  # exact counter accounting
+def test_failed_run_still_flushes_report_exit65(tmp_path, capsys):
+    path = str(tmp_path / "run.json")
+    out, err = run_inproc(
+        "--input", fixture_path("tiny"),
+        "--retries", "1",
+        "--faults", "chunk_scoring:fail=5",
+        "--metrics-out", path,  # implies --metrics
+        capsys=capsys,
+        rc_want=65,
+    )
+    assert out == ""  # fail-stop stdout
+    with open(path) as f:
+        rec = json.load(f)
+    validate_report(rec)
+    assert rec["exit_code"] == 65
+    # Budget 1: the first attempt and its one retry both fault.
+    assert rec["counters"]["retry_attempts"] == 2
+    assert rec["counters"]["faults_injected"] == 2
+
+
+@pytest.mark.no_chaos  # exact journal contents
+def test_drained_run_flushes_report_exit75(tmp_path, monkeypatch, capsys):
+    monkeypatch.setenv("SEQALIGN_DRAIN", "1")
+    jpath = str(tmp_path / "j.jsonl")
+    mpath = str(tmp_path / "run.json")
+    out, err = run_inproc(
+        "--input", fixture_path("tiny"),
+        "--journal", jpath,
+        "--metrics-out", mpath,
+        capsys=capsys,
+        rc_want=75,
+    )
+    assert out == ""
+    with open(mpath) as f:
+        rec = json.load(f)
+    validate_report(rec)
+    assert rec["exit_code"] == 75
+    # The journal's resumable-exit record carries the drain-time metrics
+    # snapshot when the plane is armed.
+    with open(jpath) as f:
+        recs = [json.loads(line) for line in f.read().splitlines()]
+    drains = [r for r in recs if r.get("event") == "drain"]
+    assert drains and "metrics" in drains[0]
+    validate_report(wrap_report("run", dict(drains[0]["metrics"], exit_code=75)))
+
+
+@pytest.mark.no_chaos  # retries would break chunks_total == chunks_dispatched
+def test_stream_report_chunk_gauges_and_nested_spans(tmp_path, capsys):
+    mpath = str(tmp_path / "run.json")
+    out, _ = run_inproc(
+        "--input", fixture_path("stress_small"),
+        "--stream", "3",
+        "--metrics-out", mpath,
+        capsys=capsys,
+    )
+    assert out == golden("stress_small")
+    with open(mpath) as f:
+        rec = json.load(f)
+    validate_report(rec)
+    # A clean run dispatches exactly chunks_total chunks, and the
+    # per-chunk dispatch spans nest under the stream phase.
+    assert rec["counters"]["chunks_dispatched"] == rec["gauges"]["chunks_total"]
+    assert "stream.chunk_dispatch" in rec["spans"]["totals"]
+
+
+def test_metrics_out_env_var_writes_report(tmp_path, monkeypatch, capsys):
+    # SEQALIGN_METRICS_OUT alone arms the plane (flag parity, SEQ002
+    # registry) — runs under the ambient chaos spec too, so only the
+    # schema is asserted, never counts.
+    mpath = str(tmp_path / "run.json")
+    monkeypatch.setenv("SEQALIGN_METRICS_OUT", mpath)
+    out, _ = run_inproc("--input", fixture_path("tiny"), capsys=capsys)
+    assert out == golden("tiny")
+    with open(mpath) as f:
+        validate_report(json.load(f))
+
+
+def test_metrics_off_leaves_no_plane_and_no_report(capsys):
+    out, _ = run_inproc("--input", fixture_path("tiny"), capsys=capsys)
+    assert out == golden("tiny")
+    # The CLI's finally disarmed nothing because nothing was armed; the
+    # library-visible hooks are back to (stayed at) zero-cost no-ops.
+    assert metrics.active_metrics() is None
+    assert events.active_bus() is None
+    assert spans.active_spans() is None
+    assert spans.span("x") is spans.span("y")

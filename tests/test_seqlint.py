@@ -184,6 +184,50 @@ class TestSeq005WallClock:
         )
 
 
+class TestSeq006StderrBypass:
+    def test_direct_stderr_print_in_instrumented_module(self, tmp_path):
+        findings = _lint_snippet(
+            tmp_path,
+            "resilience/foo.py",
+            """
+            import sys
+
+            def warn(msg):
+                print(msg, file=sys.stderr)
+            """,
+        )
+        assert [f.code for f in findings] == ["SEQ006"]
+        assert "log_line" in findings[0].message
+
+    def test_plain_print_is_out_of_scope(self, tmp_path):
+        # Only the stderr diagnostic channel must ride the bus; stdout is
+        # the result stream and has its own byte-exact contract.
+        assert not _lint_snippet(
+            tmp_path,
+            "resilience/foo.py",
+            "import sys\n\ndef out(msg):\n    print(msg)\n",
+        )
+
+    def test_uninstrumented_modules_are_out_of_scope(self, tmp_path):
+        assert not _lint_snippet(
+            tmp_path,
+            "io/foo.py",
+            "import sys\n\ndef warn(m):\n    print(m, file=sys.stderr)\n",
+        )
+
+    @pytest.mark.parametrize(
+        "rel",
+        ["utils/journal.py", "ops/dispatch.py", "parallel/distributed.py"],
+    )
+    def test_every_instrumented_path_is_covered(self, tmp_path, rel):
+        findings = _lint_snippet(
+            tmp_path,
+            rel,
+            "import sys\n\ndef warn(m):\n    print(m, file=sys.stderr)\n",
+        )
+        assert [f.code for f in findings] == ["SEQ006"]
+
+
 class TestSuppressions:
     def test_per_line_disable(self, tmp_path):
         assert not _lint_snippet(
