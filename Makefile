@@ -18,7 +18,7 @@ PY_CFLAGS  := $(shell $(PYCONFIG) --includes)
 PY_LDFLAGS := $(shell $(PYCONFIG) --ldflags --embed)
 INPUT      ?= /root/reference/input5.txt
 
-.PHONY: build run run2 runOn2 test chaos chaos-kill analyze metrics-smoke bench bench-table bench-gather check clean
+.PHONY: build run run2 runOn2 test chaos chaos-kill analyze metrics-smoke serve-smoke bench bench-table bench-gather check clean
 
 build: final
 
@@ -103,6 +103,14 @@ analyze:
 # the JSON run report and its Prometheus sidecar.  CPU-only, seconds.
 metrics-smoke:
 	JAX_PLATFORMS=cpu $(PYTHON) scripts/metrics_smoke.py
+
+# Serving-plane smoke gate (docs/ARCHITECTURE.md §12): boot --serve
+# --port 0 as a subprocess, run 6 concurrent loopback clients sharing
+# one problem key, SIGTERM, then gate coalescing (dispatches < requests),
+# steady-state recompiles == 0, drain exit 75, and the run report
+# schema.  CPU-only, seconds.
+serve-smoke:
+	JAX_PLATFORMS=cpu $(PYTHON) scripts/serve_smoke.py
 
 # Full coverage in TWO pytest processes: the fast tier, then the
 # slow-marked tests alone.  A single combined process segfaults jaxlib's

@@ -30,6 +30,11 @@ SEQ006   no direct ``print(..., file=sys.stderr)`` in the instrumented
          modules (resilience/, journal, dispatch, distributed) — route
          diagnostics through ``obs.events.log_line`` so an armed
          observability plane sees every line the operator sees (PR 5).
+SEQ007   no bare blocking waits (``time.sleep`` / ``Condition.wait`` /
+         ``wait_for``) in ``serve/`` outside ``serve/clock.py`` — every
+         serve-loop wait must ride the injectable
+         ``ServeClock.block_until`` so tests drive a fake clock and a
+         drain signal is noticed within one bounded wait (PR 6).
 =======  ==================================================================
 
 Suppression: append ``# seqlint: disable=SEQ00N`` to the offending line
@@ -60,7 +65,10 @@ _TRACED_NAME_RE = re.compile(
 _TRACED_DIRS = ("ops", "parallel")
 
 #: Modules whose DECISIONS must be wall-clock-free (SEQ005).
-_DETERMINISTIC_PATHS = ("resilience/", "utils/journal.py")
+_DETERMINISTIC_PATHS = ("resilience/", "utils/journal.py", "serve/queue.py")
+
+#: The serving plane's single legal home for blocking waits (SEQ007).
+_SERVE_CLOCK_HOME = "serve/clock.py"
 
 #: The single legal home for environment reads (SEQ002).
 _ENV_HOME = "utils/platform.py"
@@ -161,6 +169,13 @@ class _Linter(ast.NodeVisitor):
         )
         self.in_instrumented = any(
             p in rel for p in _INSTRUMENTED_PATHS
+        )
+        # Path-segment match, not substring: "serve/" would also match
+        # a hypothetical "observe/" module.
+        self.in_serve = (
+            len(parts) > 1
+            and parts[1] == "serve"
+            and not rel.endswith(_SERVE_CLOCK_HOME)
         )
 
     # -- bookkeeping -------------------------------------------------------
@@ -332,6 +347,28 @@ class _Linter(ast.NodeVisitor):
                         "obs.events.log_line (same bytes on stderr, plus "
                         "a `log` event when the bus is armed)",
                     )
+
+        # SEQ007: bare blocking waits in the serving plane.
+        if self.in_serve:
+            is_sleep = (
+                isinstance(func, ast.Attribute)
+                and func.attr == "sleep"
+                and isinstance(func.value, ast.Name)
+                and func.value.id == "time"
+            ) or (isinstance(func, ast.Name) and func.id == "sleep")
+            is_wait = isinstance(func, ast.Attribute) and func.attr in (
+                "wait",
+                "wait_for",
+            )
+            if is_sleep or is_wait:
+                self._emit(
+                    "SEQ007",
+                    node,
+                    "bare blocking wait in the serving plane; route the "
+                    "wait through the injectable ServeClock.block_until "
+                    "(serve/clock.py) so tests drive a fake clock and "
+                    "drain signals stay bounded",
+                )
         self.generic_visit(node)
 
     # -- SEQ002: os.environ subscripts / membership ------------------------

@@ -18,7 +18,6 @@ from ..obs.metrics import gauge as obs_gauge
 from ..ops.dispatch import AlignmentScorer
 from ..resilience.degrade import (
     BackendDegrader,
-    MaterialisedRows,
     run_degrading,
     verify_rows_against_oracle,
 )
@@ -33,6 +32,7 @@ from ..resilience.watchdog import (
 from ..utils.platform import env_flag, env_float, env_int, env_str
 from ..utils.profiling import PhaseTimer, device_trace
 from .parse import load_problem
+from .pipeline import ChunkPipeline, PendingWindow
 from .printer import guarded_stdout, print_results, write_json_sidecar
 
 
@@ -239,6 +239,29 @@ def build_arg_parser() -> argparse.ArgumentParser:
         "status to stderr from the watchdog monitor thread after every "
         "S quiet seconds (SEQALIGN_HEARTBEAT_S; implies --metrics and "
         "composes with --deadline on the same monitor thread)",
+    )
+    p.add_argument(
+        "--serve",
+        action="store_true",
+        help="persistent serving mode: hold the scorer (and its warm jit "
+        "caches) in a long-lived loop, read newline-delimited JSON "
+        "alignment requests, coalesce concurrent requests' Seq2s into "
+        "shared fixed-shape superblocks (bucketed continuous batching), "
+        "and stream per-sequence result records back; requests arrive on "
+        "a loopback socket (--port) or the --input pipe/stdin; SIGTERM "
+        "drains: in-flight superblocks finish, queued requests are "
+        "journaled (--journal) and the run exits 75 for a --resume rerun",
+    )
+    p.add_argument(
+        "--port",
+        type=_nonnegative_int,
+        default=None,
+        metavar="PORT",
+        help="with --serve: listen for request connections on "
+        "127.0.0.1:PORT (0 = OS-assigned; the bound port is announced on "
+        "stderr); SEQALIGN_SERVE_PORT supplies the value when this flag "
+        "is absent; without a port the server reads requests from "
+        "--input/stdin and exits when the pipe drains",
     )
     p.add_argument(
         "--check",
@@ -561,59 +584,9 @@ def _run_streaming(
                     dist.broadcast_chunk(None, failed=True)
                 raise
 
-        def _chunk_verify(codes_sub):
-            """Oracle re-verification closure for the first degraded chunk
-            (None when --degrade is off: run_degrading skips the check)."""
-            if not deg.enabled:
-                return None
-            return lambda rows: verify_rows_against_oracle(
-                header.seq1_codes, codes_sub, header.weights, rows
-            )
-
-        def _dispatch(codes_sub, budget):
-            """Async-dispatch a (journal-reduced) chunk under the shared
-            budget; on budget exhaustion with --degrade, fall down the
-            backend chain with a synchronous rescore — MaterialisedRows
-            keeps the promise contract for _finish."""
-            return run_degrading(
-                policy,
-                deg,
-                lambda: deg.scorer.score_codes_async(
-                    header.seq1_codes, codes_sub, header.weights
-                ),
-                lambda sc: sc.score_codes(
-                    header.seq1_codes, codes_sub, header.weights
-                ),
-                "chunk dispatch",
-                budget=budget,
-                verify=_chunk_verify(codes_sub),
-                wrap=MaterialisedRows,
-            )
-
-        def _materialise(promise, codes_sub, budget):
-            """Materialise under the chunk's shared budget (first attempt
-            forces the promise, retries rescore synchronously), degrading
-            past exhaustion like _dispatch."""
-            first = [promise]
-
-            def attempt():
-                if first:
-                    return first.pop().result()
-                return deg.scorer.score_codes(
-                    header.seq1_codes, codes_sub, header.weights
-                )
-
-            return run_degrading(
-                policy,
-                deg,
-                attempt,
-                lambda sc: sc.score_codes(
-                    header.seq1_codes, codes_sub, header.weights
-                ),
-                "chunk scoring",
-                budget=budget,
-                verify=_chunk_verify(codes_sub),
-            )
+        # Dispatch/materialise (shared budget, --degrade chain, oracle
+        # re-verification) live in io.pipeline, shared with --serve.
+        pipe = ChunkPipeline(policy, deg)
 
         def _submit(start, codes):
             """Dispatch a chunk; returns (promise, start, codes, pend, rows,
@@ -628,7 +601,9 @@ def _run_streaming(
                     # Workers must see the identical chunk before the
                     # sharded dispatch's collectives.
                     dist.broadcast_chunk(codes)
-                promise = _dispatch(codes, budget)
+                promise = pipe.dispatch(
+                    header.seq1_codes, codes, header.weights, budget
+                )
                 return (promise, start, codes, None, None, None, budget)
             hashes = [seq_hash(c) for c in codes]
             pend = []
@@ -652,14 +627,21 @@ def _run_streaming(
                 # lockstep (they skip scoring an empty chunk, as here).
                 dist.broadcast_chunk([codes[j] for j in pend])
             if pend:
-                promise = _dispatch([codes[j] for j in pend], budget)
+                promise = pipe.dispatch(
+                    header.seq1_codes,
+                    [codes[j] for j in pend],
+                    header.weights,
+                    budget,
+                )
             return (promise, start, codes, pend, rows, hashes, budget)
 
         def _finish(promise, start, codes, pend, rows, hashes, budget):
             res = None
             if promise is not None:
                 sub = codes if pend is None else [codes[j] for j in pend]
-                res = _materialise(promise, sub, budget)
+                res = pipe.materialise(
+                    promise, header.seq1_codes, sub, header.weights, budget
+                )
             if pend is None:
                 out = res
             else:
@@ -695,7 +677,8 @@ def _run_streaming(
                 stack.enter_context(device_trace(args.trace))
                 if journal is not None:
                     stack.enter_context(journal)
-                # In-flight window.  Multi-host: EXACTLY one chunk, the
+                # In-flight window (io.pipeline.PendingWindow, shared
+                # with --serve).  Multi-host: EXACTLY one chunk, the
                 # schedule _run_streaming_worker mirrors collective-for-
                 # collective.  Single-process: a deeper window (default
                 # 4, env-tunable) — on a tunnelled TPU each result fetch
@@ -706,14 +689,12 @@ def _run_streaming(
                 # dispatch, and the window gives the copies time to land
                 # before _finish needs them.  Host memory stays bounded:
                 # window+1 chunks of codes plus the output lines.
-                import collections
-
-                window = (
+                window = PendingWindow(
                     1
                     if multi
-                    else max(1, env_int("TPU_SEQALIGN_STREAM_DEPTH", 4))
+                    else max(1, env_int("TPU_SEQALIGN_STREAM_DEPTH", 4)),
+                    _finish,
                 )
-                pendings = collections.deque()
                 end_sent = False
                 drained_at = None
                 for start, codes in header.iter_chunks(args.stream):
@@ -723,20 +704,7 @@ def _run_streaming(
                         # journals) normally, then the run exits 75.
                         drained_at = start
                         break
-                    cur = _submit(start, codes)
-                    if cur[0] is not None:
-                        try:
-                            cur[0].prefetch()
-                        except Exception:
-                            # Prefetch is purely a latency optimisation:
-                            # a device->host copy that cannot start here
-                            # resurfaces at result(), inside the chunk's
-                            # shared retry budget, instead of killing the
-                            # pipeline from an advisory call.
-                            pass
-                    pendings.append(cur)
-                    if len(pendings) > window:
-                        _finish(*pendings.popleft())
+                    window.push(*_submit(start, codes))
                 if multi:
                     # End sentinel BEFORE the final materialise: the
                     # pipelined worker mirrors this exactly (it learns
@@ -745,8 +713,7 @@ def _run_streaming(
                     # identical on every host — see _run_streaming_worker.
                     dist.broadcast_chunk(None, end=True)
                     end_sent = True
-                while pendings:
-                    _finish(*pendings.popleft())
+                window.flush()
                 if drained_at is not None:
                     # Drained: in-flight chunks are journalled (fsync'd on
                     # append) but NOTHING goes to stdout — the fail-stop
@@ -819,6 +786,23 @@ def run(argv: list[str] | None = None) -> int:
          "desynchronises the collective schedules"),
     )):
         return EX_USAGE
+    if args.serve and _reject_combos("--serve", (
+        ("--stream", args.stream is not None, "the serve loop IS the "
+         "streaming pipeline; chunking is driven by the request queue, "
+         "not a flag"),
+        ("--selfcheck", args.selfcheck, "selfcheck re-verifies a "
+         "fully-materialised batch; a server has no final batch"),
+        ("--distributed", args.distributed, "the serving plane is "
+         "single-process; shard the scorer with --mesh instead"),
+    )):
+        return EX_USAGE
+    if args.port is not None and not args.serve:
+        print(
+            "mpi_openmp_cuda_tpu: error: --port requires --serve (the "
+            "port is where the serving loop listens)",
+            file=sys.stderr,
+        )
+        return EX_USAGE
     if args.resume and not args.journal:
         print(
             "mpi_openmp_cuda_tpu: error: --resume requires --journal PATH "
@@ -881,6 +865,26 @@ def run(argv: list[str] | None = None) -> int:
         # finally below so library callers never inherit our handlers.
         _drain = drain_guard()
         _drain.__enter__()
+        if args.serve:
+            if args.journal:
+                _check_resume(args)
+
+            def _imp_serve():
+                from ..serve import loop as serve_loop
+
+                return serve_loop
+
+            serve_mod = _feature_import("--serve serving loop", _imp_serve)
+            with timer.phase("setup"):
+                # The serving loop's whole value is this scorer living
+                # across requests: its jit caches stay warm for every
+                # superblock shape seen so far.
+                deg = _make_degrader(args, _make_scorer(args, False))
+            obs_gauge("backend", deg.scorer.backend)
+            rc = serve_mod.run_serve(
+                args, timer, policy, deg, out_stream=out_stream
+            )
+            return rc
         coordinator = True
         dist = None
         if args.distributed:

@@ -1,0 +1,117 @@
+"""Shared superblock submit/finish machinery (stream CLI + serve loop).
+
+Factored out of the ``--stream`` closure nest in :mod:`.cli` so the
+batch-streaming path and the serving plane drive ONE implementation of
+the dispatch/materialise contract instead of a copy:
+
+* :class:`ChunkPipeline` — async-dispatch and materialise one
+  shape-uniform chunk under a SHARED retry budget, with the
+  ``--degrade`` backend chain applied at both stages and the oracle
+  re-verification hook on the first degraded result.  All scoring goes
+  through ``degrader.scorer`` *at call time*: a mid-stream degradation
+  replaces the backend for every later chunk too.
+* :class:`PendingWindow` — the bounded in-flight window: each pushed
+  promise's device→host copy is prefetched at dispatch, the oldest
+  entry is finished once the window overflows, and ``flush()`` drains
+  the rest.  On a tunnelled TPU each result fetch costs a ~0.1 s link
+  round trip; the window gives the prefetched copies time to land
+  before ``finish`` needs them (measured 6.3x over batch mode with a
+  window of one, r5).
+"""
+
+from __future__ import annotations
+
+import collections
+
+from ..resilience.degrade import (
+    MaterialisedRows,
+    run_degrading,
+    verify_rows_against_oracle,
+)
+
+
+class ChunkPipeline:
+    """One run's dispatch/materialise pair over a policy + degrader."""
+
+    def __init__(self, policy, degrader):
+        self.policy = policy
+        self.degrader = degrader
+
+    def _verify(self, seq1_codes, codes, weights):
+        """Oracle re-verification closure for the first degraded chunk
+        (None when --degrade is off: run_degrading skips the check)."""
+        if not self.degrader.enabled:
+            return None
+        return lambda rows: verify_rows_against_oracle(
+            seq1_codes, codes, weights, rows
+        )
+
+    def dispatch(self, seq1_codes, codes, weights, budget):
+        """Async-dispatch a chunk under the shared budget; on budget
+        exhaustion with --degrade, fall down the backend chain with a
+        synchronous rescore — MaterialisedRows keeps the promise
+        contract for :meth:`materialise`."""
+        deg = self.degrader
+        return run_degrading(
+            self.policy,
+            deg,
+            lambda: deg.scorer.score_codes_async(seq1_codes, codes, weights),
+            lambda sc: sc.score_codes(seq1_codes, codes, weights),
+            "chunk dispatch",
+            budget=budget,
+            verify=self._verify(seq1_codes, codes, weights),
+            wrap=MaterialisedRows,
+        )
+
+    def materialise(self, promise, seq1_codes, codes, weights, budget):
+        """Materialise under the chunk's shared budget (first attempt
+        forces the promise, retries rescore synchronously), degrading
+        past exhaustion like :meth:`dispatch`."""
+        deg = self.degrader
+        first = [promise]
+
+        def attempt():
+            if first:
+                return first.pop().result()
+            return deg.scorer.score_codes(seq1_codes, codes, weights)
+
+        return run_degrading(
+            self.policy,
+            deg,
+            attempt,
+            lambda sc: sc.score_codes(seq1_codes, codes, weights),
+            "chunk scoring",
+            budget=budget,
+            verify=self._verify(seq1_codes, codes, weights),
+        )
+
+
+class PendingWindow:
+    """Bounded in-flight promises; ``finish`` is called with exactly the
+    tuple that was pushed, oldest first."""
+
+    def __init__(self, depth: int, finish):
+        self.depth = max(1, int(depth))
+        self._finish = finish
+        self._pending = collections.deque()
+
+    def __len__(self) -> int:
+        return len(self._pending)
+
+    def push(self, promise, *rest) -> None:
+        if promise is not None:
+            try:
+                promise.prefetch()
+            except Exception:
+                # Prefetch is purely a latency optimisation: a
+                # device->host copy that cannot start here resurfaces at
+                # result(), inside the chunk's shared retry budget,
+                # instead of killing the pipeline from an advisory call.
+                pass
+        self._pending.append((promise, *rest))
+        if len(self._pending) > self.depth:
+            self._finish(*self._pending.popleft())
+
+    def flush(self) -> None:
+        while self._pending:
+            self._finish(*self._pending.popleft())

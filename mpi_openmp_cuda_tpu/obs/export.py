@@ -68,10 +68,15 @@ def heartbeat_line(snapshot: dict) -> str:
     g = snapshot.get("gauges", {})
     total = g.get("chunks_total", "?")
     degraded = "yes" if c.get("degrade_transitions") else "no"
-    return (
+    line = (
         f"[obs] chunk {c.get('chunks_dispatched', 0)}/{total} "
         f"retries={c.get('retry_attempts', 0)} degraded={degraded}"
     )
+    if "queue_depth" in g:
+        # Serve mode only (the gauge exists only there): the batch-mode
+        # heartbeat golden stays byte-identical.
+        line += f" queue={g['queue_depth']}"
+    return line
 
 
 def heartbeat_callback(log=None):

@@ -43,6 +43,27 @@ _EVENT_COUNTERS = {
 }
 
 
+class Histogram(dict):
+    """One count/sum/min/max summary, generalised out of the registry so
+    any caller (serve latency, backoff delays) shares the exact shape
+    :func:`validate_report` checks.  Subclassing ``dict`` keeps snapshots
+    and report serialisation plain-JSON for free."""
+
+    __slots__ = ()
+
+    def observe(self, value: float) -> None:
+        if not self:
+            self["count"] = 1
+            self["sum"] = value
+            self["min"] = value
+            self["max"] = value
+            return
+        self["count"] += 1
+        self["sum"] += value
+        self["min"] = min(self["min"], value)
+        self["max"] = max(self["max"], value)
+
+
 class MetricsRegistry:
     """One run's counters/gauges/histograms behind an injectable clock.
 
@@ -57,7 +78,7 @@ class MetricsRegistry:
         self._start = clock()
         self.counters: dict[str, int | float] = {}
         self.gauges: dict[str, int | float | str] = {}
-        self.histograms: dict[str, dict[str, float]] = {}
+        self.histograms: dict[str, Histogram] = {}
         # Per-host snapshots gathered by the coordinator under
         # --distributed (obs/export.py): process id -> snapshot dict.
         self.fleet: dict[str, dict] = {}
@@ -71,14 +92,8 @@ class MetricsRegistry:
     def observe(self, name: str, value: float) -> None:
         h = self.histograms.get(name)
         if h is None:
-            self.histograms[name] = {
-                "count": 1, "sum": value, "min": value, "max": value,
-            }
-            return
-        h["count"] += 1
-        h["sum"] += value
-        h["min"] = min(h["min"], value)
-        h["max"] = max(h["max"], value)
+            h = self.histograms[name] = Histogram()
+        h.observe(value)
 
     def uptime_s(self) -> float:
         return self._clock() - self._start
@@ -103,6 +118,20 @@ class MetricsRegistry:
             self.inc("beacon_misses")
         elif event == "rescue.orphans":
             self.inc("rescued_sequences", int(fields.get("count", 0)))
+        elif event == "serve.request.admitted":
+            self.inc("serve_requests")
+            self.gauge("queue_depth", int(fields.get("depth", 0)))
+        elif event == "serve.request.rejected":
+            self.inc("serve_rejections")
+        elif event == "serve.request.done":
+            self.inc("serve_completed")
+            self.observe(
+                "request_latency_s", float(fields.get("latency_s", 0.0))
+            )
+        elif event == "serve.batch.dispatch":
+            self.inc("serve_batches")
+            self.gauge("batch_fill_ratio", float(fields.get("fill", 0.0)))
+            self.gauge("queue_depth", int(fields.get("depth", 0)))
         else:
             # Forward-compatible: an unmapped event still leaves a trace.
             self.inc(f"events.{event}")

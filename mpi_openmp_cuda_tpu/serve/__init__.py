@@ -1,0 +1,23 @@
+"""Serving plane: a persistent alignment server with bucketed
+continuous batching (ROADMAP Open item 1; docs/ARCHITECTURE.md §12).
+
+The reference is a one-shot stdin→stdout batch binary; this package
+turns the PR-1/4/5 substrate (retry policy, SIGTERM drain with
+resumable exit 75, heartbeats, run-report metrics) into SLO machinery:
+
+* :mod:`.clock` — the injectable serve clock, the ONE legal home for
+  blocking waits under ``serve/`` (seqlint SEQ007);
+* :mod:`.queue` — deterministic admission control over raw request
+  dicts (SEQ005-clean: no wall-clock reads, decisions are depth-based);
+* :mod:`.session` — per-request lifecycle: typed validation, ordered
+  result emission, done/error records, the serve journal;
+* :mod:`.batcher` — continuous batching: Seq2 rows from CONCURRENT
+  requests coalesce into shared fixed-shape superblocks on the existing
+  length-bucket schedule, tagged for demux;
+* :mod:`.loop` — the serve loop itself: warm jit caches across
+  requests, dispatch through the unchanged ``AlignmentScorer`` via the
+  shared :mod:`..io.pipeline`, drain → journal → exit 75.
+
+Imports stay lazy at the CLI boundary (``--serve`` goes through
+``_feature_import``), so batch runs never pay for the server.
+"""

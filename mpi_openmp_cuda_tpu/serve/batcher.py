@@ -1,0 +1,96 @@
+"""Bucketed continuous batching: concurrent requests → shared superblocks.
+
+The batch CLI pads each problem to its own bucket shapes; a server that
+did that per request would pay one (mostly-padding) dispatch per client.
+Here Seq2 rows from EVERY session popped in one tick are pooled:
+
+1. group by *problem key* ``(weights, seq1)`` — rows are only
+   co-scorable when they share the scorer's other two inputs;
+2. inside a group, run the existing length-bucket planner
+   (:func:`..ops.dispatch.plan_buckets`, ``packable=False`` /
+   ``min_rows=1``: no straggler merging — a merged row would change its
+   L2P and with it the compiled shape);
+3. chop each bucket into :class:`SuperBlock`\\ s of exactly
+   ``rows_per_block`` rows, padding the tail block with throwaway rows
+   of the SAME bucket length.
+
+Step 3 is the steady-state-compile guarantee: every block the loop ever
+dispatches has shape ``[rows_per_block, l2p]`` for a bucketed ``l2p``,
+so after the first block of a given ``(seq1-bucket, l2p)`` the jit cache
+is warm and ``make serve-smoke``'s recompile gate (PR-3 detector) holds
+at zero.  Pad rows are scored (wasted lanes, counted by
+``fill_ratio``) and dropped at demux via their ``None`` tag.
+
+Each real row's tag is ``(session, local_index)``: results demux back
+to the right client in the right per-request order no matter how
+requests interleaved inside the block.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from ..ops.dispatch import plan_buckets
+from ..utils.constants import BUF_SIZE_SEQ2
+
+#: Rows per dispatched superblock (SEQALIGN_SERVE_BLOCK_ROWS overrides;
+#: power of two keeps choose_chunk's pow2 flooring exact).
+DEFAULT_BLOCK_ROWS = 64
+
+
+@dataclasses.dataclass
+class SuperBlock:
+    """One fixed-shape dispatch unit: the shared problem key, the padded
+    row list, and the demux tags (``None`` marks a pad row)."""
+
+    weights: list[int]
+    seq1_codes: np.ndarray
+    codes: list[np.ndarray]
+    tags: list[tuple | None]
+    real_rows: int
+
+    @property
+    def fill_ratio(self) -> float:
+        return self.real_rows / max(1, len(self.codes))
+
+
+def plan_blocks(sessions, rows_per_block: int) -> list[SuperBlock]:
+    """Plan the tick's superblocks from every popped session's rows."""
+    if rows_per_block < 1:
+        raise ValueError(
+            f"rows_per_block must be >= 1, got {rows_per_block}"
+        )
+    groups: dict[tuple, list[tuple]] = {}
+    for sess in sessions:
+        key = (tuple(int(w) for w in sess.weights), sess.seq1)
+        rows = groups.setdefault(key, [])
+        for j, codes in enumerate(sess.seq2_codes):
+            rows.append((sess, j, codes))
+    blocks: list[SuperBlock] = []
+    for (weights, _seq1), rows in groups.items():
+        seq1_codes = rows[0][0].seq1_codes
+        buckets = plan_buckets(
+            [c.size for (_, _, c) in rows], packable=False, min_rows=1
+        )
+        for l2p in sorted(buckets):
+            members = [rows[i] for i in sorted(buckets[l2p])]
+            # Pad length stays inside the reference buffer cap while
+            # keeping the same L2P bucket (round_up(2000,128) == 2048),
+            # so the dispatcher sees ONE uniform group per block.
+            pad = np.ones(min(int(l2p), BUF_SIZE_SEQ2), dtype=np.int8)
+            for off in range(0, len(members), rows_per_block):
+                chunk = members[off : off + rows_per_block]
+                n_pad = rows_per_block - len(chunk)
+                blocks.append(
+                    SuperBlock(
+                        weights=list(weights),
+                        seq1_codes=seq1_codes,
+                        codes=[c for (_, _, c) in chunk] + [pad] * n_pad,
+                        tags=[(s, j) for (s, j, _) in chunk]
+                        + [None] * n_pad,
+                        real_rows=len(chunk),
+                    )
+                )
+    return blocks

@@ -1,0 +1,43 @@
+"""The injectable serve-plane clock — the ONE home for blocking waits.
+
+Everything under ``serve/`` that needs "now" or "wait until" goes
+through a :class:`ServeClock` instance handed in at construction, for
+two reasons:
+
+* **Determinism**: tests inject a fake clock whose ``block_until``
+  returns immediately, so admission/coalescing behaviour is exercised
+  without real sleeps (the same stance as the metrics registry's
+  injectable clock and the retry policy's seeded backoff).
+* **Drain responsiveness**: every wait is a *bounded, condition-based*
+  wait — a bare ``time.sleep`` or raw ``Condition.wait`` sprinkled
+  through the loop would add un-interruptible latency between a SIGTERM
+  and the drain's exit 75.
+
+seqlint SEQ007 enforces this: ``time.sleep`` and ``.wait``/
+``.wait_for`` calls anywhere else under ``serve/`` are violations;
+this module is the single exemption.
+"""
+
+from __future__ import annotations
+
+import time
+
+
+class ServeClock:
+    """Monotonic now + bounded condition wait, both injectable.
+
+    ``block_until`` must be called with ``cond``'s lock held (the
+    ``threading.Condition.wait_for`` contract); it returns the
+    predicate's final value so callers can distinguish "woke because
+    true" from "woke on timeout".
+    """
+
+    def __init__(self, now=time.monotonic):
+        self._now = now
+
+    def now(self) -> float:
+        return self._now()
+
+    def block_until(self, cond, predicate, timeout_s: float) -> bool:
+        """Wait on ``cond`` until ``predicate()`` or ``timeout_s``."""
+        return cond.wait_for(predicate, timeout=timeout_s)

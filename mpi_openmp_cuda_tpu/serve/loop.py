@@ -1,0 +1,358 @@
+"""The serve loop: warm caches, continuous batching, drain → 75.
+
+One :class:`ServeLoop` owns the run: the admission queue, the pending
+window, and the jit caches (held warm simply by the process living —
+the scorer and its compiled shapes persist across requests, which is
+the whole point of serving versus one-shot batch runs).
+
+A **tick** is the unit of work: pop whatever coalesced in the gather
+window, validate each raw request into a :class:`.session.Session`
+(typed error record on failure — the loop outlives bad input), plan
+the pooled rows into fixed-shape superblocks, dispatch every block
+through the shared :class:`..io.pipeline.ChunkPipeline` (async, windowed,
+prefetched), then flush and demux rows back to sessions by tag.  Every
+dispatch rides the SAME retry/degrade/watchdog machinery as the batch
+CLI — a deadline-expired superblock is retried, not wedged.
+
+**Drain**: the PR-4 guard's SIGTERM flag is checked at tick boundaries
+and inside the queue wait (bounded, via the injectable clock — worst
+case one tick of latency).  On drain: admission closes, in-flight
+superblocks finish and their lines stream out, queued-but-unstarted
+requests are journaled (whole-file atomic serve journal) and notified
+``{"drained": true}``, and :class:`DrainInterrupt` surfaces → the CLI's
+exit 75.  ``--serve --journal P --resume`` re-admits the journaled
+requests before reading any new input.
+
+**Steady-state compiles**: the PR-3 recompile detector baselines after
+the first block finishes; everything after must hit warm caches.  The
+delta is exported as the ``serve_steady_compiles`` gauge —
+``make serve-smoke`` gates on it being 0.
+
+Threading: socket reader threads only ``json.loads`` + enqueue (see
+:mod:`.queue`); parsing, scoring, span recording, and ALL journal/metric
+mutation happen on the main loop thread.
+"""
+
+from __future__ import annotations
+
+import socket as socketlib
+import sys
+import threading
+
+from ..analysis.recompile import compile_count
+from ..io.pipeline import PendingWindow
+from ..obs.events import log_line, publish
+from ..obs.metrics import gauge as obs_gauge
+from ..obs.spans import span
+from ..resilience.drain import DrainInterrupt, drain_requested
+from ..utils.platform import env_float, env_int
+from .batcher import DEFAULT_BLOCK_ROWS, plan_blocks
+from .clock import ServeClock
+from .queue import ADMIT_CLOSED, ADMIT_FULL, RequestQueue
+from .session import (
+    RequestError,
+    Responder,
+    build_session,
+    journal_drained,
+    load_drained,
+    parse_raw,
+)
+
+#: Upper bound on one queue wait: the drain flag is re-checked at least
+#: this often even if no request ever arrives.
+_TICK_S = 0.25
+
+
+class ServeLoop:
+    """The serving run's state: queue, window, pipeline, drain plumbing."""
+
+    def __init__(
+        self,
+        pipeline,
+        policy,
+        *,
+        clock=None,
+        journal_path: str | None = None,
+        max_depth: int | None = None,
+        window_s: float | None = None,
+        rows_per_block: int | None = None,
+        max_pop: int | None = None,
+    ):
+        self.pipeline = pipeline
+        self.policy = policy
+        self.clock = clock or ServeClock()
+        self.journal_path = journal_path
+        self.window_s = (
+            window_s
+            if window_s is not None
+            else env_float("SEQALIGN_SERVE_WINDOW_S", 0.05)
+        )
+        self.rows_per_block = (
+            rows_per_block
+            if rows_per_block is not None
+            else env_int("SEQALIGN_SERVE_BLOCK_ROWS", DEFAULT_BLOCK_ROWS)
+        )
+        self.max_pop = (
+            max_pop
+            if max_pop is not None
+            else env_int("SEQALIGN_SERVE_MAX_POP", 0)
+        )
+        self.queue = RequestQueue(
+            max_depth
+            if max_depth is not None
+            else env_int("SEQALIGN_SERVE_MAX_QUEUE", 256),
+            self.clock,
+        )
+        self.window = PendingWindow(
+            max(1, env_int("TPU_SEQALIGN_STREAM_DEPTH", 4)), self._finish
+        )
+        self._steady_base: int | None = None
+
+    # -- ingest (reader threads and the main-thread stdin loop) -----------
+
+    def ingest(self, line: str, responder) -> None:
+        """One wire line → parse-to-dict → admission; error record on a
+        line that is not a JSON object, backpressure/drain verdicts
+        relayed to the client."""
+        line = line.strip()
+        if not line:
+            return
+        try:
+            raw = parse_raw(line)
+        except RequestError as e:
+            publish(
+                "serve.request.rejected",
+                reason="malformed",
+                depth=self.queue.depth(),
+            )
+            responder.send({"id": None, "error": str(e)})
+            return
+        verdict = self.queue.submit(raw, responder)
+        if verdict == ADMIT_FULL:
+            responder.send(
+                {
+                    "id": raw.get("id"),
+                    "error": f"queue full ({self.queue.max_depth} requests "
+                    "queued); resubmit later",
+                }
+            )
+        elif verdict == ADMIT_CLOSED:
+            responder.send(
+                {
+                    "id": raw.get("id"),
+                    "error": "server is draining; resubmit elsewhere",
+                }
+            )
+
+    # -- the scoring side --------------------------------------------------
+
+    def _dispatch(self, block) -> None:
+        """Async-dispatch one superblock under its own shared retry
+        budget (the per-superblock watchdog deadline rides inside the
+        scorer, unchanged from batch mode)."""
+        budget = self.policy.new_budget()
+        promise = self.pipeline.dispatch(
+            block.seq1_codes, block.codes, block.weights, budget
+        )
+        publish(
+            "serve.batch.dispatch",
+            rows=block.real_rows,
+            fill=round(block.fill_ratio, 4),
+            depth=self.queue.depth(),
+        )
+        self.window.push(promise, block, budget)
+
+    def _finish(self, promise, block, budget) -> None:
+        """Materialise one superblock and demux rows to sessions by tag
+        (pad rows carry a ``None`` tag and are dropped)."""
+        rows = self.pipeline.materialise(
+            promise, block.seq1_codes, block.codes, block.weights, budget
+        )
+        with span("serve.request.emit"):
+            for row, tag in zip(rows, block.tags):
+                if tag is not None:
+                    sess, j = tag
+                    sess.fill(j, row)
+        if self._steady_base is None:
+            # Baseline AFTER the first block: its compiles are the warmup;
+            # everything later must be cache hits (ROADMAP Open item 5).
+            self._steady_base = compile_count()
+
+    def tick(self) -> bool:
+        """One loop iteration; returns False once idle with no sources
+        left (the stdin/file mode's termination condition)."""
+        if drain_requested():
+            self._drain(())
+        items = self.queue.pop_ready(
+            _TICK_S, self.window_s, self.max_pop, wake=drain_requested
+        )
+        if drain_requested():
+            # Popped-but-unstarted requests at the drain boundary are
+            # "queued" for journal purposes: nothing was dispatched yet.
+            self._drain(items)
+        sessions = []
+        for item in items:
+            try:
+                with span("serve.request.parse"):
+                    sess = build_session(item, self.clock)
+            except RequestError as e:
+                publish(
+                    "serve.request.rejected",
+                    reason="invalid",
+                    depth=self.queue.depth(),
+                )
+                item.responder.send(
+                    {"id": item.raw.get("id"), "error": str(e)}
+                )
+                continue
+            sessions.append(sess)
+        if sessions:
+            for block in plan_blocks(sessions, self.rows_per_block):
+                self._dispatch(block)
+            self.window.flush()
+            for sess in sessions:
+                # Emits the done record for empty (n == 0) requests; a
+                # no-op for sessions already completed through demux.
+                sess.advance()
+        obs_gauge("queue_depth", self.queue.depth())
+        return bool(items) or not self.queue.idle()
+
+    # -- drain -------------------------------------------------------------
+
+    def _drain(self, popped) -> None:
+        """Close admission, finish in-flight work, journal the leftovers,
+        and surface the resumable preemption (CLI maps it to exit 75)."""
+        self.queue.close()
+        self.window.flush()
+        leftovers = list(popped) + self.queue.drain_pending()
+        for it in leftovers:
+            it.responder.send({"id": it.raw.get("id"), "drained": True})
+        n = len(leftovers)
+        if self.journal_path is not None:
+            journal_drained(self.journal_path, [it.raw for it in leftovers])
+            raise DrainInterrupt(
+                f"serve loop preempted; {n} queued request(s) journaled — "
+                f"rerun with --serve --journal {self.journal_path} "
+                "--resume to finish them"
+            )
+        raise DrainInterrupt(
+            f"serve loop preempted; no --journal, so {n} queued "
+            "request(s) are dropped (clients were sent drained notices)"
+        )
+
+    def record_steady_gauge(self) -> None:
+        """Export the steady-state recompile delta (0 until any block
+        has finished — an idle server has nothing to be cold about)."""
+        base = self._steady_base
+        obs_gauge(
+            "serve_steady_compiles",
+            0 if base is None else compile_count() - base,
+        )
+
+
+# -- transports --------------------------------------------------------------
+
+
+def _serve_connection(loop: ServeLoop, conn) -> None:
+    """One client connection's reader thread: lines in, queue in; the
+    responder (writer side) is driven from the main loop thread.  The
+    connection stays open after client EOF so pending results flow; a
+    client that disconnects hard just deadens its responder."""
+    rfile = conn.makefile("r", encoding="utf-8", newline="\n")
+    wfile = conn.makefile("w", encoding="utf-8", newline="\n")
+    responder = Responder(wfile)
+    try:
+        for line in rfile:
+            loop.ingest(line, responder)
+    except (OSError, ValueError):
+        pass
+
+
+def _accept_loop(loop: ServeLoop, sock) -> None:
+    """The listener thread: accept → spawn a daemon reader per client."""
+    while True:
+        try:
+            conn, _addr = sock.accept()
+        except OSError:
+            return  # listener closed: the run is over
+        threading.Thread(
+            target=_serve_connection, args=(loop, conn), daemon=True
+        ).start()
+
+
+def run_serve(args, timer, policy, deg, out_stream=None) -> int:
+    """CLI entry for ``--serve`` (called with the observability plane,
+    faults, watchdog, and drain guard already armed by ``run()``).
+
+    Sources: ``--port`` opens a loopback ndjson socket (port 0 → the
+    OS assigns; the bound port is announced on stderr).  Without a port
+    — or with an explicit ``--input`` — requests are read line-by-line
+    from the file/stdin on the main thread and the loop runs until the
+    queue drains, which makes pipe mode fully deterministic for tests.
+    """
+    from ..io.pipeline import ChunkPipeline
+    from ..io.parse import open_input
+
+    loop = ServeLoop(
+        ChunkPipeline(policy, deg), policy, journal_path=args.journal
+    )
+    out_responder = Responder(out_stream or sys.stdout)
+    if args.journal:
+        resumed = load_drained(args.journal)
+        if resumed:
+            log_line(
+                f"mpi_openmp_cuda_tpu: serve journal {args.journal!r}: "
+                f"re-admitting {len(resumed)} drained request(s)"
+            )
+        for raw in resumed:
+            loop.ingest(json_dumps_line(raw), out_responder)
+
+    port = args.port if args.port is not None else env_int("SEQALIGN_SERVE_PORT")
+    persistent = port is not None
+    sock = None
+    try:
+        if persistent:
+            sock = socketlib.create_server(("127.0.0.1", int(port)))
+            bound = sock.getsockname()[1]
+            log_line(f"mpi_openmp_cuda_tpu: serving on 127.0.0.1:{bound}")
+            loop.queue.open_source()
+            threading.Thread(
+                target=_accept_loop, args=(loop, sock), daemon=True
+            ).start()
+        with timer.phase("serve"):
+            if not persistent or args.input is not None:
+                loop.queue.open_source()
+                try:
+                    with open_input(args.input) as stream:
+                        for line in stream:
+                            loop.ingest(line, out_responder)
+                            if drain_requested():
+                                break
+                finally:
+                    loop.queue.close_source()
+            while True:
+                alive = loop.tick()
+                if not persistent and not alive:
+                    break
+        if args.journal:
+            # Clean completion: nothing pending — rewrite the journal
+            # empty so a later --resume re-admits nothing.
+            journal_drained(args.journal, [])
+        timer.report()
+        return 0
+    finally:
+        loop.record_steady_gauge()
+        if sock is not None:
+            try:
+                sock.close()
+            except OSError:  # pragma: no cover - teardown best-effort
+                pass
+
+
+def json_dumps_line(raw: dict) -> str:
+    """Round-trip a journaled raw request back through the normal ingest
+    path (one line of JSON), so resume and live traffic share every
+    validation/admission branch."""
+    import json
+
+    return json.dumps(raw)

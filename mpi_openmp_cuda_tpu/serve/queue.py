@@ -1,0 +1,176 @@
+"""Request queue with deterministic admission control.
+
+Reader threads (socket connections, the stdin ingest) call
+:meth:`RequestQueue.submit`; the main serve loop calls
+:meth:`RequestQueue.pop_ready`.  Admission is **deterministic**: the
+only decision input is the current queue depth against ``max_depth`` —
+never a clock, never a rate estimate — so the same submission sequence
+always admits and rejects identically (this file is on seqlint
+SEQ005's deterministic-path list, like ``resilience/``).  The admit
+*timestamp* is recorded (for the request-latency histogram) but never
+decides anything.
+
+Requests are held as RAW parsed dicts: full validation (weights range,
+sequence alphabet, buffer caps) happens on the main loop thread in
+:mod:`.session`, where the span recorder lives — reader threads only
+``json.loads`` and enqueue, keeping the single-threaded-spans contract
+of :mod:`..obs.spans`.
+
+``pop_ready`` is the continuous-batching seam: it waits (via the
+injectable :class:`..serve.clock.ServeClock`) for at least one queued
+request, then lingers one *gather window* so a concurrent burst
+coalesces into a single superblock plan instead of one dispatch per
+request.  The window is skipped when every input source has closed —
+nothing more can arrive, so waiting only adds latency.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+
+from ..obs.events import publish
+
+#: Admission verdicts (strings so responders can embed them in errors).
+ADMIT_OK = "ok"
+ADMIT_FULL = "full"
+ADMIT_CLOSED = "closed"
+
+
+@dataclasses.dataclass
+class QueuedRequest:
+    """One admitted raw request awaiting the loop: the unvalidated dict,
+    the responder that owns its result lines, the admit time (histogram
+    input only), and a process-unique sequence number (the default
+    request id)."""
+
+    raw: dict
+    responder: object
+    admitted_t: float
+    seq: int
+
+
+class RequestQueue:
+    """Bounded FIFO of :class:`QueuedRequest` under one condition.
+
+    ``max_depth`` is the backpressure contract: a submit past it is
+    rejected with :data:`ADMIT_FULL` (the client resubmits) instead of
+    growing the queue without bound.  ``close()`` stops admission for
+    the drain; ``drain_pending()`` hands the leftovers to the journal.
+    """
+
+    def __init__(self, max_depth: int, clock):
+        if max_depth < 1:
+            raise ValueError(f"max_depth must be >= 1, got {max_depth}")
+        self.max_depth = int(max_depth)
+        self._clock = clock
+        self._cond = threading.Condition()
+        self._items: list[QueuedRequest] = []
+        self._closed = False
+        self._sources = 0
+        self._seq = 0
+
+    # -- source bookkeeping ------------------------------------------------
+
+    def open_source(self) -> None:
+        """A producer (socket listener, stdin ingest) came up."""
+        with self._cond:
+            self._sources += 1
+
+    def close_source(self) -> None:
+        """A producer finished; with zero sources and an empty queue the
+        loop knows the run is complete (stdin/file mode)."""
+        with self._cond:
+            self._sources = max(0, self._sources - 1)
+            self._cond.notify_all()
+
+    # -- admission ---------------------------------------------------------
+
+    def submit(self, raw: dict, responder) -> str:
+        """Admit one raw request; returns an ADMIT_* verdict."""
+        with self._cond:
+            if self._closed:
+                publish(
+                    "serve.request.rejected",
+                    reason="closed",
+                    depth=len(self._items),
+                )
+                return ADMIT_CLOSED
+            if len(self._items) >= self.max_depth:
+                publish(
+                    "serve.request.rejected",
+                    reason="full",
+                    depth=len(self._items),
+                )
+                return ADMIT_FULL
+            self._seq += 1
+            self._items.append(
+                QueuedRequest(raw, responder, self._clock.now(), self._seq)
+            )
+            publish("serve.request.admitted", depth=len(self._items))
+            self._cond.notify_all()
+            return ADMIT_OK
+
+    def close(self) -> None:
+        """Stop admission (drain); waiters wake immediately."""
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+
+    # -- the loop side -----------------------------------------------------
+
+    def depth(self) -> int:
+        with self._cond:
+            return len(self._items)
+
+    def idle(self) -> bool:
+        """Nothing queued and no producer left to queue more."""
+        with self._cond:
+            return not self._items and self._sources == 0
+
+    def pop_ready(
+        self,
+        timeout_s: float,
+        window_s: float,
+        limit: int = 0,
+        wake=None,
+    ) -> list[QueuedRequest]:
+        """Pop up to ``limit`` requests (0 = all), coalescing a burst.
+
+        Phase 1 waits up to ``timeout_s`` for work (or ``wake()``, the
+        drain flag: the wait is bounded so a signal is noticed within
+        one tick).  Phase 2 lingers ``window_s`` with work in hand while
+        sources are still open, so concurrently-arriving requests land
+        in the SAME pop — that is what turns per-request dispatches into
+        shared superblocks.
+        """
+
+        def wake_up() -> bool:
+            return bool(wake is not None and wake())
+
+        with self._cond:
+            self._clock.block_until(
+                self._cond,
+                lambda: bool(self._items)
+                or self._closed
+                or self._sources == 0
+                or wake_up(),
+                timeout_s,
+            )
+            if self._items and self._sources > 0 and not wake_up():
+                self._clock.block_until(
+                    self._cond,
+                    lambda: self._closed
+                    or wake_up()
+                    or (0 < limit <= len(self._items)),
+                    window_s,
+                )
+            take = len(self._items) if limit <= 0 else min(limit, len(self._items))
+            popped, self._items[:take] = self._items[:take], []
+            return popped
+
+    def drain_pending(self) -> list[QueuedRequest]:
+        """Remove and return everything still queued (drain journaling)."""
+        with self._cond:
+            popped, self._items[:] = list(self._items), []
+            return popped

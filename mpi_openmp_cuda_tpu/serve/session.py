@@ -1,0 +1,284 @@
+"""Per-request lifecycle: validation, ordered emission, the serve journal.
+
+The wire protocol is newline-delimited JSON in both directions (the
+loopback socket and the stdin pipe speak the same records):
+
+request   ``{"id": ..., "weights": [w1,w2,w3,w4], "seq1": "...",
+            "seq2": ["...", ...]}`` — ``id`` optional (defaults to
+            ``req-<seq>`` from the admission counter)
+response  ``{"id": ..., "line": "#j: score: S, n: N, k: K"}`` per
+            sequence (the ``line`` value is byte-identical to the batch
+            CLI's stdout line for the same problem), then
+            ``{"id": ..., "done": true, "n": N}``; malformed input gets
+            ``{"id": ..., "error": "..."}`` and the loop lives on; a
+            drain hands queued-but-unstarted requests
+            ``{"id": ..., "drained": true}`` after journaling them.
+
+Validation runs on the MAIN loop thread (under the ``serve.request
+.parse`` span — the span recorder is single-threaded by construction)
+and reuses the batch parser's header validation verbatim, so a weight
+that the batch CLI would reject is rejected here with the same message.
+A bad request raises :class:`RequestError` → one typed error record,
+never process death (the batch fail-stop stance inverted: the server
+outlives its worst client).
+
+Result rows can land out of order (a request's short and long Seq2s sit
+in different length buckets, so different superblocks finish at
+different times); :class:`Session` buffers and emits the longest
+consecutively-scored prefix, so each client sees its lines in index
+order and their concatenation is byte-identical to batch-mode output.
+
+The **serve journal** is the drain's resume token: a whole-file atomic
+write of the raw request dicts still queued at preemption.  Its format
+line is distinct from the batch/stream journals — the three are
+mutually foreign and refuse each other's files.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+
+import numpy as np
+
+from ..io.parse import _parse_header_tokens
+from ..io.printer import format_result
+from ..models.encoding import encode_normalized
+from ..obs.events import publish
+from ..utils.constants import BUF_SIZE_SEQ1, BUF_SIZE_SEQ2
+
+
+class RequestError(ValueError):
+    """A malformed/invalid request: rejected with a typed error record."""
+
+
+class Responder:
+    """One output stream shared by a request's records, lock-serialised.
+
+    Writes one compact JSON document per line.  A broken client (closed
+    socket, vanished pipe) marks the responder dead and later records
+    are dropped silently — a client that hung up forfeits its results;
+    it must not take the loop (or other clients) down with it.
+    """
+
+    def __init__(self, out):
+        self._out = out
+        self._lock = threading.Lock()
+        self._dead = False
+
+    def send(self, obj: dict) -> None:
+        with self._lock:
+            if self._dead:
+                return
+            try:
+                self._out.write(json.dumps(obj) + "\n")
+                self._out.flush()
+            except (OSError, ValueError):
+                self._dead = True
+
+
+def parse_raw(line: str) -> dict:
+    """Reader-thread half of parsing: bytes → dict, nothing more."""
+    try:
+        raw = json.loads(line)
+    except json.JSONDecodeError as e:
+        raise RequestError(f"malformed request line (not JSON): {e}") from None
+    if not isinstance(raw, dict):
+        raise RequestError(
+            f"request must be a JSON object, got {type(raw).__name__}"
+        )
+    return raw
+
+
+class Session:
+    """One validated in-flight request: its problem, its result rows,
+    and the emit cursor that keeps output in per-request index order."""
+
+    def __init__(
+        self, req_id, weights, seq1, seq1_codes, seq2_codes, responder,
+        admitted_t, clock,
+    ):
+        self.id = req_id
+        self.weights = weights
+        self.seq1 = seq1
+        self.seq1_codes = seq1_codes
+        self.seq2_codes = seq2_codes
+        self.responder = responder
+        self._admitted_t = admitted_t
+        self._clock = clock
+        n = len(seq2_codes)
+        self.rows = np.zeros((n, 3), dtype=np.int64)
+        self._have = [False] * n
+        self._emitted = 0
+        self._done = False
+
+    @property
+    def count(self) -> int:
+        return len(self.seq2_codes)
+
+    def fill(self, j: int, row) -> None:
+        """Record sequence ``j``'s (score, n, k) row and emit whatever
+        prefix became consecutive."""
+        self.rows[j] = row
+        self._have[j] = True
+        self.advance()
+
+    def advance(self) -> None:
+        """Emit the longest consecutively-filled prefix; on completion,
+        emit the done record and publish the latency event."""
+        n = self.count
+        while self._emitted < n and self._have[self._emitted]:
+            j = self._emitted
+            self.responder.send(
+                {
+                    "id": self.id,
+                    "line": format_result(
+                        j,
+                        int(self.rows[j][0]),
+                        int(self.rows[j][1]),
+                        int(self.rows[j][2]),
+                    ),
+                }
+            )
+            self._emitted += 1
+        if self._emitted == n and not self._done:
+            self._done = True
+            self.responder.send({"id": self.id, "done": True, "n": n})
+            publish(
+                "serve.request.done",
+                id=self.id,
+                n=n,
+                latency_s=self._clock.now() - self._admitted_t,
+            )
+
+
+def build_session(item, clock) -> Session:
+    """Validate one queued raw request into a :class:`Session`.
+
+    Reuses the batch parser's header validation (same weight-range
+    messages as stdin input) plus the encoder's alphabet check and the
+    reference buffer caps — the caps must reject HERE, because past this
+    point a cap violation would surface as a fatal ``ValueError`` inside
+    the scorer and kill the loop.
+    """
+    raw = item.raw
+    rid = raw.get("id")
+    rid = f"req-{item.seq}" if rid is None else str(rid)
+    weights = raw.get("weights")
+    if not isinstance(weights, (list, tuple)) or len(weights) != 4:
+        raise RequestError(
+            f"request {rid!r}: 'weights' must be a list of 4 integers"
+        )
+    seq1 = raw.get("seq1")
+    if not isinstance(seq1, str) or not seq1.strip():
+        raise RequestError(
+            f"request {rid!r}: 'seq1' must be a nonempty string"
+        )
+    seq2 = raw.get("seq2", [])
+    if not isinstance(seq2, list) or not all(
+        isinstance(s, str) for s in seq2
+    ):
+        raise RequestError(
+            f"request {rid!r}: 'seq2' must be a list of strings"
+        )
+    try:
+        w, s1, _ = _parse_header_tokens(
+            [str(x) for x in weights] + [seq1, str(len(seq2))]
+        )
+        seq1_codes = encode_normalized(s1)
+        seq2_codes = [encode_normalized(s) for s in seq2]
+    except ValueError as e:
+        raise RequestError(f"request {rid!r}: {e}") from None
+    if seq1_codes.size > BUF_SIZE_SEQ1:
+        raise RequestError(
+            f"request {rid!r}: Seq1 length {seq1_codes.size} exceeds "
+            f"BUF_SIZE_SEQ1={BUF_SIZE_SEQ1}"
+        )
+    for j, c in enumerate(seq2_codes):
+        if c.size == 0:
+            raise RequestError(
+                f"request {rid!r}: Seq2[{j}] is empty (whitespace-"
+                "delimited batch input cannot express an empty sequence; "
+                "drop the entry instead)"
+            )
+        if c.size > BUF_SIZE_SEQ2:
+            raise RequestError(
+                f"request {rid!r}: Seq2[{j}] length {c.size} exceeds "
+                f"BUF_SIZE_SEQ2={BUF_SIZE_SEQ2}"
+            )
+    return Session(
+        rid, w, s1, seq1_codes, seq2_codes, item.responder,
+        item.admitted_t, clock,
+    )
+
+
+# -- the serve journal -------------------------------------------------------
+
+#: Format fingerprint; foreign --journal files (batch/stream journals,
+#: arbitrary JSON) are refused, same stance as utils/journal.py.
+SERVE_JOURNAL_FORMAT = "mpi_openmp_cuda_tpu.serve-journal.v1"
+
+
+def journal_drained(path: str, raw_requests: list[dict]) -> None:
+    """Atomically write the drain leftovers: header line, one
+    ``{"request": ...}`` record per queued raw dict, and a trailing
+    ``{"event": "drain"}`` marker when anything was left.  Whole-file
+    tmp+rename (not append): the leftovers ARE the full resume state,
+    and a preemption mid-write must leave either the old file or the
+    new one, never a torn tail."""
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w", encoding="utf-8") as f:
+        f.write(json.dumps({"format": SERVE_JOURNAL_FORMAT}) + "\n")
+        for raw in raw_requests:
+            f.write(json.dumps({"request": raw}) + "\n")
+        if raw_requests:
+            f.write(json.dumps({"event": "drain"}) + "\n")
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+def load_drained(path: str) -> list[dict]:
+    """Read journaled raw requests back for ``--serve --resume``.
+
+    Missing file → empty (plain ``--journal`` starts fresh; ``--resume``
+    asserts existence at the CLI layer first).  A file that parses but
+    is not a serve journal raises ``ValueError`` (fatal 65): silently
+    rescoring a batch journal's worth of nothing would be worse.  Torn
+    or alien trailing records are skipped, the journal reader's
+    torn-tail tolerance applied here."""
+    if not os.path.exists(path):
+        return []
+    requests: list[dict] = []
+    with open(path, encoding="utf-8") as f:
+        head = f.readline()
+        if not head.strip():
+            return []
+        try:
+            header = json.loads(head)
+        except json.JSONDecodeError as e:
+            raise ValueError(
+                f"journal {path!r} is not a serve journal (unreadable "
+                f"header: {e}); batch/stream/serve journals are mutually "
+                "foreign — pass a fresh --journal path"
+            ) from None
+        if (
+            not isinstance(header, dict)
+            or header.get("format") != SERVE_JOURNAL_FORMAT
+        ):
+            raise ValueError(
+                f"journal {path!r} is not a serve journal; batch/stream/"
+                "serve journals are mutually foreign — pass a fresh "
+                "--journal path"
+            )
+        for line in f:
+            if not line.strip():
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue  # torn tail: everything before it is intact
+            if isinstance(rec, dict) and isinstance(rec.get("request"), dict):
+                requests.append(rec["request"])
+    return requests

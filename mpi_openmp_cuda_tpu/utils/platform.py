@@ -134,6 +134,42 @@ ENV_VARS: tuple[EnvVar, ...] = (
         "--heartbeat; implies --metrics)",
     ),
     EnvVar(
+        "SEQALIGN_SERVE_PORT",
+        "int",
+        None,
+        "loopback port for the --serve request socket (same as --port; "
+        "0 = OS-assigned, announced on stderr)",
+    ),
+    EnvVar(
+        "SEQALIGN_SERVE_MAX_QUEUE",
+        "int",
+        256,
+        "serve admission cap: requests queued past this depth are "
+        "rejected with a 'queue full' error record",
+    ),
+    EnvVar(
+        "SEQALIGN_SERVE_WINDOW_S",
+        "float",
+        0.05,
+        "serve gather window (seconds): after the first queued request "
+        "the loop lingers this long so a concurrent burst coalesces "
+        "into shared superblocks",
+    ),
+    EnvVar(
+        "SEQALIGN_SERVE_BLOCK_ROWS",
+        "int",
+        64,
+        "rows per serve superblock; every dispatch has exactly this row "
+        "count (padded), pinning the compiled shapes",
+    ),
+    EnvVar(
+        "SEQALIGN_SERVE_MAX_POP",
+        "int",
+        0,
+        "max requests popped per serve tick (0 = unlimited); bounds one "
+        "tick's latency under backlog",
+    ),
+    EnvVar(
         "JAX_COORDINATOR_ADDRESS",
         "str",
         None,

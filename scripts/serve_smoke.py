@@ -1,0 +1,190 @@
+"""End-to-end smoke gate for the serving plane (``make serve-smoke``).
+
+Boots ``--serve --port 0`` as a real subprocess, fires N concurrent
+loopback clients that all share one problem key (weights + Seq1), reads
+every client's result records, SIGTERMs the server, then gates what the
+serving plane promises:
+
+* every client got its ``done`` record with per-sequence lines;
+* the requests COALESCED: ``counters.chunks_dispatched`` strictly below
+  the request count (shared superblocks, not one dispatch per request);
+* ``gauges.serve_steady_compiles`` == 0 — after the first superblock the
+  jit caches were warm for every later dispatch (the PR-3 recompile
+  detector's steady-state gate, hard-failed here);
+* SIGTERM produced exit 75 (resumable drain) and the run report still
+  flushed and validates.
+
+Exit 0 on success, 1 with every problem listed on failure — same
+all-problems-at-once reporting style as seqlint and metrics_smoke.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import signal
+import socket
+import subprocess
+import sys
+import tempfile
+import threading
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from mpi_openmp_cuda_tpu.obs.metrics import validate_report  # noqa: E402
+
+N_CLIENTS = 6
+WEIGHTS = [1, -3, -5, -2]
+SEQ1 = "ACGTACGTACGTACGT"
+PORT_RE = re.compile(r"serving on 127\.0\.0\.1:(\d+)")
+
+
+def _client(port: int, rid: str, seq2: list[str], results: dict, errors: list):
+    try:
+        with socket.create_connection(("127.0.0.1", port), timeout=60) as conn:
+            req = {"id": rid, "weights": WEIGHTS, "seq1": SEQ1, "seq2": seq2}
+            conn.sendall((json.dumps(req) + "\n").encode())
+            conn.settimeout(120)
+            buf = b""
+            while b'"done"' not in buf:
+                chunk = conn.recv(65536)
+                if not chunk:
+                    break
+                buf += chunk
+        results[rid] = [json.loads(l) for l in buf.decode().splitlines() if l]
+    except Exception as e:
+        errors.append(f"client {rid}: {e}")
+
+
+def main() -> int:
+    out_dir = tempfile.mkdtemp(prefix="serve_smoke_")
+    report_path = os.path.join(out_dir, "run.json")
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    # Widen the gather window so all six "concurrent" clients land in one
+    # pop even on a loaded 1-core box — the coalescing we are gating on.
+    env.setdefault("SEQALIGN_SERVE_WINDOW_S", "0.5")
+    proc = subprocess.Popen(
+        [
+            sys.executable,
+            "-m",
+            "mpi_openmp_cuda_tpu",
+            "--serve",
+            "--port",
+            "0",
+            "--metrics-out",
+            report_path,
+        ],
+        stdin=subprocess.DEVNULL,
+        stdout=subprocess.DEVNULL,
+        stderr=subprocess.PIPE,
+        cwd=REPO,
+        env=env,
+        text=True,
+    )
+    try:
+        port = None
+        stderr_lines: list[str] = []
+        for line in proc.stderr:
+            stderr_lines.append(line)
+            m = PORT_RE.search(line)
+            if m:
+                port = int(m.group(1))
+                break
+        if port is None:
+            print("serve-smoke: FAIL: server never announced its port")
+            sys.stderr.write("".join(stderr_lines))
+            return 1
+        # Keep draining stderr in the background so the server never
+        # blocks on a full pipe.
+        drain = threading.Thread(
+            target=lambda: stderr_lines.extend(proc.stderr), daemon=True
+        )
+        drain.start()
+
+        results: dict[str, list[dict]] = {}
+        errors: list[str] = []
+        threads = []
+        for i in range(N_CLIENTS):
+            seq2 = ["ACGT" * (1 + i % 3), "GATTACA"]
+            t = threading.Thread(
+                target=_client,
+                args=(port, f"c{i}", seq2, results, errors),
+                daemon=True,
+            )
+            t.start()
+            threads.append(t)
+        for t in threads:
+            t.join(300)
+        proc.send_signal(signal.SIGTERM)
+        rc = proc.wait(timeout=120)
+        drain.join(10)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=30)
+
+    problems = list(errors)
+    if rc != 75:
+        problems.append(f"exit code: want 75 (drained), got {rc}")
+    if set(results) != {f"c{i}" for i in range(N_CLIENTS)}:
+        problems.append(
+            f"clients served: want {N_CLIENTS}, got {sorted(results)}"
+        )
+    for rid, recs in results.items():
+        if not any(r.get("done") for r in recs):
+            problems.append(f"{rid}: no done record")
+        if sum(1 for r in recs if "line" in r) != 2:
+            problems.append(f"{rid}: want 2 result lines, got {recs}")
+
+    try:
+        with open(report_path, encoding="utf-8") as fh:
+            rec = json.load(fh)
+    except (OSError, json.JSONDecodeError) as e:
+        problems.append(f"no readable report at {report_path}: {e}")
+        rec = None
+    if rec is not None:
+        try:
+            validate_report(rec)
+        except ValueError as e:
+            problems.append(str(e))
+        else:
+            counters = rec["counters"]
+            gauges = rec["gauges"]
+            if counters.get("serve_requests") != N_CLIENTS:
+                problems.append(
+                    f"counters.serve_requests: want {N_CLIENTS}, got "
+                    f"{counters.get('serve_requests')}"
+                )
+            dispatched = counters.get("chunks_dispatched", 0)
+            if not 0 < dispatched < N_CLIENTS:
+                problems.append(
+                    "coalescing: want 0 < chunks_dispatched < "
+                    f"{N_CLIENTS} (shared superblocks), got {dispatched}"
+                )
+            # The hard steady-state gate: zero recompiles after the first
+            # superblock finished.
+            if gauges.get("serve_steady_compiles") != 0:
+                problems.append(
+                    "gauges.serve_steady_compiles: want 0, got "
+                    f"{gauges.get('serve_steady_compiles')}"
+                )
+            if "request_latency_s" not in rec["histograms"]:
+                problems.append("histograms.request_latency_s: missing")
+
+    if problems:
+        for p in problems:
+            print(f"serve-smoke: FAIL: {p}")
+        return 1
+    print(
+        "serve-smoke: OK "
+        f"(requests={N_CLIENTS}, dispatches={rec['counters']['chunks_dispatched']}, "
+        f"steady_compiles=0, exit=75, report={report_path})"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

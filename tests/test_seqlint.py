@@ -228,6 +228,58 @@ class TestSeq006StderrBypass:
         assert [f.code for f in findings] == ["SEQ006"]
 
 
+class TestSeq007BlockingWaits:
+    def test_time_sleep_in_serve(self, tmp_path):
+        findings = _lint_snippet(
+            tmp_path,
+            "serve/foo.py",
+            "import time\n\ndef poll():\n    time.sleep(0.1)\n",
+        )
+        assert [f.code for f in findings] == ["SEQ007"]
+        assert "ServeClock.block_until" in findings[0].message
+
+    def test_condition_wait_forms_in_serve(self, tmp_path):
+        findings = _lint_snippet(
+            tmp_path,
+            "serve/foo.py",
+            """
+            def poll(cond):
+                cond.wait(0.1)
+                cond.wait_for(lambda: True, timeout=0.1)
+            """,
+        )
+        assert [f.code for f in findings] == ["SEQ007", "SEQ007"]
+
+    def test_clock_module_is_the_legal_home(self, tmp_path):
+        assert not _lint_snippet(
+            tmp_path,
+            "serve/clock.py",
+            """
+            def block_until(cond, predicate, timeout_s):
+                return cond.wait_for(predicate, timeout=timeout_s)
+            """,
+        )
+
+    def test_sleep_outside_serve_is_out_of_scope(self, tmp_path):
+        # resilience/ backoff sleeps stay legal (SEQ005 explicitly
+        # allows them: they delay, they do not decide).
+        assert not _lint_snippet(
+            tmp_path,
+            "resilience/foo.py",
+            "import time\n\ndef delay():\n    time.sleep(0.1)\n",
+        )
+
+    def test_serve_queue_is_on_the_seq005_list(self, tmp_path):
+        # Admission decisions must be clock-free: SEQ005 now covers
+        # serve/queue.py too.
+        findings = _lint_snippet(
+            tmp_path,
+            "serve/queue.py",
+            "import time\n\ndef admit():\n    return time.monotonic()\n",
+        )
+        assert "SEQ005" in [f.code for f in findings]
+
+
 class TestSuppressions:
     def test_per_line_disable(self, tmp_path):
         assert not _lint_snippet(

@@ -1,0 +1,600 @@
+"""Serving plane tests: admission, coalescing, demux, drain, parity.
+
+The load-bearing claims, each pinned here:
+
+* serve-mode result ``line`` values are BYTE-identical to the batch
+  CLI's stdout for the same problem (the acceptance gate);
+* concurrent requests sharing a problem key coalesce into shared
+  superblocks (one ``chunks_dispatched`` for two requests);
+* a malformed request is one typed error record, never loop death;
+* SIGTERM mid-run finishes in-flight superblocks, journals the queued
+  leftovers, exits 75, and ``--resume`` finishes them byte-identically.
+
+Unit layers (queue/batcher/session) run on a fake clock — admission is
+deterministic by construction, so no test here sleeps.
+"""
+
+from __future__ import annotations
+
+import json
+import signal
+
+import pytest
+
+from conftest import run_cli_inproc
+
+from mpi_openmp_cuda_tpu.serve.batcher import plan_blocks
+from mpi_openmp_cuda_tpu.serve.queue import (
+    ADMIT_CLOSED,
+    ADMIT_FULL,
+    ADMIT_OK,
+    RequestQueue,
+)
+from mpi_openmp_cuda_tpu.serve.session import (
+    Session,
+    build_session,
+    journal_drained,
+    load_drained,
+)
+
+
+class FakeClock:
+    """Deterministic ServeClock stand-in: ``now()`` counts calls;
+    ``block_until`` never blocks — it evaluates the predicate once."""
+
+    def __init__(self):
+        self.t = 0.0
+
+    def now(self) -> float:
+        self.t += 1.0
+        return self.t
+
+    def block_until(self, cond, predicate, timeout_s):
+        return predicate()
+
+
+class Sink:
+    """Responder stand-in collecting every sent record."""
+
+    def __init__(self):
+        self.records = []
+
+    def send(self, obj):
+        self.records.append(obj)
+
+
+WEIGHTS = [1, -3, -5, -2]
+
+
+def _request(rid, seq1="ACGTACGT", seq2=("ACGT", "TTTT")):
+    return {
+        "id": rid,
+        "weights": WEIGHTS,
+        "seq1": seq1,
+        "seq2": list(seq2),
+    }
+
+
+def _queued(raw, sink=None, seq=1):
+    class _Item:
+        pass
+
+    item = _Item()
+    item.raw = raw
+    item.responder = sink or Sink()
+    item.admitted_t = 0.0
+    item.seq = seq
+    return item
+
+
+# -- queue units -------------------------------------------------------------
+
+
+class TestRequestQueue:
+    def test_admission_cap(self):
+        q = RequestQueue(2, FakeClock())
+        s = Sink()
+        assert q.submit(_request("a"), s) == ADMIT_OK
+        assert q.submit(_request("b"), s) == ADMIT_OK
+        assert q.submit(_request("c"), s) == ADMIT_FULL
+        assert q.depth() == 2
+
+    def test_closed_queue_rejects(self):
+        q = RequestQueue(4, FakeClock())
+        q.close()
+        assert q.submit(_request("a"), Sink()) == ADMIT_CLOSED
+        assert q.depth() == 0
+
+    def test_pop_ready_takes_all_then_limit(self):
+        q = RequestQueue(8, FakeClock())
+        for rid in "abcd":
+            q.submit(_request(rid), Sink())
+        popped = q.pop_ready(0.1, 0.1, limit=3)
+        assert [it.raw["id"] for it in popped] == ["a", "b", "c"]
+        assert [it.raw["id"] for it in q.pop_ready(0.1, 0.1)] == ["d"]
+        assert q.pop_ready(0.1, 0.1) == []
+
+    def test_seq_numbers_are_unique_and_monotonic(self):
+        q = RequestQueue(8, FakeClock())
+        q.submit(_request(None), Sink())
+        q.submit(_request(None), Sink())
+        a, b = q.pop_ready(0.1, 0.1)
+        assert (a.seq, b.seq) == (1, 2)
+
+    def test_idle_tracks_sources(self):
+        q = RequestQueue(8, FakeClock())
+        assert q.idle()
+        q.open_source()
+        assert not q.idle()
+        q.close_source()
+        assert q.idle()
+
+    def test_drain_pending_empties(self):
+        q = RequestQueue(8, FakeClock())
+        q.submit(_request("a"), Sink())
+        assert [it.raw["id"] for it in q.drain_pending()] == ["a"]
+        assert q.depth() == 0
+
+
+# -- session / batcher units -------------------------------------------------
+
+
+class TestSession:
+    def test_out_of_order_fill_emits_in_index_order(self):
+        sink = Sink()
+        sess = build_session(
+            _queued(_request("r", seq2=("ACGT", "TTTT", "GG")), sink),
+            FakeClock(),
+        )
+        sess.fill(2, (5, 0, 0))
+        sess.fill(0, (14, 1, 1))
+        assert [r["line"] for r in sink.records] == [
+            "#0: score: 14, n: 1, k: 1"
+        ]
+        sess.fill(1, (10, 0, 3))
+        assert [r.get("line", "done") for r in sink.records] == [
+            "#0: score: 14, n: 1, k: 1",
+            "#1: score: 10, n: 0, k: 3",
+            "#2: score: 5, n: 0, k: 0",
+            "done",
+        ]
+        assert sink.records[-1] == {"id": "r", "done": True, "n": 3}
+
+    def test_default_id_from_admission_seq(self):
+        raw = _request(None)
+        del raw["id"]
+        sess = build_session(_queued(raw, seq=7), FakeClock())
+        assert sess.id == "req-7"
+
+    @pytest.mark.parametrize(
+        "raw, want",
+        [
+            ({"weights": [1, 2, 3], "seq1": "AC", "seq2": []}, "weights"),
+            ({"weights": WEIGHTS, "seq1": "", "seq2": []}, "seq1"),
+            ({"weights": WEIGHTS, "seq1": "AC", "seq2": "AC"}, "seq2"),
+            (
+                {"weights": WEIGHTS, "seq1": "AC", "seq2": ["A", ""]},
+                "empty",
+            ),
+            (
+                {"weights": WEIGHTS, "seq1": "A" * 3001, "seq2": ["A"]},
+                "BUF_SIZE_SEQ1",
+            ),
+            (
+                {"weights": WEIGHTS, "seq1": "AC", "seq2": ["A" * 2001]},
+                "BUF_SIZE_SEQ2",
+            ),
+        ],
+    )
+    def test_invalid_requests_are_typed_rejections(self, raw, want):
+        from mpi_openmp_cuda_tpu.serve.session import RequestError
+
+        with pytest.raises(RequestError, match=want):
+            build_session(_queued(raw), FakeClock())
+
+
+class TestBatcher:
+    def _sessions(self, specs):
+        out = []
+        for i, (seq1, seq2) in enumerate(specs):
+            out.append(
+                build_session(
+                    _queued(_request(f"r{i}", seq1, seq2)), FakeClock()
+                )
+            )
+        return out
+
+    def test_shared_key_requests_coalesce_into_one_block(self):
+        s1, s2 = self._sessions(
+            [("ACGTACGT", ("ACGT", "TTTT")), ("ACGTACGT", ("GGGG",))]
+        )
+        blocks = plan_blocks([s1, s2], rows_per_block=8)
+        assert len(blocks) == 1
+        (b,) = blocks
+        assert b.real_rows == 3
+        assert len(b.codes) == 8  # padded to the fixed shape
+        assert b.fill_ratio == pytest.approx(3 / 8)
+        assert b.tags[:3] == [(s1, 0), (s1, 1), (s2, 0)]
+        assert b.tags[3:] == [None] * 5
+
+    def test_foreign_keys_get_separate_blocks(self):
+        s1, s2 = self._sessions(
+            [("ACGTACGT", ("ACGT",)), ("TTTTTTTT", ("ACGT",))]
+        )
+        assert len(plan_blocks([s1, s2], rows_per_block=8)) == 2
+
+    def test_length_buckets_split_within_a_key(self):
+        s1, s2 = self._sessions(
+            [("ACGTACGT", ("ACGT",)), ("ACGTACGT", ("AC" * 150,))]
+        )
+        blocks = plan_blocks([s1, s2], rows_per_block=4)
+        assert len(blocks) == 2
+        sizes = sorted({b.codes[-1].size for b in blocks})
+        assert sizes == [128, 384]  # pad rows carry the bucket length
+
+    def test_every_block_has_exactly_rows_per_block(self):
+        (s1,) = self._sessions([("ACGTACGT", tuple(["ACGT"] * 11))])
+        blocks = plan_blocks([s1], rows_per_block=4)
+        assert [len(b.codes) for b in blocks] == [4, 4, 4]
+        assert [b.real_rows for b in blocks] == [4, 4, 3]
+
+
+# -- obs satellites ----------------------------------------------------------
+
+
+class TestServeObservability:
+    def test_histogram_helper(self):
+        from mpi_openmp_cuda_tpu.obs.metrics import Histogram
+
+        h = Histogram()
+        for v in (2.0, 1.0, 4.0):
+            h.observe(v)
+        assert h == {"count": 3, "sum": 7.0, "min": 1.0, "max": 4.0}
+
+    def test_serve_events_map_to_metrics(self):
+        from mpi_openmp_cuda_tpu.obs.metrics import MetricsRegistry
+
+        reg = MetricsRegistry(clock=lambda: 0.0)
+        reg.record_event("serve.request.admitted", {"depth": 3})
+        reg.record_event("serve.request.rejected", {"reason": "full"})
+        reg.record_event("serve.request.done", {"latency_s": 0.5})
+        reg.record_event(
+            "serve.batch.dispatch", {"rows": 7, "fill": 0.875, "depth": 1}
+        )
+        assert reg.counters == {
+            "serve_requests": 1,
+            "serve_rejections": 1,
+            "serve_completed": 1,
+            "serve_batches": 1,
+        }
+        assert reg.gauges["queue_depth"] == 1
+        assert reg.gauges["batch_fill_ratio"] == 0.875
+        assert reg.histograms["request_latency_s"]["count"] == 1
+
+    def test_heartbeat_gains_queue_suffix_only_in_serve(self):
+        from mpi_openmp_cuda_tpu.obs.export import heartbeat_line
+
+        base = {"counters": {}, "gauges": {}}
+        assert heartbeat_line(base) == "[obs] chunk 0/? retries=0 degraded=no"
+        serve = {"counters": {}, "gauges": {"queue_depth": 5}}
+        assert heartbeat_line(serve).endswith(" queue=5")
+
+
+# -- the serve journal -------------------------------------------------------
+
+
+class TestServeJournal:
+    def test_round_trip(self, tmp_path):
+        path = str(tmp_path / "serve.jsonl")
+        raws = [_request("a"), _request("b")]
+        journal_drained(path, raws)
+        assert load_drained(path) == raws
+        with open(path) as f:
+            recs = [json.loads(l) for l in f.read().splitlines()]
+        assert recs[-1] == {"event": "drain"}
+
+    def test_clean_exit_rewrite_is_empty(self, tmp_path):
+        path = str(tmp_path / "serve.jsonl")
+        journal_drained(path, [_request("a")])
+        journal_drained(path, [])
+        assert load_drained(path) == []
+
+    def test_missing_file_is_fresh_start(self, tmp_path):
+        assert load_drained(str(tmp_path / "absent.jsonl")) == []
+
+    def test_foreign_journal_refused(self, tmp_path):
+        path = tmp_path / "batch.jsonl"
+        path.write_text('{"format": "mpi_openmp_cuda_tpu.journal.v1"}\n')
+        with pytest.raises(ValueError, match="mutually foreign"):
+            load_drained(str(path))
+
+
+# -- CLI usage gates ---------------------------------------------------------
+
+
+class TestServeUsage:
+    @pytest.mark.parametrize(
+        "argv",
+        [
+            ("--serve", "--stream", "4"),
+            ("--serve", "--selfcheck"),
+            ("--serve", "--distributed"),
+        ],
+    )
+    def test_serve_combo_rejections(self, argv, capsys):
+        _, err = run_cli_inproc(*argv, capsys=capsys, rc_want=64)
+        assert "cannot be combined with --serve" in err
+
+    def test_port_requires_serve(self, capsys):
+        _, err = run_cli_inproc("--port", "0", capsys=capsys, rc_want=64)
+        assert "--port requires --serve" in err
+
+
+# -- end-to-end over the stdin pipe ------------------------------------------
+
+
+def _serve_records(out: str) -> list[dict]:
+    return [json.loads(l) for l in out.splitlines() if l.strip()]
+
+
+def _lines_by_id(records) -> dict:
+    got: dict[str, list[str]] = {}
+    for rec in records:
+        if "line" in rec:
+            got.setdefault(rec["id"], []).append(rec["line"])
+    return got
+
+
+class TestServePipeE2E:
+    SEQ2 = ["ACGT", "TTTT", "ACGTTGCA", "AC" * 40, "GATTACA"]
+
+    def test_serve_lines_byte_identical_to_batch_cli(self, tmp_path, capsys):
+        reqfile = tmp_path / "reqs.ndjson"
+        reqfile.write_text(
+            json.dumps(_request("r1", "ACGTACGT", self.SEQ2)) + "\n"
+        )
+        serve_out, _ = run_cli_inproc(
+            "--serve", "--input", str(reqfile), capsys=capsys
+        )
+        records = _serve_records(serve_out)
+        assert records[-1] == {"id": "r1", "done": True, "n": len(self.SEQ2)}
+
+        batch_in = tmp_path / "batch.txt"
+        batch_in.write_text(
+            " ".join(str(w) for w in WEIGHTS)
+            + f"\nACGTACGT\n{len(self.SEQ2)}\n"
+            + "\n".join(self.SEQ2)
+            + "\n"
+        )
+        batch_out, _ = run_cli_inproc(
+            "--input", str(batch_in), capsys=capsys
+        )
+        assert "\n".join(_lines_by_id(records)["r1"]) + "\n" == batch_out
+
+    @pytest.mark.no_chaos  # exact dispatch accounting
+    def test_shared_key_requests_share_superblocks(self, tmp_path, capsys):
+        reqfile = tmp_path / "reqs.ndjson"
+        reqfile.write_text(
+            json.dumps(_request("a", "ACGTACGT", ["ACGT", "TTTT"]))
+            + "\n"
+            + json.dumps(_request("b", "ACGTACGT", ["GGGG"]))
+            + "\n"
+        )
+        report = tmp_path / "report.json"
+        out, _ = run_cli_inproc(
+            "--serve",
+            "--input",
+            str(reqfile),
+            "--metrics-out",
+            str(report),
+            capsys=capsys,
+        )
+        records = _serve_records(out)
+        assert {r["id"] for r in records if r.get("done")} == {"a", "b"}
+        rep = json.loads(report.read_text())
+        # Both requests pooled into ONE superblock: one dispatch, one
+        # batch, fewer dispatches than requests — the coalescing proof.
+        assert rep["counters"]["serve_requests"] == 2
+        assert rep["counters"]["serve_batches"] == 1
+        assert rep["counters"]["chunks_dispatched"] == 1
+        assert rep["gauges"]["batch_fill_ratio"] == round(3 / 64, 4)
+        assert rep["gauges"]["serve_steady_compiles"] == 0
+
+    def test_malformed_requests_do_not_kill_the_loop(self, tmp_path, capsys):
+        reqfile = tmp_path / "reqs.ndjson"
+        reqfile.write_text(
+            "this is not json\n"
+            + json.dumps({"id": "w3", "weights": [1, 2, 3], "seq1": "AC",
+                          "seq2": ["AC"]})
+            + "\n"
+            + json.dumps(_request("bad-alpha", "ACGT", ["B@D!"]))
+            + "\n"
+            + json.dumps(_request("ok", "ACGTACGT", ["ACGT"]))
+            + "\n"
+        )
+        out, _ = run_cli_inproc(
+            "--serve", "--input", str(reqfile), capsys=capsys
+        )
+        records = _serve_records(out)
+        errors = {r["id"]: r["error"] for r in records if "error" in r}
+        assert None in errors and "not JSON" in errors[None]
+        assert "w3" in errors
+        assert "bad-alpha" in errors
+        assert any(r.get("done") and r["id"] == "ok" for r in records)
+
+    def test_queue_full_rejection(self, tmp_path, monkeypatch, capsys):
+        monkeypatch.setenv("SEQALIGN_SERVE_MAX_QUEUE", "1")
+        reqfile = tmp_path / "reqs.ndjson"
+        reqfile.write_text(
+            "".join(
+                json.dumps(_request(rid, "ACGTACGT", ["ACGT"])) + "\n"
+                for rid in ("r1", "r2", "r3")
+            )
+        )
+        out, _ = run_cli_inproc(
+            "--serve", "--input", str(reqfile), capsys=capsys
+        )
+        records = _serve_records(out)
+        full = [r for r in records if "queue full" in r.get("error", "")]
+        assert {r["id"] for r in full} == {"r2", "r3"}
+        assert any(r.get("done") and r["id"] == "r1" for r in records)
+
+
+# -- drain → 75 → resume -----------------------------------------------------
+
+
+@pytest.mark.no_chaos  # exact per-call signal timing and journal accounting
+def test_sigterm_mid_serve_drains_journals_and_resumes(
+    tmp_path, monkeypatch, capsys
+):
+    from mpi_openmp_cuda_tpu.ops.dispatch import AlignmentScorer
+
+    journal = str(tmp_path / "serve.jsonl")
+    reqfile = tmp_path / "reqs.ndjson"
+    reqfile.write_text(
+        "".join(
+            json.dumps(_request(rid, "ACGTACGT", ["ACGT", "GATTACA"])) + "\n"
+            for rid in ("r1", "r2", "r3")
+        )
+    )
+    calls = {"n": 0}
+    orig = AlignmentScorer.score_codes_async
+
+    def signalling(self, *a, **kw):
+        calls["n"] += 1
+        if calls["n"] == 2:
+            signal.raise_signal(signal.SIGTERM)
+        return orig(self, *a, **kw)
+
+    monkeypatch.setattr(AlignmentScorer, "score_codes_async", signalling)
+    # One request per tick so the signal lands between superblocks.
+    monkeypatch.setenv("SEQALIGN_SERVE_MAX_POP", "1")
+    out, err = run_cli_inproc(
+        "--serve",
+        "--input",
+        str(reqfile),
+        "--journal",
+        journal,
+        capsys=capsys,
+        rc_want=75,
+    )
+    records = _serve_records(out)
+    # r1 and r2 finished (their superblocks were in flight); r3 never
+    # started — journaled and told so.
+    done = {r["id"] for r in records if r.get("done")}
+    assert done == {"r1", "r2"}
+    assert {"id": "r3", "drained": True} in records
+    assert "journaled" in err and "--resume" in err
+    assert [raw["id"] for raw in load_drained(journal)] == ["r3"]
+
+    monkeypatch.setattr(AlignmentScorer, "score_codes_async", orig)
+    r3_out, _ = run_cli_inproc(
+        "--serve",
+        "--input",
+        "/dev/null",
+        "--journal",
+        journal,
+        "--resume",
+        capsys=capsys,
+    )
+    r3 = _serve_records(r3_out)
+    assert {"id": "r3", "done": True, "n": 2} in r3
+    # The resumed lines are the same bytes a fresh scoring produces
+    # (r1 scored the identical problem above).
+    assert _lines_by_id(r3)["r3"] == _lines_by_id(records)["r1"]
+    # Clean completion empties the journal: double-resume is a no-op.
+    assert load_drained(journal) == []
+    empty_out, _ = run_cli_inproc(
+        "--serve",
+        "--input",
+        "/dev/null",
+        "--journal",
+        journal,
+        "--resume",
+        capsys=capsys,
+    )
+    assert _serve_records(empty_out) == []
+
+
+# -- loopback socket e2e -----------------------------------------------------
+
+
+@pytest.mark.no_chaos  # exact done/drain record accounting on a live socket
+def test_loopback_socket_concurrent_clients_then_sigterm(
+    tmp_path, monkeypatch, capsys
+):
+    """The persistent transport, in-process: cli.run owns the main
+    thread (the drain guard needs it for signal handlers); client
+    threads connect over loopback, stream requests, and read their own
+    result records back; SIGTERM then drains the server to exit 75."""
+    import os
+    import socket
+    import threading
+
+    with socket.socket() as probe:
+        probe.bind(("127.0.0.1", 0))
+        port = probe.getsockname()[1]
+
+    results: dict[str, list[dict]] = {}
+    failures: list[BaseException] = []
+
+    def client(rid, seq2):
+        try:
+            deadline = 60.0
+            while True:
+                try:
+                    conn = socket.create_connection(
+                        ("127.0.0.1", port), timeout=5
+                    )
+                    break
+                except OSError:
+                    deadline -= 0.05
+                    if deadline <= 0:
+                        raise
+                    threading.Event().wait(0.05)
+            with conn:
+                conn.sendall(
+                    (json.dumps(_request(rid, "ACGTACGT", seq2)) + "\n")
+                    .encode()
+                )
+                buf = b""
+                while b'"done"' not in buf:
+                    chunk = conn.recv(65536)
+                    if not chunk:
+                        break
+                    buf += chunk
+            results[rid] = [
+                json.loads(l) for l in buf.decode().splitlines() if l
+            ]
+        except BaseException as e:  # surfaced in the main thread
+            failures.append(e)
+
+    threads = [
+        threading.Thread(target=client, args=(rid, seq2), daemon=True)
+        for rid, seq2 in (
+            ("c1", ["ACGT", "GATTACA"]),
+            ("c2", ["TTTT"]),
+        )
+    ]
+
+    def fire_when_served():
+        for t in threads:
+            t.join(120)
+        os.kill(os.getpid(), signal.SIGTERM)
+
+    for t in threads:
+        t.start()
+    stopper = threading.Thread(target=fire_when_served, daemon=True)
+    stopper.start()
+
+    _, err = run_cli_inproc(
+        "--serve", "--port", str(port), "--input", "/dev/null",
+        capsys=capsys, rc_want=75,
+    )
+    stopper.join(120)
+    assert not failures, failures
+    assert "serving on 127.0.0.1:" in err
+    assert set(results) == {"c1", "c2"}
+    for rid, n in (("c1", 2), ("c2", 1)):
+        assert {"id": rid, "done": True, "n": n} in results[rid]
+        assert len(_lines_by_id(results[rid])[rid]) == n
