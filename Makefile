@@ -18,7 +18,7 @@ PY_CFLAGS  := $(shell $(PYCONFIG) --includes)
 PY_LDFLAGS := $(shell $(PYCONFIG) --ldflags --embed)
 INPUT      ?= /root/reference/input5.txt
 
-.PHONY: build run run2 runOn2 test chaos chaos-kill analyze metrics-smoke serve-smoke bench bench-table bench-gather check clean
+.PHONY: build run run2 runOn2 test chaos chaos-kill analyze schedule-audit metrics-smoke serve-smoke bench bench-table bench-gather check clean
 
 build: final
 
@@ -97,6 +97,15 @@ chaos-kill:
 # deployment container does not ship them).  CPU-only, a few seconds.
 analyze:
 	$(PYTHON) scripts/analyze.py
+
+# Trace-level schedule gate (docs/ARCHITECTURE.md §9): price the
+# deterministic input3-class schedule with the static cost model, lower
+# every entry point + bucket body on CPU, audit donation/transfers/
+# launch structure, and diff the stable fields against the committed
+# golden (tests/golden/schedule_audit.json; regenerate deliberately
+# with scripts/schedule_audit.py --update).  CPU-only, zero devices.
+schedule-audit:
+	JAX_PLATFORMS=cpu $(PYTHON) scripts/schedule_audit.py
 
 # Observability smoke gate (docs/ARCHITECTURE.md §10): one CLI run on
 # the tiny fixture with --metrics --metrics-out, then schema-validate

@@ -84,21 +84,15 @@ def load_workload():
     path = os.environ.get("BENCH_INPUT", "/root/reference/input3.txt")
     if os.path.exists(path):
         return override(load_problem(path), os.path.basename(path))
-    rng = np.random.default_rng(3)
-    from mpi_openmp_cuda_tpu.io.parse import Problem
-    from mpi_openmp_cuda_tpu.models.encoding import decode, encode_normalized
-
-    seq1 = decode(rng.integers(1, 27, size=1489))
-    lens2 = [int(x) for x in rng.integers(56, 1153, size=32)]
-    seqs = [decode(rng.integers(1, 27, size=l)) for l in lens2]
-    problem = Problem(
-        weights=[2, 2, 1, 10],
-        seq1=seq1,
-        seq2=seqs,
-        seq1_codes=encode_normalized(seq1),
-        seq2_codes=[encode_normalized(s) for s in seqs],
+    # Deterministic synthetic fallback — factored into the package
+    # (models/workload.py) so the static schedule auditor prices the
+    # SAME problem this harness measures.
+    from mpi_openmp_cuda_tpu.models.workload import (
+        INPUT3_CLASS_NAME,
+        input3_class_problem,
     )
-    return override(problem, "synthetic-input3-class")
+
+    return override(input3_class_problem(), INPUT3_CLASS_NAME)
 
 
 def pick_backend() -> str:
@@ -137,76 +131,12 @@ def min_wall_slope(progs: dict) -> float:
     return max(walls[ks[1]] - walls[ks[0]], STEADY_CLAMP_FLOOR) / (ks[1] - ks[0])
 
 
-def production_schedule(problem, backend: str):
-    """The bucket schedule the production dispatch would run for this
-    problem — one entry per length bucket (including the r4 row-packing
-    sub-classes) with its padded chunked rows and resolved chunks body.
-
-    SHARED by the steady-state harness (which times it) and the MFU /
-    VPU-floor accounting (which counts it): a single derivation is the
-    only way "the bench times and accounts exactly the production
-    schedule" stays true (r4 code review).  Entries carry the PADDED
-    per-chunk lens — the packed kernel executes super-block 0 even for
-    all-padding tiles, and the accounting must count them.
-    """
-    from mpi_openmp_cuda_tpu.ops.dispatch import (
-        choose_chunk,
-        choose_pallas_formulation,
-        DEFAULT_CHUNK_BUDGET,
-        effective_backend,
-        pack_classes,
-        pad_batch_rows,
-        pad_problem,
-        plan_buckets,
-        resolve_chunks_body,
-        round_up,
-    )
-    from mpi_openmp_cuda_tpu.ops.values import max_abs_value, value_table
-
-    val = value_table(problem.weights).astype(np.int32).reshape(-1)
-    # Row packing only applies to 128-row buckets, so gate the packing
-    # sub-classes on the l2p=128 formulation (mirrors score_codes_async).
-    packable = False
-    classes: tuple = ()
-    if backend == "pallas":
-        fm = choose_pallas_formulation(val, (), 128)
-        if fm[0] == "pallas":
-            classes = pack_classes(fm[1], max_abs_value(val))
-            packable = bool(classes)
-    groups = plan_buckets(
-        [c.size for c in problem.seq2_codes],
-        packable=packable,
-        classes=classes or (8, 16, 32, 64),
-    )
-    sched = []
-    for key in sorted(groups):
-        codes = [problem.seq2_codes[i] for i in groups[key]]
-        batch = pad_problem(problem.seq1_codes, codes)
-        # Same chunk policy the dispatch layer applies: pallas-sized
-        # chunks only when the kernel actually runs (wide weights route
-        # to gather).
-        cb = choose_chunk(
-            batch,
-            DEFAULT_CHUNK_BUDGET,
-            backend=effective_backend(backend, val, batch.l2p),
-        )
-        bp = round_up(batch.batch_size, cb)
-        rows, lens = pad_batch_rows(batch, bp)
-        body = resolve_chunks_body(
-            backend,
-            val,
-            problem_dims=(batch.l1p, batch.l2p, batch.len1, batch.len2),
-        )
-        sched.append(
-            {
-                "batch": batch,
-                "cb": cb,
-                "rows": rows.reshape(bp // cb, cb, batch.l2p),
-                "lens": lens.reshape(bp // cb, cb),
-                "body": body,
-            }
-        )
-    return val, sched
+# The composed bucket schedule moved into the package (ops/schedule.py)
+# so the static schedule auditor (analysis/costmodel.py,
+# analysis/traceaudit.py, scripts/schedule_audit.py) prices the SAME
+# derivation this harness times and counts; re-exported here for the
+# existing tooling surface.
+from mpi_openmp_cuda_tpu.ops.schedule import production_schedule  # noqa: E402,F401
 
 
 def kernel_floor_counts(problem, backend: str, buckets: bool = True):
@@ -228,61 +158,35 @@ def kernel_floor_counts(problem, backend: str, buckets: bool = True):
     bucket-merge A/B).  Emitting both makes the official record
     self-explanatory on the floor claim (VERDICT r4 item 6).
     """
-    from mpi_openmp_cuda_tpu.ops.dispatch import (
-        DEFAULT_CHUNK_BUDGET,
-        choose_chunk,
-        choose_pallas_formulation,
-        choose_rowpack,
-        effective_backend,
-        pad_batch_rows,
-        pad_problem,
-        round_up,
-    )
     from mpi_openmp_cuda_tpu.ops.pallas_scorer import (
-        choose_superblock,
         kernel_mxu_flops,
         kernel_vpu_pass_elems,
     )
-    from mpi_openmp_cuda_tpu.ops.values import value_table
+    from mpi_openmp_cuda_tpu.ops.schedule import kernel_configs
 
-    val_flat = value_table(problem.weights).reshape(-1)
-    if buckets:
-        _, sched = production_schedule(problem, backend)
-        parts = [(p["batch"], np.asarray(p["lens"])) for p in sched]
-    else:
-        batch = pad_problem(problem.seq1_codes, problem.seq2_codes)
-        cb = choose_chunk(
-            batch, DEFAULT_CHUNK_BUDGET,
-            backend=effective_backend(backend, val_flat, batch.l2p),
-        )
-        bp = round_up(batch.batch_size, cb)
-        _, lens = pad_batch_rows(batch, bp)
-        parts = [(batch, lens.reshape(bp // cb, cb))]
+    # The per-bucket kernel decisions (formulation/feed/sb/l2s and the
+    # padded chunk walk) come from the package-level derivation shared
+    # with the static cost sheet (analysis/costmodel.py) — one source
+    # for "what would the dispatch run", three consumers (timing,
+    # accounting, prediction).
+    cfgs = kernel_configs(problem, backend, buckets=buckets)
+    if cfgs is None:
+        return 0, 0, None
 
     flops = 0
     vpu_elems = 0
     feed = None
-    from mpi_openmp_cuda_tpu.ops.values import max_abs_value
-
-    for sub, lens_chunks in parts:
-        fm = choose_pallas_formulation(val_flat, (sub.l1p, sub.l2p), sub.l2p)
-        if fm[0] != "pallas":
-            return flops, vpu_elems, None
-        feed = fm[1]
-        sb = choose_superblock(
-            sub.l1p // 128, sub.l2p // 128, sub.len1, sub.len2, feed
-        )
-        l2s = choose_rowpack(
-            feed, sub.l2p, sub.len2, maxv=max_abs_value(val_flat)
-        )
-        for chunk_lens in lens_chunks:
+    for cfg in cfgs:
+        feed = cfg.feed
+        for chunk_lens in cfg.chunk_lens:
             flops += kernel_mxu_flops(
-                sub.len1, chunk_lens, sub.l1p, sub.l2p, feed, sb=sb, l2s=l2s
+                cfg.len1, chunk_lens, cfg.l1p, cfg.l2p, cfg.feed,
+                sb=cfg.sb, l2s=cfg.l2s,
             )
             vpu_elems += sum(
                 kernel_vpu_pass_elems(
-                    sub.len1, chunk_lens, sub.l1p, sub.l2p, feed,
-                    sb=sb, l2s=l2s,
+                    cfg.len1, chunk_lens, cfg.l1p, cfg.l2p, cfg.feed,
+                    sb=cfg.sb, l2s=cfg.l2s,
                 ).values()
             )
     return flops, vpu_elems, feed
@@ -918,6 +822,26 @@ def main() -> None:
             real_tflops = flops / wall / 1e12
             record["real_tflops"] = round(real_tflops, 1)
             record["kernel_feed"] = feed
+            # Static cost-model prediction of the same schedule-level
+            # ratio (analysis/costmodel.py), emitted NEXT TO the
+            # measured number so the bucketed-schedule gap (ROADMAP
+            # item 2, BENCH_r05's 0.217) is a quantified, golden-gated
+            # quantity.  Never fatal: a cost-model bug must not take
+            # down a measurement run.
+            try:
+                from mpi_openmp_cuda_tpu.analysis.costmodel import (
+                    predicted_mfu_vs_feed_roofline,
+                )
+
+                pred = predicted_mfu_vs_feed_roofline(problem, backend)
+            except Exception as e:  # noqa: BLE001 - diagnostic only
+                pred = None
+                print(
+                    f"[bench] WARNING: cost model failed ({e})",
+                    file=sys.stderr,
+                )
+            if pred is not None:
+                record["predicted_mfu_vs_feed_roofline"] = pred
             if feed == "i8" and on_tpu:
                 # VPU-pass floor (VERDICT r3 item 2): the kernel is
                 # VPU-pass-bound, so its floor is the irreducible

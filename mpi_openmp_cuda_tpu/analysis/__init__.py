@@ -22,6 +22,14 @@ runnable on CPU-only CI (``make analyze``):
   deterministic resilience/journal decision paths).
 * :mod:`.recompile` — a jit cache-miss counting harness so tests can pin
   the expected number of compilations per bucketed schedule.
+* :mod:`.costmodel` — a static FLOP / bytes-moved / launch-count cost
+  sheet per emittable kernel config and per composed bucketed schedule,
+  producing the ``predicted_mfu_vs_feed_roofline`` bench.py emits next
+  to the measured number and the hot-config ranking for the AOT cache.
+* :mod:`.traceaudit` — a jaxpr/StableHLO walker over the lowered entry
+  points and schedule bodies: un-donated large buffers on the chunk
+  pipeline, implicit host transfers / ``convert`` widenings in hot
+  paths, and the executables-per-schedule static launch count.
 
 Everything raises a :class:`SeqcheckError` subclass with a message
 naming the violated bound and the fix, so a CI failure is actionable
@@ -73,6 +81,25 @@ class LintError(SeqcheckError):
     individual findings are :class:`.seqlint.LintFinding` rows)."""
 
 
+class CostModelError(SeqcheckError):
+    """The static cost sheet cannot price an emittable configuration or
+    schedule (non-finite / non-positive modelled cost — the iteration
+    model and the kernel walk have drifted apart)."""
+
+
+class TraceAuditError(SeqcheckError):
+    """A lowered entry point or schedule body violates a trace-level
+    invariant (failed to lower, host transfer inside a chunk body,
+    pallas-launch count drift)."""
+
+
+class ScheduleDriftError(SeqcheckError):
+    """The schedule-audit report drifted from the committed golden
+    baseline (launch count, predicted MFU, donation coverage): either
+    regenerate the golden deliberately (scripts/schedule_audit.py
+    --update) or fix the regression."""
+
+
 __all__ = [
     "SeqcheckError",
     "ContractViolation",
@@ -82,4 +109,7 @@ __all__ = [
     "SuperblockViolation",
     "VmemBudgetError",
     "LintError",
+    "CostModelError",
+    "TraceAuditError",
+    "ScheduleDriftError",
 ]

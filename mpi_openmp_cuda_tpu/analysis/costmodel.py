@@ -1,0 +1,405 @@
+"""Static cost sheet for the fused kernel and the composed schedule.
+
+BENCH_r05's headline gap — 0.31–0.40 single-program MFU vs 0.217 across
+the real bucketed schedule — lives *between* kernels, where neither the
+AST lint nor the per-kernel chooser model can see it.  This pass makes
+the schedule-level number a statically derivable quantity: every fact
+it prices (FLOPs issued, launches, executables, modelled kernel wall,
+minimum HBM traffic) is host arithmetic over the SAME derivations the
+production dispatch runs (``ops.schedule.kernel_configs``) and the SAME
+calibrated iteration model the chooser minimises
+(``pallas_scorer.superblock_model_cost`` + ``model_constants``), so it
+runs on CPU with zero devices in milliseconds and is golden-pinnable.
+
+Three products:
+
+* :func:`config_cost` / :func:`sweep_config_costs` — a per-config sheet
+  over every emittable kernel configuration
+  (``pallas_scorer.emittable_superblocks``, the chooser's own candidate
+  enumeration): FLOPs, modelled wall, and an MFU bound per canonical
+  work unit (one fully-live pair, or one packed tile).
+* :func:`schedule_cost_sheet` — the composed bucketed schedule priced
+  bucket by bucket, chunk by chunk: launch count, distinct executables,
+  ``predicted_mfu_vs_feed_roofline`` (the number bench.py emits next to
+  the measured one, so the gap is a quantified regression-gated
+  quantity), and the hot-config ranking an AOT compile cache should
+  warm first (ROADMAP item 5).
+* :func:`predicted_mfu_vs_feed_roofline` — the single scalar for
+  bench.py's record.
+
+Model scope (documented, deliberately): the kernel wall is the
+calibrated per-iteration model (log-err 0.025–0.038 vs measured kernel
+walls); launches are priced at a nominal in-program cost
+(:data:`LAUNCH_OVERHEAD_S`); bytes are the *minimum* HBM traffic (each
+operand crosses HBM<->VMEM once per launch — re-streaming can only add).
+The prediction is NOT fitted to the measured schedule number: the
+measured-vs-predicted difference is the unexplained between-kernel loss
+ROADMAP item 2's megakernel work must drive down.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from . import CostModelError
+
+_BLK = 128
+
+#: Nominal per-feed MXU roofline, matching bench.py's denominator
+#: conventions: the bf16 quiet-probe reference is ~197 TFLOP/s on the
+#: reference chip (bench.QUIET_BF16_BY_KIND), the i8 feed drives the
+#: MXU at the architectural 2x of that (bench's "2x_bf16_probe" roof),
+#: and f32 issues at ~1/4 the bf16 rate.  Static stand-ins for the
+#: measured probes so the prediction exists with zero devices.
+FEED_ROOFLINE_TFLOPS = {"i8": 394.0, "bf16": 197.0, "f32": 49.2}
+
+#: Nominal cost of one kernel launch *inside* a compiled program
+#: (scalar prologue, grid setup, semaphore round-trip) — NOT the ~40 us
+#: host-dispatch floor, which the steady-state harness amortises away.
+#: A deliberate model constant, not a fit: the schedule prediction must
+#: stay independent of the measurement it is gauged against.
+LAUNCH_OVERHEAD_S = 2.0e-6
+
+#: Traffic the value table contributes per launch (27*27 int32).
+_VAL_BYTES = 27 * 27 * 4
+
+
+def _lens_hist(lens) -> tuple:
+    """128-rounded length histogram, the exact key shape
+    ``choose_superblock`` feeds the iteration model (zero-length padding
+    rows carry no live char-blocks and are dropped, matching the
+    chooser; the packed walk re-adds their super-block-0 cost via
+    ``kernel_mxu_flops``'s padded-tile accounting)."""
+    hist: dict[int, int] = {}
+    for l2 in lens:
+        l2 = int(l2)
+        if l2 <= 0:
+            continue
+        l2r = -(-l2 // _BLK) * _BLK
+        hist[l2r] = hist.get(l2r, 0) + 1
+    return tuple(sorted(hist.items()))
+
+
+def _packed_model_wall_s(
+    flops: int, feed: str, sb: int
+) -> float:
+    """Modelled kernel wall of a row-packed walk that issued ``flops``:
+    the packed kernel runs one one-hot plus one full-W prefix matmul
+    per executed tile (``kernel_mxu_flops``'s packed arm), so the tile
+    count falls out of the FLOP total, and each tile pays the larger of
+    the calibrated iteration floor and its MAC issue time — the same
+    max(floor, macs/rate) structure ``superblock_model_cost`` applies
+    to the unpacked walk (packed buckets are nbi == 1, i.e. 1-wide)."""
+    from ..ops.pallas_scorer import model_constants
+
+    base, per_sb, rate = model_constants(feed)
+    per_tile_macs = 2 * _BLK * _BLK * (sb * _BLK + _BLK)
+    tiles = flops // (2 * per_tile_macs)
+    t_tile = max(base + sb * per_sb, per_tile_macs / rate)
+    return tiles * t_tile
+
+
+@dataclasses.dataclass(frozen=True)
+class ConfigCost:
+    """Static cost of one emittable kernel configuration, per canonical
+    work unit — one fully-live pair (unpacked) or one fully-packed tile
+    of p = 128/l2s pairs (packed)."""
+
+    kind: str  # 'unpacked' | 'packed'
+    feed: str
+    nbn: int
+    nbi: int
+    sb: int
+    l2s: int | None
+    flops: int  # MXU FLOPs per work unit
+    model_wall_s: float  # calibrated-model kernel time per work unit
+    vmem_bytes: int  # modelled resident footprint (analysis.vmem)
+    mfu_bound: float  # flops / model_wall_s / feed roofline
+
+    def describe(self) -> str:
+        return (
+            f"{self.kind:<8s} feed={self.feed:<4s} nbn={self.nbn:>2d} "
+            f"nbi={self.nbi:>2d} sb={self.sb:>2d} "
+            f"l2s={self.l2s or '-':>2} "
+            f"flops={self.flops:>12d} "
+            f"model={self.model_wall_s * 1e6:8.2f}us "
+            f"mfu<={self.mfu_bound:5.3f}"
+        )
+
+
+def config_cost(
+    nbn: int, nbi: int, feed: str, sb: int, l2s: int | None = None
+) -> ConfigCost:
+    """Price one kernel configuration (see :class:`ConfigCost`)."""
+    from ..ops.pallas_scorer import (
+        kernel_mxu_flops,
+        model_constants,
+        superblock_model_cost,
+    )
+    from .vmem import estimate_packed, estimate_unpacked
+
+    len1 = nbn * _BLK
+    l1p = nbn * _BLK
+    if l2s is not None:
+        l2p = _BLK
+        p = _BLK // l2s
+        lens = [l2s] * p  # one fully-packed tile
+        flops = kernel_mxu_flops(len1, lens, l1p, l2p, feed, sb=sb, l2s=l2s)
+        wall = _packed_model_wall_s(flops, feed, sb)
+        vmem = estimate_packed(nbn, feed, sb, l2s).total_bytes
+    else:
+        l2p = nbi * _BLK
+        lens = [l2p]  # one fully-live pair
+        flops = kernel_mxu_flops(len1, lens, l1p, l2p, feed, sb=sb)
+        base, per_sb, rate = model_constants(feed)
+        wall = superblock_model_cost(
+            nbn, nbi, len1, _lens_hist(lens), sb,
+            base=base, per_sb=per_sb, rate=rate,
+        )
+        vmem = estimate_unpacked(nbn, nbi, feed, sb, pp=2).total_bytes
+    if wall <= 0.0:
+        raise CostModelError(
+            f"modelled wall is non-positive for nbn={nbn} nbi={nbi} "
+            f"feed={feed} sb={sb} l2s={l2s}: the iteration model "
+            "(pallas_scorer.superblock_model_cost) no longer covers this "
+            "configuration"
+        )
+    roof = FEED_ROOFLINE_TFLOPS[feed] * 1e12
+    return ConfigCost(
+        kind="packed" if l2s is not None else "unpacked",
+        feed=feed,
+        nbn=nbn,
+        nbi=nbi,
+        sb=sb,
+        l2s=l2s,
+        flops=int(flops),
+        model_wall_s=float(wall),
+        vmem_bytes=int(vmem),
+        mfu_bound=float(flops / wall / roof),
+    )
+
+
+def sweep_config_costs():
+    """Yield a :class:`ConfigCost` for every configuration the dispatch
+    choosers can emit — the same space ``analysis.vmem.iter_chooser_space``
+    sweeps, enumerated through ``pallas_scorer.emittable_superblocks``
+    so a chooser change is automatically re-priced."""
+    import itertools
+
+    from ..ops.dispatch import pack_classes
+    from ..ops.pallas_scorer import emittable_superblocks
+    from .vmem import _FEED_MAXV, MAX_NBI, MAX_NBN
+
+    for nbn, nbi in itertools.product(
+        range(1, MAX_NBN + 1), range(1, MAX_NBI + 1)
+    ):
+        for feed in ("i8", "bf16", "f32"):
+            for sb in emittable_superblocks(nbn, nbi, feed):
+                yield config_cost(nbn, nbi, feed, sb)
+
+    for nbn in range(1, MAX_NBN + 1):
+        for feed, maxvs in _FEED_MAXV.items():
+            classes: set[int] = set()
+            for maxv in maxvs:
+                classes.update(pack_classes(feed, maxv))
+            for sb in emittable_superblocks(nbn, 1, feed):
+                for l2s in sorted(classes):
+                    yield config_cost(nbn, 1, feed, sb, l2s=l2s)
+
+
+def audit_config_space():
+    """Sweep the whole emittable space and return ``(n, best)`` where
+    ``best`` is the highest-MFU-bound config; raises
+    :class:`CostModelError` on any non-finite or non-positive cost
+    (a config the iteration model can no longer price)."""
+    import math
+
+    n = 0
+    best: ConfigCost | None = None
+    for cc in sweep_config_costs():
+        n += 1
+        if not (math.isfinite(cc.model_wall_s) and cc.flops > 0):
+            raise CostModelError(
+                f"non-finite or empty cost for emittable config: "
+                f"{cc.describe()}"
+            )
+        if best is None or cc.mfu_bound > best.mfu_bound:
+            best = cc
+    if best is None:
+        raise CostModelError("config sweep yielded no configurations")
+    return n, best
+
+
+def _bucket_bytes_moved(cfg, est_a_bytes: int) -> int:
+    """Minimum HBM traffic for one LAUNCH of this bucket: the A band,
+    the chunk's rows/lens operands, the value table, and the output —
+    each crossing HBM<->VMEM once (re-streaming can only add)."""
+    rows = cfg.cb * cfg.l2p * 4
+    lens = cfg.cb * 4
+    out = cfg.cb * 3 * 4
+    seq1ext = (cfg.l1p + cfg.l2p + 1) * 4
+    return est_a_bytes + rows + lens + out + seq1ext + _VAL_BYTES
+
+
+def schedule_cost_sheet(problem, backend: str = "pallas") -> dict:
+    """Price ``problem``'s composed production bucket schedule.
+
+    Returns a JSON-ready dict (see ``scripts/schedule_audit.py`` for the
+    enveloped report): per-bucket rows, schedule totals (FLOPs, bytes,
+    launches, executables, modelled wall), the
+    ``predicted_mfu_vs_feed_roofline`` scalar, and the hot-config
+    ranking for the AOT warm set.  Off-kernel schedules (wide weights /
+    unaligned buckets) return a sheet with ``"feed": None`` and no
+    prediction — counts for work that never runs must not be recorded.
+    """
+    from ..ops.pallas_scorer import (
+        kernel_mxu_flops,
+        kernel_vpu_pass_elems,
+        model_constants,
+        superblock_model_cost,
+    )
+    from ..ops.schedule import kernel_configs
+    from .vmem import estimate_packed, estimate_unpacked
+
+    cfgs = kernel_configs(problem, backend, buckets=True)
+    if cfgs is None:
+        return {
+            "backend": backend,
+            "feed": None,
+            "buckets": [],
+            "totals": None,
+            "predicted_mfu_vs_feed_roofline": None,
+            "hot_configs": [],
+        }
+
+    feed = cfgs[0].feed
+    base, per_sb, rate = model_constants(feed)
+    buckets = []
+    total_flops = 0
+    total_vpu = 0
+    total_bytes = 0
+    total_launches = 0
+    total_model_s = 0.0
+    by_key: dict[tuple, dict] = {}
+    for cfg in cfgs:
+        nbn, nbi = cfg.l1p // _BLK, cfg.l2p // _BLK
+        b_flops = 0
+        b_vpu = 0
+        b_model_s = 0.0
+        for chunk_lens in cfg.chunk_lens:
+            flops = kernel_mxu_flops(
+                cfg.len1, chunk_lens, cfg.l1p, cfg.l2p, cfg.feed,
+                sb=cfg.sb, l2s=cfg.l2s,
+            )
+            b_flops += flops
+            b_vpu += sum(
+                kernel_vpu_pass_elems(
+                    cfg.len1, chunk_lens, cfg.l1p, cfg.l2p, cfg.feed,
+                    sb=cfg.sb, l2s=cfg.l2s,
+                ).values()
+            )
+            if cfg.l2s is not None:
+                b_model_s += _packed_model_wall_s(flops, cfg.feed, cfg.sb)
+            else:
+                b_model_s += superblock_model_cost(
+                    nbn, nbi, cfg.len1, _lens_hist(chunk_lens), cfg.sb,
+                    base=base, per_sb=per_sb, rate=rate,
+                )
+        if cfg.l2s is not None:
+            a_bytes = estimate_packed(nbn, cfg.feed, cfg.sb, cfg.l2s).a_bytes
+        else:
+            a_bytes = estimate_unpacked(
+                nbn, nbi, cfg.feed, cfg.sb, pp=2
+            ).a_bytes
+        b_bytes = cfg.n_chunks * _bucket_bytes_moved(cfg, a_bytes)
+        row = {
+            "l1p": cfg.l1p,
+            "l2p": cfg.l2p,
+            "cb": cfg.cb,
+            "launches": cfg.n_chunks,
+            "formulation": cfg.formulation,
+            "feed": cfg.feed,
+            "sb": cfg.sb,
+            "l2s": cfg.l2s,
+            "mxu_flops": int(b_flops),
+            "vpu_pass_elems": int(b_vpu),
+            "bytes_moved_min": int(b_bytes),
+            "model_kernel_us": round(b_model_s * 1e6, 3),
+        }
+        buckets.append(row)
+        total_flops += b_flops
+        total_vpu += b_vpu
+        total_bytes += b_bytes
+        total_launches += cfg.n_chunks
+        total_model_s += b_model_s
+        agg = by_key.setdefault(
+            cfg.cache_key,
+            {
+                "formulation": cfg.formulation,
+                "feed": cfg.feed,
+                "l1p": cfg.l1p,
+                "l2p": cfg.l2p,
+                "cb": cfg.cb,
+                "sb": cfg.sb,
+                "l2s": cfg.l2s,
+                "launches": 0,
+                "model_kernel_s": 0.0,
+            },
+        )
+        agg["launches"] += cfg.n_chunks
+        agg["model_kernel_s"] += b_model_s
+
+    predicted_wall_s = total_model_s + total_launches * LAUNCH_OVERHEAD_S
+    roof = FEED_ROOFLINE_TFLOPS[feed]
+    predicted_tflops = total_flops / predicted_wall_s / 1e12
+    hot = sorted(
+        by_key.values(), key=lambda r: -r["model_kernel_s"]
+    )
+    hot_rows = []
+    for rank, r in enumerate(hot, start=1):
+        hot_rows.append(
+            {
+                "rank": rank,
+                "formulation": r["formulation"],
+                "feed": r["feed"],
+                "l1p": r["l1p"],
+                "l2p": r["l2p"],
+                "cb": r["cb"],
+                "sb": r["sb"],
+                "l2s": r["l2s"],
+                "launches": r["launches"],
+                "model_kernel_us": round(r["model_kernel_s"] * 1e6, 3),
+                "wall_share": round(r["model_kernel_s"] / total_model_s, 4),
+            }
+        )
+    return {
+        "backend": backend,
+        "feed": feed,
+        "buckets": buckets,
+        "totals": {
+            "mxu_flops": int(total_flops),
+            "vpu_pass_elems": int(total_vpu),
+            "bytes_moved_min": int(total_bytes),
+            "launches": int(total_launches),
+            "executables": len(by_key),
+            "model_kernel_us": round(total_model_s * 1e6, 3),
+            "launch_overhead_us": round(
+                total_launches * LAUNCH_OVERHEAD_S * 1e6, 3
+            ),
+            "predicted_wall_us": round(predicted_wall_s * 1e6, 3),
+        },
+        "feed_roofline_tflops": roof,
+        "predicted_tflops": round(predicted_tflops, 2),
+        "predicted_mfu_vs_feed_roofline": round(
+            total_flops / predicted_wall_s / (roof * 1e12), 3
+        ),
+        "hot_configs": hot_rows,
+    }
+
+
+def predicted_mfu_vs_feed_roofline(problem, backend: str) -> float | None:
+    """The scalar bench.py emits next to the measured
+    ``mfu_vs_feed_roofline``; ``None`` when any part of the schedule
+    falls off the fused kernel."""
+    sheet = schedule_cost_sheet(problem, backend)
+    return sheet["predicted_mfu_vs_feed_roofline"]

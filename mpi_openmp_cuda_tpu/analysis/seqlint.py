@@ -35,6 +35,20 @@ SEQ007   no bare blocking waits (``time.sleep`` / ``Condition.wait`` /
          serve-loop wait must ride the injectable
          ``ServeClock.block_until`` so tests drive a fake clock and a
          drain signal is noticed within one bounded wait (PR 6).
+SEQ008   serve-plane shared state is mutated only under its owning
+         lock: in ``serve/``, a class that declares a
+         ``threading.Condition``/``Lock``/``RLock`` attribute is
+         *guarded*, and every ``self.*`` mutation outside ``__init__``
+         must sit inside ``with self.<guard>:``.  Reader threads
+         (socket connections, stdin ingest) may only ``json.loads``
+         and enqueue — everything they touch crosses this lock (PR 6's
+         threading contract, now machine-checked).
+SEQ009   every package module is explicitly classified in the
+         ``_MODULE_CLASSES`` registry below (traced / deterministic /
+         instrumented / serve-plane / host ...).  A new module that no
+         rule list knows about would silently escape SEQ001-008; the
+         registry makes that a lint failure instead (the PR 6 drift:
+         ``io/pipeline.py`` and ``serve/*`` predated it).
 =======  ==================================================================
 
 Suppression: append ``# seqlint: disable=SEQ00N`` to the offending line
@@ -61,27 +75,85 @@ _TRACED_NAME_RE = re.compile(
     r"prologue|step|combine|inner)$"
 )
 
-#: Modules whose traced functions SEQ001/SEQ003 police.
-_TRACED_DIRS = ("ops", "parallel")
+#: Module roles.  Each role keys one rule's scope; a module may hold
+#: several (resilience/ is both clock-free in its decisions AND routed
+#: through the event bus for its diagnostics).
+ROLE_TRACED = "traced-scoring"  # SEQ001/SEQ003 police its kernel bodies
+ROLE_DETERMINISTIC = "deterministic"  # SEQ005: decisions are clock-free
+ROLE_INSTRUMENTED = "instrumented"  # SEQ006: stderr rides the event bus
+ROLE_SERVE = "serve-plane"  # SEQ007 waits + SEQ008 shared-state lock
+ROLE_WAIT_HOME = "serve-clock-home"  # the one legal blocking-wait seam
+ROLE_ENV_HOME = "env-home"  # the one legal os.environ reader
+ROLE_HOST = "host"  # plain host-side module; only SEQ002/SEQ004 apply
 
-#: Modules whose DECISIONS must be wall-clock-free (SEQ005).
-_DETERMINISTIC_PATHS = ("resilience/", "utils/journal.py", "serve/queue.py")
+#: EXHAUSTIVE classification of the package tree.  Exact file entries
+#: override their directory's default; ``dir/`` entries classify every
+#: module beneath them.  A module matching NEITHER is a SEQ009 finding
+#: — new modules must be placed here deliberately, so no rule scope can
+#: silently rot again (PR 6 shipped io/pipeline.py and serve/* without
+#: touching these lists; this registry turns that into a failure).
+_MODULE_CLASSES: dict[str, tuple[str, ...]] = {
+    # -- exact files (override the directory default) ----------------------
+    "utils/platform.py": (ROLE_ENV_HOME,),
+    "utils/journal.py": (ROLE_DETERMINISTIC, ROLE_INSTRUMENTED),
+    "ops/dispatch.py": (ROLE_TRACED, ROLE_INSTRUMENTED),
+    "parallel/distributed.py": (ROLE_TRACED, ROLE_INSTRUMENTED),
+    "io/pipeline.py": (ROLE_INSTRUMENTED,),
+    "serve/clock.py": (ROLE_WAIT_HOME,),
+    "serve/queue.py": (ROLE_SERVE, ROLE_DETERMINISTIC),
+    "serve/loop.py": (ROLE_SERVE, ROLE_INSTRUMENTED),
+    "serve/session.py": (ROLE_SERVE, ROLE_INSTRUMENTED),
+    # -- directory defaults ------------------------------------------------
+    "ops/": (ROLE_TRACED,),
+    "parallel/": (ROLE_TRACED,),
+    "resilience/": (ROLE_DETERMINISTIC, ROLE_INSTRUMENTED),
+    "serve/": (ROLE_SERVE,),
+    "analysis/": (ROLE_HOST,),
+    "io/": (ROLE_HOST,),
+    "models/": (ROLE_HOST,),
+    "obs/": (ROLE_HOST,),
+    "utils/": (ROLE_HOST,),
+    # -- top-level modules -------------------------------------------------
+    "__init__.py": (ROLE_HOST,),
+    "__main__.py": (ROLE_HOST,),
+    "native_bridge.py": (ROLE_HOST,),
+}
 
-#: The serving plane's single legal home for blocking waits (SEQ007).
+
+def module_roles(rel: str | Path) -> tuple[str, ...] | None:
+    """Roles for a lint-relative module path (``<pkg>/<inner...>.py``).
+
+    The leading path component is the package directory name (whatever
+    it is — the tests lint under ``pkg/``); classification keys on the
+    inner path.  Returns ``None`` for an unclassified module (a SEQ009
+    finding, not a crash: the linter must keep linting the rest)."""
+    parts = Path(rel).parts
+    inner = "/".join(parts[1:]) if len(parts) > 1 else parts[0]
+    exact = _MODULE_CLASSES.get(inner)
+    if exact is not None:
+        return exact
+    if "/" in inner:
+        return _MODULE_CLASSES.get(inner.split("/", 1)[0] + "/")
+    return None
+
+
+#: The serving plane's single legal home for blocking waits (SEQ007)
+#: and the single legal home for environment reads (SEQ002) — kept as
+#: names because the rule MESSAGES cite them.
 _SERVE_CLOCK_HOME = "serve/clock.py"
-
-#: The single legal home for environment reads (SEQ002).
 _ENV_HOME = "utils/platform.py"
 
-#: Modules whose stderr diagnostics must flow through the event bus so
-#: an armed observability plane mirrors them (SEQ006); ``obs/events.py``
-#: itself holds the one blessed ``print`` (the log_line seam).
-_INSTRUMENTED_PATHS = (
-    "resilience/",
-    "utils/journal.py",
-    "ops/dispatch.py",
-    "parallel/distributed.py",
-)
+#: Guard types whose ``self.X = threading.<T>()`` assignment marks a
+#: serve-plane class as lock-guarded (SEQ008).
+_GUARD_TYPES = ("Condition", "Lock", "RLock")
+
+#: In-place mutator methods: a call ``self.attr.<m>(...)`` mutates the
+#: shared container exactly like an assignment does (SEQ008).
+_MUTATOR_METHODS = {
+    "append", "extend", "insert", "pop", "popleft", "appendleft",
+    "remove", "clear", "add", "discard", "update", "setdefault",
+    "popitem", "sort", "reverse",
+}
 
 _WALLCLOCK_ATTRS = {
     ("time", "time"),
@@ -161,22 +233,17 @@ class _Linter(ast.NodeVisitor):
         self.findings: list[LintFinding] = []
         self.per_line, self.file_level = _suppressions(source)
         self.scopes: list[_Scope] = []
-        parts = Path(rel).parts
-        self.in_traced_dir = len(parts) > 1 and parts[1] in _TRACED_DIRS
-        self.is_env_home = rel.endswith(_ENV_HOME)
-        self.in_deterministic = any(
-            p in rel for p in _DETERMINISTIC_PATHS
-        )
-        self.in_instrumented = any(
-            p in rel for p in _INSTRUMENTED_PATHS
-        )
-        # Path-segment match, not substring: "serve/" would also match
-        # a hypothetical "observe/" module.
-        self.in_serve = (
-            len(parts) > 1
-            and parts[1] == "serve"
-            and not rel.endswith(_SERVE_CLOCK_HOME)
-        )
+        # Every rule's scope derives from the one classification
+        # registry — path predicates may not be re-derived ad hoc here
+        # (that is exactly the drift SEQ009 exists to prevent).
+        roles = module_roles(rel)
+        self.unclassified = roles is None
+        roles = roles or ()
+        self.in_traced_dir = ROLE_TRACED in roles
+        self.is_env_home = ROLE_ENV_HOME in roles
+        self.in_deterministic = ROLE_DETERMINISTIC in roles
+        self.in_instrumented = ROLE_INSTRUMENTED in roles
+        self.in_serve = ROLE_SERVE in roles
 
     # -- bookkeeping -------------------------------------------------------
 
@@ -203,6 +270,125 @@ class _Linter(ast.NodeVisitor):
             if s.traced:
                 return s
         return None
+
+    # -- SEQ009: unclassified module ---------------------------------------
+
+    def visit_Module(self, node: ast.Module):
+        if self.unclassified:
+            self._emit(
+                "SEQ009",
+                node,
+                "module is not classified in the seqlint _MODULE_CLASSES "
+                "registry; add it (traced / deterministic / instrumented "
+                "/ serve-plane / host) so the rule scopes cover it",
+            )
+        self.generic_visit(node)
+
+    # -- SEQ008: serve-plane shared state under its lock -------------------
+
+    def visit_ClassDef(self, node: ast.ClassDef):
+        if self.in_serve:
+            guards = self._class_guards(node)
+            if guards:
+                for stmt in node.body:
+                    if (
+                        isinstance(
+                            stmt, (ast.FunctionDef, ast.AsyncFunctionDef)
+                        )
+                        and stmt.name != "__init__"
+                    ):
+                        for child in stmt.body:
+                            self._scan_guarded(
+                                child, node.name, guards, held=False
+                            )
+        self.generic_visit(node)
+
+    @staticmethod
+    def _class_guards(node: ast.ClassDef) -> set[str]:
+        """Attribute names assigned ``threading.Condition()/Lock()/
+        RLock()`` (or a bare imported ``Lock()`` etc.) anywhere in the
+        class: the class's owning guards."""
+        guards: set[str] = set()
+        for sub in ast.walk(node):
+            if not (isinstance(sub, ast.Assign) and isinstance(sub.value, ast.Call)):
+                continue
+            func = sub.value.func
+            is_guard_ctor = (
+                isinstance(func, ast.Attribute)
+                and func.attr in _GUARD_TYPES
+                and isinstance(func.value, ast.Name)
+                and func.value.id == "threading"
+            ) or (isinstance(func, ast.Name) and func.id in _GUARD_TYPES)
+            if not is_guard_ctor:
+                continue
+            for tgt in sub.targets:
+                if (
+                    isinstance(tgt, ast.Attribute)
+                    and isinstance(tgt.value, ast.Name)
+                    and tgt.value.id == "self"
+                ):
+                    guards.add(tgt.attr)
+        return guards
+
+    @staticmethod
+    def _self_attr_root(node: ast.AST) -> str | None:
+        """The ``X`` of a ``self.X`` / ``self.X[...]`` chain, else None."""
+        while isinstance(node, ast.Subscript):
+            node = node.value
+        if (
+            isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"
+        ):
+            return node.attr
+        return None
+
+    def _is_guard_enter(self, expr: ast.AST, guards: set[str]) -> bool:
+        """``with self.<guard>:`` — the context expression IS a guard
+        attribute (Condition/Lock are their own context managers)."""
+        return self._self_attr_root(expr) in guards
+
+    def _scan_guarded(self, node, cls: str, guards: set[str], held: bool):
+        """Walk one guarded class's method body tracking whether a
+        ``with self.<guard>:`` is lexically held, flagging every
+        ``self.*`` mutation reached without it (SEQ008)."""
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            inner = held or any(
+                self._is_guard_enter(item.context_expr, guards)
+                for item in node.items
+            )
+            for child in node.body:
+                self._scan_guarded(child, cls, guards, inner)
+            return
+        if not held:
+            mutated = None
+            if isinstance(node, ast.Assign):
+                for tgt in node.targets:
+                    elts = tgt.elts if isinstance(tgt, (ast.Tuple, ast.List)) else [tgt]
+                    for e in elts:
+                        e = e.value if isinstance(e, ast.Starred) else e
+                        mutated = mutated or self._self_attr_root(e)
+            elif isinstance(node, ast.AugAssign):
+                mutated = self._self_attr_root(node.target)
+            elif isinstance(node, ast.Call):
+                func = node.func
+                if (
+                    isinstance(func, ast.Attribute)
+                    and func.attr in _MUTATOR_METHODS
+                ):
+                    mutated = self._self_attr_root(func.value)
+            if mutated is not None:
+                self._emit(
+                    "SEQ008",
+                    node,
+                    f"`self.{mutated}` of guarded serve-plane class "
+                    f"`{cls}` is mutated outside `with self.<guard>:`; "
+                    "reader threads may only json.loads and enqueue — "
+                    "every shared-state mutation crosses the owning "
+                    "Condition/Lock",
+                )
+        for child in ast.iter_child_nodes(node):
+            self._scan_guarded(child, cls, guards, held)
 
     # -- SEQ004: bare assert ----------------------------------------------
 

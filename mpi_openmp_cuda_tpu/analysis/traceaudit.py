@@ -1,0 +1,294 @@
+"""Jaxpr/StableHLO audit of the scorer entry points and the schedule.
+
+The cost model (:mod:`.costmodel`) prices what the kernels *should*
+cost; this pass inspects what the compiler is actually *given*.  Every
+registered entry point (``contracts.ENTRY_CONTRACTS`` — the same five
+the eval_shape tier audits) is lowered on CPU with abstract operands
+(no FLOPs run; lowering a pallas body is cheap, executing it is not)
+and the result is walked for the between-kernel losses ROADMAP items 2
+and 5 are about:
+
+* **Donation coverage** — every large input buffer that is NOT donated
+  (``jax.jit``'s ``donate_argnums`` / ``tf.aliasing_output``) forces
+  XLA to keep input and output alive simultaneously; on the chunk
+  pipeline that is the rows/chunks arrays every launch.  The audit
+  LISTS each un-donated large buffer per entry point — the honest
+  current state is zero donation, and the report says so rather than
+  silently passing (the acceptance bar).
+* **Implicit transfers / widenings** — ``device_put`` equations in a
+  supposedly device-resident body, and ``convert_element_type``
+  equations that WIDEN (target itemsize > source): each widening in a
+  hot body multiplies VPU pass bytes and VMEM pressure.
+* **Executables per schedule** — the static launch/executable counts
+  the megakernel work must drive down: each bucket body must lower to
+  exactly ONE ``pallas_call`` (the fused kernel), and the number of
+  distinct compiled programs per schedule is the bucket cache-key
+  count (``ops.schedule.BucketKernelConfig.cache_key``).
+
+Pure lowering + jaxpr walking: CPU-only, zero devices, seconds.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from . import TraceAuditError
+
+#: An input buffer at or above this size is "large": its round trip is
+#: material HBM traffic on every launch.  16 KiB keeps the production
+#: schedule's per-chunk rows arrays (24-40 KiB on the input3-class
+#: workload, MiB-scale on wide buckets) in scope while letting scalars,
+#: the value table, and short seq1ext operands pass.
+LARGE_BUFFER_BYTES = 16 << 10
+
+
+@dataclasses.dataclass(frozen=True)
+class BufferInfo:
+    """One flattened input operand of a lowered entry point."""
+
+    index: int
+    shape: tuple
+    dtype: str
+    nbytes: int
+    donated: bool
+
+    def describe(self) -> str:
+        kib = self.nbytes / 1024
+        mark = "donated" if self.donated else "UNDONATED"
+        return (
+            f"arg{self.index}: {self.dtype}{list(self.shape)} "
+            f"{kib:8.1f} KiB {mark}"
+        )
+
+
+@dataclasses.dataclass(frozen=True)
+class EntryTraceReport:
+    """Audit result of one entry point at one shape bucket."""
+
+    entry: str
+    bucket: tuple  # (b, nc, l1p, l2p)
+    n_args: int
+    large_buffers: tuple  # BufferInfo rows (nbytes >= threshold)
+    undonated_large: tuple  # the subset with donated=False
+    convert_widenings: int
+    device_puts: int
+    pallas_calls: int
+
+    @property
+    def donation_covered(self) -> bool:
+        return not self.undonated_large
+
+
+def _walk_jaxpr(jaxpr, counts: dict) -> None:
+    """Recursively count primitives of interest through every nested
+    jaxpr (pjit bodies, scan/while carries, cond branches, custom-call
+    wrappers)."""
+    for eqn in jaxpr.eqns:
+        name = eqn.primitive.name
+        if name == "convert_element_type":
+            src = eqn.invars[0].aval
+            dst = eqn.outvars[0].aval
+            if dst.dtype.itemsize > src.dtype.itemsize:
+                counts["convert_widenings"] += 1
+        elif name == "device_put":
+            counts["device_puts"] += 1
+        elif name == "pallas_call":
+            counts["pallas_calls"] += 1
+        for sub in eqn.params.values():
+            if hasattr(sub, "jaxpr"):  # ClosedJaxpr
+                _walk_jaxpr(sub.jaxpr, counts)
+            elif hasattr(sub, "eqns"):  # raw Jaxpr
+                _walk_jaxpr(sub, counts)
+            elif isinstance(sub, (tuple, list)):
+                for item in sub:
+                    if hasattr(item, "jaxpr"):
+                        _walk_jaxpr(item.jaxpr, counts)
+                    elif hasattr(item, "eqns"):
+                        _walk_jaxpr(item, counts)
+
+
+def walk_counts(fn, *args) -> dict:
+    """Primitive counts of interest for ``fn`` traced at ``args``
+    (abstract or concrete)."""
+    import jax
+
+    counts = {"convert_widenings": 0, "device_puts": 0, "pallas_calls": 0}
+    closed = jax.make_jaxpr(fn)(*args)
+    _walk_jaxpr(closed.jaxpr, counts)
+    return counts
+
+
+def buffer_infos(fn, *args, donate_argnums=()) -> list:
+    """Flattened :class:`BufferInfo` rows for ``fn`` lowered at
+    ``args`` — donation read back from the lowering itself
+    (``Lowered.args_info``), not from the caller's intent, so a
+    donation the platform rejects reads as not donated."""
+    import warnings
+
+    import jax
+    import numpy as np
+
+    with warnings.catch_warnings():
+        # CPU rejects some donations with a UserWarning; the audit's
+        # whole point is to REPORT that state, not to spam stderr.
+        warnings.simplefilter("ignore")
+        lowered = jax.jit(fn, donate_argnums=donate_argnums).lower(*args)
+    infos = []
+    for i, leaf in enumerate(jax.tree_util.tree_leaves(lowered.args_info)):
+        # jax.stages.ArgInfo spells the aval field `aval` in newer
+        # releases and `_aval` in 0.4.x; accept both.
+        aval = getattr(leaf, "aval", None) or leaf._aval
+        nbytes = int(np.prod(aval.shape, dtype=np.int64)) * aval.dtype.itemsize
+        infos.append(
+            BufferInfo(
+                index=i,
+                shape=tuple(aval.shape),
+                dtype=str(aval.dtype),
+                nbytes=nbytes,
+                donated=bool(leaf.donated),
+            )
+        )
+    return infos
+
+
+def trace_entry(
+    contract, bucket, threshold: int = LARGE_BUFFER_BYTES
+) -> EntryTraceReport:
+    """Lower one :class:`~.contracts.EntryContract` at one audit bucket
+    and collect its :class:`EntryTraceReport`."""
+    b, nc, l1p, l2p = bucket
+    fn, args = contract.make(b, nc, l1p, l2p)
+    try:
+        infos = buffer_infos(fn, *args)
+        counts = walk_counts(fn, *args)
+    except Exception as exc:  # noqa: BLE001 - re-raise with context
+        raise TraceAuditError(
+            f"{contract.name} failed to lower at bucket (b={b}, nc={nc}, "
+            f"l1p={l1p}, l2p={l2p}): {exc!r}"
+        ) from exc
+    large = tuple(i for i in infos if i.nbytes >= threshold)
+    return EntryTraceReport(
+        entry=contract.name,
+        bucket=tuple(bucket),
+        n_args=len(infos),
+        large_buffers=large,
+        undonated_large=tuple(i for i in large if not i.donated),
+        convert_widenings=counts["convert_widenings"],
+        device_puts=counts["device_puts"],
+        pallas_calls=counts["pallas_calls"],
+    )
+
+
+def audit_entry_points(buckets=None, threshold: int = LARGE_BUFFER_BYTES):
+    """Lower every registered entry point over the audit buckets and
+    return the :class:`EntryTraceReport` rows.  Raises
+    :class:`TraceAuditError` if any entry fails to lower, or if an
+    entry claims device residency but emits host transfers
+    (``device_put`` inside a chunk body)."""
+    from .contracts import _AUDIT_BUCKETS, ENTRY_CONTRACTS
+
+    if buckets is None:
+        buckets = _AUDIT_BUCKETS
+    reports = []
+    for contract in ENTRY_CONTRACTS:
+        for bucket in buckets:
+            rep = trace_entry(contract, bucket, threshold=threshold)
+            if rep.device_puts:
+                raise TraceAuditError(
+                    f"{rep.entry} lowers with {rep.device_puts} device_put "
+                    f"equation(s) at bucket {rep.bucket}: chunk bodies must "
+                    "be device-resident — hoist the transfer to the "
+                    "dispatch boundary (ops/dispatch.py)"
+                )
+            reports.append(rep)
+    return reports
+
+
+def audit_schedule(problem, backend: str = "pallas") -> dict:
+    """Trace-audit the COMPOSED schedule: every bucket's resolved body
+    is traced at its production chunk shapes; each 128-aligned pallas
+    bucket must contain exactly one ``pallas_call`` (so the static
+    launch count is ``n_chunks`` per bucket — the number the megakernel
+    work must drive down), and donation coverage is reported for the
+    chunk-pipeline operands.  Returns a JSON-ready dict."""
+    import jax
+    import numpy as np
+
+    from ..ops.schedule import kernel_configs, production_schedule
+
+    _, sched = production_schedule(problem, backend)
+    cfgs = kernel_configs(problem, backend, buckets=True)
+    rows = []
+    total_undonated = 0
+    total_large = 0
+    for i, part in enumerate(sched):
+        batch = part["batch"]
+        body = part["body"]
+        rows_arr = np.asarray(part["rows"])
+        lens_arr = np.asarray(part["lens"])
+        nc, cb, l2p = rows_arr.shape
+        # The production pipeline (io/pipeline.py) dispatches chunk by
+        # chunk: trace the body at the single-chunk invocation shape,
+        # so "pallas calls per chunk" x n_chunks is the schedule's
+        # static launch count.
+        args = (
+            jax.ShapeDtypeStruct(
+                np.asarray(batch.seq1ext).shape,
+                np.asarray(batch.seq1ext).dtype,
+            ),
+            jax.ShapeDtypeStruct((), np.int32),
+            jax.ShapeDtypeStruct((1, cb, l2p), np.int32),
+            jax.ShapeDtypeStruct((1, cb), np.int32),
+            jax.ShapeDtypeStruct((27 * 27,), np.int32),
+        )
+        try:
+            counts = walk_counts(body, *args)
+            infos = buffer_infos(body, *args)
+        except Exception as exc:  # noqa: BLE001 - re-raise with context
+            raise TraceAuditError(
+                f"schedule bucket {i} (l1p={batch.l1p}, l2p={batch.l2p}, "
+                f"cb={cb}) failed to lower: {exc!r}"
+            ) from exc
+        aligned = batch.l1p % 128 == 0 and batch.l2p % 128 == 0
+        if aligned and backend == "pallas" and counts["pallas_calls"] != 1:
+            raise TraceAuditError(
+                f"schedule bucket {i} (l1p={batch.l1p}, l2p={batch.l2p}) "
+                f"lowers to {counts['pallas_calls']} pallas_call(s), "
+                "expected exactly 1: the static launch count "
+                "(launches == chunks) no longer holds — update "
+                "analysis/costmodel.py's launch accounting in lockstep "
+                "with the kernel restructuring"
+            )
+        large = [b for b in infos if b.nbytes >= LARGE_BUFFER_BYTES]
+        undonated = [b.describe() for b in large if not b.donated]
+        total_large += len(large)
+        total_undonated += len(undonated)
+        rows.append(
+            {
+                "bucket": i,
+                "l1p": int(batch.l1p),
+                "l2p": int(batch.l2p),
+                "cb": int(cb),
+                "chunks": int(nc),
+                "pallas_calls_per_chunk": counts["pallas_calls"],
+                "convert_widenings": counts["convert_widenings"],
+                "device_puts": counts["device_puts"],
+                "large_buffers": len(large),
+                "undonated_large_buffers": undonated,
+            }
+        )
+        del lens_arr
+    executables = (
+        len({c.cache_key for c in cfgs}) if cfgs is not None else len(sched)
+    )
+    return {
+        "backend": backend,
+        "buckets": rows,
+        "executables": executables,
+        "launches": int(sum(r["chunks"] for r in rows)),
+        "donation": {
+            "large_buffers": total_large,
+            "undonated_large_buffers": total_undonated,
+            "covered": total_undonated == 0,
+        },
+    }

@@ -201,7 +201,8 @@ def drain_snapshot() -> dict | None:
 
 def wrap_report(kind: str, body: dict, *, meta: dict | None = None) -> dict:
     """The one report envelope: ``bench.py`` wraps its blob with
-    ``kind="bench"``, the CLI's run report uses ``kind="run"`` — both
+    ``kind="bench"``, the CLI's run report uses ``kind="run"``, and the
+    static schedule auditor emits ``kind="schedule-audit"`` — all
     validate against :func:`validate_report`."""
     rec: dict = {
         "schema": RUN_REPORT_SCHEMA,
@@ -278,6 +279,50 @@ def validate_report(rec) -> None:
     elif kind == "bench":
         if "metric" not in rec or "value" not in rec:
             problems.append("bench report: want metric and value fields")
+    elif kind == "schedule-audit":
+        # scripts/schedule_audit.py's cost-sheet + trace-audit report.
+        sheet = rec.get("cost_sheet")
+        if not isinstance(sheet, dict):
+            problems.append(
+                f"cost_sheet: want an object, got {sheet!r}"
+            )
+        else:
+            if not isinstance(sheet.get("buckets"), list):
+                problems.append("cost_sheet.buckets: want a list")
+            totals = sheet.get("totals")
+            if totals is not None and (
+                not isinstance(totals, dict)
+                or not isinstance(totals.get("launches"), int)
+                or not isinstance(totals.get("executables"), int)
+            ):
+                problems.append(
+                    "cost_sheet.totals: want launches/executables ints, "
+                    f"got {totals!r}"
+                )
+            pred = sheet.get("predicted_mfu_vs_feed_roofline")
+            if pred is not None and not isinstance(pred, (int, float)):
+                problems.append(
+                    "cost_sheet.predicted_mfu_vs_feed_roofline: want a "
+                    f"number or null, got {pred!r}"
+                )
+        audit = rec.get("trace_audit")
+        if not isinstance(audit, dict):
+            problems.append(f"trace_audit: want an object, got {audit!r}")
+        else:
+            if not isinstance(audit.get("buckets"), list):
+                problems.append("trace_audit.buckets: want a list")
+            don = audit.get("donation")
+            if not isinstance(don, dict) or "undonated_large_buffers" not in (
+                don or {}
+            ):
+                problems.append(
+                    "trace_audit.donation: want an object with "
+                    f"undonated_large_buffers, got {don!r}"
+                )
+        if not isinstance(rec.get("entry_points"), list):
+            problems.append(
+                f"entry_points: want a list, got {rec.get('entry_points')!r}"
+            )
     if problems:
         raise ValueError(
             "invalid run report: " + "; ".join(problems)

@@ -266,6 +266,16 @@ _SB_CONSTANTS = {
 }
 
 
+def model_constants(feed: str) -> tuple[float, float, float]:
+    """``(iteration-floor base s, per-sb floor slope s/sb, MAC rate
+    MACs/s)`` of the calibrated super-block cost model for ``feed`` —
+    the public read-only view for the analysis layer
+    (``analysis.costmodel`` prices whole schedules with the SAME
+    constants the chooser minimises, so chooser refits automatically
+    re-price the schedule prediction)."""
+    return _SB_CONSTANTS[feed]
+
+
 def _live_superblocks(nbn: int, sb: int, len1: int, l2: int) -> int:
     """Number of offset super-blocks the kernel executes for one pair:
     block 0 always runs; block j*sb (j >= 1) runs while j*sb*128 <

@@ -1,0 +1,192 @@
+"""The composed production bucket schedule, as a first-class object.
+
+``production_schedule`` used to live in ``bench.py``; it moved into the
+package so the trace-level analysis layer (``analysis.costmodel`` /
+``analysis.traceaudit``) can derive the EXACT schedule the production
+dispatch runs — buckets, chunk shapes, padded lens, resolved bodies —
+without importing the bench harness.  ``bench.py`` re-exports it, so
+the steady-state measurement, the FLOP/VPU accounting, and the static
+cost sheet all price one derivation (the r4 "the bench times and
+accounts exactly the production schedule" invariant, now extended to
+"…and the auditor audits exactly it" too).
+
+``kernel_configs`` additionally resolves each bucket's kernel-side
+decisions (formulation, MXU feed, super-block width, row-packing class)
+the same way the dispatch layer does at scoring time — the static facts
+the cost model prices and the AOT warm-set ranking is keyed on.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+
+def production_schedule(problem, backend: str):
+    """The bucket schedule the production dispatch would run for this
+    problem — one entry per length bucket (including the r4 row-packing
+    sub-classes) with its padded chunked rows and resolved chunks body.
+
+    SHARED by the steady-state harness (which times it), the MFU /
+    VPU-floor accounting (which counts it), and the static schedule
+    auditor (which prices it): a single derivation is the only way "the
+    bench times and accounts exactly the production schedule" stays
+    true (r4 code review).  Entries carry the PADDED per-chunk lens —
+    the packed kernel executes super-block 0 even for all-padding
+    tiles, and the accounting must count them.
+    """
+    from .dispatch import (
+        choose_chunk,
+        choose_pallas_formulation,
+        DEFAULT_CHUNK_BUDGET,
+        effective_backend,
+        pack_classes,
+        pad_batch_rows,
+        pad_problem,
+        plan_buckets,
+        resolve_chunks_body,
+        round_up,
+    )
+    from .values import max_abs_value, value_table
+
+    val = value_table(problem.weights).astype(np.int32).reshape(-1)
+    # Row packing only applies to 128-row buckets, so gate the packing
+    # sub-classes on the l2p=128 formulation (mirrors score_codes_async).
+    packable = False
+    classes: tuple = ()
+    if backend == "pallas":
+        fm = choose_pallas_formulation(val, (), 128)
+        if fm[0] == "pallas":
+            classes = pack_classes(fm[1], max_abs_value(val))
+            packable = bool(classes)
+    groups = plan_buckets(
+        [c.size for c in problem.seq2_codes],
+        packable=packable,
+        classes=classes or (8, 16, 32, 64),
+    )
+    sched = []
+    for key in sorted(groups):
+        codes = [problem.seq2_codes[i] for i in groups[key]]
+        batch = pad_problem(problem.seq1_codes, codes)
+        # Same chunk policy the dispatch layer applies: pallas-sized
+        # chunks only when the kernel actually runs (wide weights route
+        # to gather).
+        cb = choose_chunk(
+            batch,
+            DEFAULT_CHUNK_BUDGET,
+            backend=effective_backend(backend, val, batch.l2p),
+        )
+        bp = round_up(batch.batch_size, cb)
+        rows, lens = pad_batch_rows(batch, bp)
+        body = resolve_chunks_body(
+            backend,
+            val,
+            problem_dims=(batch.l1p, batch.l2p, batch.len1, batch.len2),
+        )
+        sched.append(
+            {
+                "batch": batch,
+                "cb": cb,
+                "rows": rows.reshape(bp // cb, cb, batch.l2p),
+                "lens": lens.reshape(bp // cb, cb),
+                "body": body,
+            }
+        )
+    return val, sched
+
+
+@dataclasses.dataclass(frozen=True)
+class BucketKernelConfig:
+    """The static kernel-side facts of ONE bucket of the production
+    schedule — everything the dispatch layer decides before tracing,
+    i.e. exactly what an AOT compile cache would key an executable on
+    (plus the chunk walk the cost model prices)."""
+
+    l1p: int
+    l2p: int
+    len1: int
+    cb: int  # chunk batch (rows per kernel launch)
+    n_chunks: int  # launches this bucket contributes per dispatch
+    formulation: str  # 'pallas' | 'xla-gather' | 'xla-mm'
+    feed: str | None  # MXU feed; None off the fused kernel
+    sb: int | None  # offset-super-block width
+    l2s: int | None  # row-packing class (packed kernel) or None
+    chunk_lens: tuple  # per-chunk PADDED lens, tuple of int tuples
+
+    @property
+    def cache_key(self) -> tuple:
+        """The executable identity: one compiled program per distinct
+        key across the schedule (shape bucket x kernel decisions)."""
+        return (
+            self.formulation, self.feed, self.l1p, self.l2p, self.cb,
+            self.sb, self.l2s,
+        )
+
+
+def kernel_configs(problem, backend: str, buckets: bool = True):
+    """Resolve the per-bucket kernel decisions of ``problem``'s
+    production schedule, exactly as the dispatch layer would.
+
+    ``buckets=False`` describes the UNBUCKETED whole-batch program
+    instead (one entry), mirroring ``bench.kernel_floor_counts``'s
+    single-program accounting.  Returns ``None`` when any bucket falls
+    off the fused kernel (wide weights / unaligned shapes) — counts for
+    work that never runs must not be recorded.
+    """
+    from .dispatch import (
+        DEFAULT_CHUNK_BUDGET,
+        choose_chunk,
+        choose_pallas_formulation,
+        choose_rowpack,
+        effective_backend,
+        pad_batch_rows,
+        pad_problem,
+        round_up,
+    )
+    from .pallas_scorer import choose_superblock
+    from .values import max_abs_value, value_table
+
+    val_flat = value_table(problem.weights).reshape(-1)
+    if buckets:
+        _, sched = production_schedule(problem, backend)
+        parts = [(p["batch"], np.asarray(p["lens"])) for p in sched]
+    else:
+        batch = pad_problem(problem.seq1_codes, problem.seq2_codes)
+        cb = choose_chunk(
+            batch, DEFAULT_CHUNK_BUDGET,
+            backend=effective_backend(backend, val_flat, batch.l2p),
+        )
+        bp = round_up(batch.batch_size, cb)
+        _, lens = pad_batch_rows(batch, bp)
+        parts = [(batch, lens.reshape(bp // cb, cb))]
+
+    configs = []
+    maxv = max_abs_value(val_flat)
+    for sub, lens_chunks in parts:
+        fm = choose_pallas_formulation(val_flat, (sub.l1p, sub.l2p), sub.l2p)
+        if fm[0] != "pallas":
+            return None
+        feed = fm[1]
+        sb = choose_superblock(
+            sub.l1p // 128, sub.l2p // 128, sub.len1, sub.len2, feed
+        )
+        l2s = choose_rowpack(feed, sub.l2p, sub.len2, maxv=maxv)
+        chunk_lens = tuple(
+            tuple(int(x) for x in chunk) for chunk in lens_chunks
+        )
+        configs.append(
+            BucketKernelConfig(
+                l1p=int(sub.l1p),
+                l2p=int(sub.l2p),
+                len1=int(sub.len1),
+                cb=int(lens_chunks.shape[1]),
+                n_chunks=int(lens_chunks.shape[0]),
+                formulation=fm[0],
+                feed=feed,
+                sb=sb,
+                l2s=l2s,
+                chunk_lens=chunk_lens,
+            )
+        )
+    return configs

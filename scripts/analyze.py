@@ -1,19 +1,28 @@
 #!/usr/bin/env python3
 """`make analyze` driver: run the full static-analysis gate on CPU.
 
-Four passes (docs/ARCHITECTURE.md §9), in cheapest-first order so the
+Six passes (docs/ARCHITECTURE.md §9), in cheapest-first order so the
 common failure (a lint regression) reports before jax even imports:
 
 1. seqlint        — repo-specific AST rules over the package tree.
 2. VMEM audit     — exhaustive sweep of every kernel config the
                     dispatch choosers can emit vs the per-core budget.
-3. contract audit — jax.eval_shape over every registered scorer entry
+3. cost model     — the same emittable space priced by the calibrated
+                    iteration model (analysis/costmodel.py): every
+                    config must cost finite and positive, and the
+                    default schedule must yield a prediction.
+4. contract audit — jax.eval_shape over every registered scorer entry
                     point (the shard_map wrapper needs a mesh, hence
                     the 8-virtual-device CPU backend forced below).
-4. ruff / mypy    — only when installed (the container may not ship
+5. trace audit    — lower every entry point and walk the jaxpr for
+                    host transfers, convert widenings, donation
+                    coverage, and pallas-launch structure
+                    (analysis/traceaudit.py; golden drift gating lives
+                    in scripts/schedule_audit.py).
+6. ruff / mypy    — only when installed (the container may not ship
                     them); the baselines live in pyproject.toml.
 
-Exit 0 iff every pass is clean.  Runs in a few seconds, no TPU.
+Exit 0 iff every pass is clean.  Runs in under a minute, no TPU.
 """
 
 from __future__ import annotations
@@ -61,6 +70,33 @@ def main() -> int:
         print(f"  {worst.describe()}")
         print(f"  headroom {worst.headroom_bytes / (1 << 20):.2f} MiB")
 
+    print("\n== cost model ==")
+    try:
+        from mpi_openmp_cuda_tpu.analysis import costmodel
+        from mpi_openmp_cuda_tpu.models.workload import input3_class_problem
+
+        n, best = costmodel.audit_config_space()
+        sheet = costmodel.schedule_cost_sheet(input3_class_problem(), "pallas")
+        pred = sheet["predicted_mfu_vs_feed_roofline"]
+        if pred is None or not 0.0 < pred <= 1.0:
+            raise SeqcheckError(
+                f"default input3-class schedule prediction is {pred!r}, "
+                "want a ratio in (0, 1]: the cost model and the schedule "
+                "derivation have drifted apart (analysis/costmodel.py)"
+            )
+    except SeqcheckError as exc:
+        print(exc)
+        failures += 1
+    else:
+        print(f"clean: {n} emittable configs priced; best MFU bound:")
+        print(f"  {best.describe()}")
+        totals = sheet["totals"]
+        print(
+            f"  default schedule: {totals['launches']} launches, "
+            f"{totals['executables']} executables, "
+            f"predicted mfu_vs_feed_roofline {pred}"
+        )
+
     print("\n== entry-point contracts ==")
     try:
         rows = contracts.audit_entry_points()
@@ -71,6 +107,31 @@ def main() -> int:
         for row in rows:
             print(f"  {row}")
         print(f"clean: {len(rows)} contract x bucket evaluations")
+
+    print("\n== trace audit ==")
+    try:
+        from mpi_openmp_cuda_tpu.analysis import traceaudit
+
+        reports = traceaudit.audit_entry_points()
+    except SeqcheckError as exc:
+        print(exc)
+        failures += 1
+    else:
+        undonated = 0
+        for rep in reports:
+            undonated += len(rep.undonated_large)
+            print(
+                f"  {rep.entry:<45s} bucket={str(rep.bucket):<22s} "
+                f"pallas={rep.pallas_calls} widen={rep.convert_widenings} "
+                f"undonated_large={len(rep.undonated_large)}"
+            )
+        # Donation coverage is REPORTED, not asserted: the honest
+        # current state is zero donation, and the drift gate on the
+        # count lives in the schedule-audit golden.
+        print(
+            f"clean: {len(reports)} lowers, 0 host transfers; "
+            f"{undonated} un-donated large buffers listed"
+        )
 
     # Optional generic tooling: gate on availability, never on import —
     # the deployment container does not ship ruff/mypy.

@@ -1,0 +1,258 @@
+#!/usr/bin/env python3
+"""`make schedule-audit` driver: the trace-level schedule gate on CPU.
+
+Builds the deterministic input3-class synthetic workload
+(``models/workload.py`` — never ``BENCH_INPUT``, so the committed
+golden is environment-independent), then:
+
+1. prices its composed production bucket schedule with the static cost
+   model (``analysis/costmodel.py``): FLOPs, minimum bytes moved,
+   launch count, distinct executables, modelled kernel wall, and the
+   ``predicted_mfu_vs_feed_roofline`` bench.py emits next to the
+   measured number;
+2. trace-audits the schedule and the five registered entry points
+   (``analysis/traceaudit.py``): donation coverage (every un-donated
+   large buffer LISTED), convert widenings, host transfers, and the
+   one-pallas-call-per-chunk launch structure;
+3. wraps both in the versioned run-report envelope
+   (``obs.metrics.wrap_report(kind="schedule-audit")``), validates the
+   schema, and diffs the stable fields against the committed golden
+   (``tests/golden/schedule_audit.json``).
+
+Drift in the golden fields (launch count, executables, predicted MFU,
+per-bucket configs, donation coverage, widening counts) exits 1 with a
+field-by-field diff: either a deliberate schedule/kernel change —
+regenerate with ``--update`` and commit the new baseline alongside the
+change that explains it — or a regression caught before hardware.
+
+Exit 0 iff the report is schema-valid and matches the golden.
+CPU-only, zero devices, tens of seconds.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+# Force the CPU backend with enough virtual devices for the shard_map
+# entry point BEFORE jax initialises (same idiom as scripts/analyze.py).
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+GOLDEN_PATH = os.path.join(REPO, "tests", "golden", "schedule_audit.json")
+BACKEND = "pallas"
+
+
+def build_report() -> dict:
+    """The full enveloped schedule-audit report (deterministic: pure
+    host arithmetic + CPU lowering of the synthetic workload)."""
+    from mpi_openmp_cuda_tpu.analysis.costmodel import schedule_cost_sheet
+    from mpi_openmp_cuda_tpu.analysis.traceaudit import (
+        audit_entry_points,
+        audit_schedule,
+    )
+    from mpi_openmp_cuda_tpu.models.workload import (
+        INPUT3_CLASS_NAME,
+        input3_class_problem,
+    )
+    from mpi_openmp_cuda_tpu.obs.metrics import wrap_report
+
+    problem = input3_class_problem()
+    sheet = schedule_cost_sheet(problem, BACKEND)
+    trace = audit_schedule(problem, BACKEND)
+    entries = [
+        {
+            "entry": rep.entry,
+            "bucket": list(rep.bucket),
+            "pallas_calls": rep.pallas_calls,
+            "convert_widenings": rep.convert_widenings,
+            "device_puts": rep.device_puts,
+            "large_buffers": len(rep.large_buffers),
+            "undonated_large_buffers": [
+                b.describe() for b in rep.undonated_large
+            ],
+        }
+        for rep in audit_entry_points()
+    ]
+    return wrap_report(
+        "schedule-audit",
+        {
+            "workload": INPUT3_CLASS_NAME,
+            "cost_sheet": sheet,
+            "trace_audit": trace,
+            "entry_points": entries,
+        },
+    )
+
+
+def golden_view(report: dict) -> dict:
+    """The drift-gated subset: every field here is a static fact of the
+    schedule/kernels (no walls, no clocks), so any change is a real
+    schedule or model change that must be explained by a commit."""
+    sheet = report["cost_sheet"]
+    trace = report["trace_audit"]
+    return {
+        "workload": report["workload"],
+        "feed": sheet["feed"],
+        "launches": sheet["totals"]["launches"],
+        "executables": sheet["totals"]["executables"],
+        "predicted_mfu_vs_feed_roofline": sheet[
+            "predicted_mfu_vs_feed_roofline"
+        ],
+        "buckets": [
+            {
+                k: b[k]
+                for k in (
+                    "l1p", "l2p", "cb", "launches", "formulation", "feed",
+                    "sb", "l2s", "mxu_flops",
+                )
+            }
+            for b in sheet["buckets"]
+        ],
+        "hot_configs": [
+            {k: r[k] for k in ("rank", "l1p", "l2p", "cb", "sb", "l2s")}
+            for r in sheet["hot_configs"]
+        ],
+        "trace_launches": trace["launches"],
+        "trace_executables": trace["executables"],
+        "donation": trace["donation"],
+        "bucket_widenings": [
+            b["convert_widenings"] for b in trace["buckets"]
+        ],
+        "entry_widenings": {
+            f"{e['entry']}@{tuple(e['bucket'])}": e["convert_widenings"]
+            for e in report["entry_points"]
+        },
+        "entry_undonated": {
+            f"{e['entry']}@{tuple(e['bucket'])}": len(
+                e["undonated_large_buffers"]
+            )
+            for e in report["entry_points"]
+        },
+    }
+
+
+def diff_views(want: dict, got: dict) -> list[str]:
+    """Field-by-field drift rows (empty = match)."""
+    rows: list[str] = []
+    for key in sorted(set(want) | set(got)):
+        w, g = want.get(key), got.get(key)
+        if w != g:
+            rows.append(f"  {key}: golden {json.dumps(w)} != got {json.dumps(g)}")
+    return rows
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument(
+        "--update",
+        action="store_true",
+        help="rewrite the committed golden baseline from this run "
+        "(commit it together with the change that explains the drift)",
+    )
+    parser.add_argument(
+        "--out",
+        default=None,
+        help="also write the full enveloped report JSON to this path",
+    )
+    args = parser.parse_args()
+
+    from mpi_openmp_cuda_tpu.obs.metrics import validate_report
+
+    report = build_report()
+    print("== schema ==")
+    try:
+        validate_report(report)
+    except ValueError as exc:
+        print(f"FAIL: {exc}")
+        return 1
+    print("valid: kind=schedule-audit")
+
+    sheet = report["cost_sheet"]
+    trace = report["trace_audit"]
+    totals = sheet["totals"]
+    print("\n== cost sheet ==")
+    print(
+        f"feed={sheet['feed']} launches={totals['launches']} "
+        f"executables={totals['executables']} "
+        f"model_kernel_us={totals['model_kernel_us']} "
+        f"predicted_wall_us={totals['predicted_wall_us']}"
+    )
+    print(
+        f"predicted_mfu_vs_feed_roofline="
+        f"{sheet['predicted_mfu_vs_feed_roofline']} "
+        f"(roofline {sheet['feed_roofline_tflops']} TFLOP/s)"
+    )
+    for r in sheet["hot_configs"]:
+        print(
+            f"  hot#{r['rank']}: l1p={r['l1p']} l2p={r['l2p']} "
+            f"cb={r['cb']} sb={r['sb']} l2s={r['l2s']} "
+            f"share={r['wall_share']}"
+        )
+
+    print("\n== trace audit ==")
+    don = trace["donation"]
+    print(
+        f"launches={trace['launches']} executables={trace['executables']} "
+        f"large_buffers={don['large_buffers']} "
+        f"undonated={don['undonated_large_buffers']}"
+    )
+    # The acceptance bar: un-donated large buffers are LISTED, never
+    # silently passed.
+    for b in trace["buckets"]:
+        for row in b["undonated_large_buffers"]:
+            print(f"  bucket {b['bucket']}: {row}")
+    for e in report["entry_points"]:
+        for row in e["undonated_large_buffers"]:
+            print(f"  {e['entry']} {tuple(e['bucket'])}: {row}")
+
+    if args.out:
+        with open(args.out, "w") as fh:
+            json.dump(report, fh, indent=1, sort_keys=True)
+            fh.write("\n")
+        print(f"\nreport written to {args.out}")
+
+    view = golden_view(report)
+    if args.update:
+        os.makedirs(os.path.dirname(GOLDEN_PATH), exist_ok=True)
+        with open(GOLDEN_PATH, "w") as fh:
+            json.dump(view, fh, indent=1, sort_keys=True)
+            fh.write("\n")
+        print(f"\ngolden updated: {GOLDEN_PATH}")
+        return 0
+
+    print("\n== golden drift ==")
+    if not os.path.exists(GOLDEN_PATH):
+        print(
+            f"FAIL: no committed golden at {GOLDEN_PATH} "
+            "(run scripts/schedule_audit.py --update and commit it)"
+        )
+        return 1
+    with open(GOLDEN_PATH) as fh:
+        want = json.load(fh)
+    rows = diff_views(want, view)
+    if rows:
+        print(f"FAIL: {len(rows)} field(s) drifted from the golden:")
+        print("\n".join(rows))
+        print(
+            "either fix the regression, or regenerate deliberately with "
+            "scripts/schedule_audit.py --update and commit the new "
+            "baseline with the change that explains it"
+        )
+        return 1
+    print("match: schedule audit equals the committed golden")
+    print("\nschedule-audit: OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

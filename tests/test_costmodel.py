@@ -1,0 +1,284 @@
+"""Static cost model + trace audit tests (PR 7).
+
+The cheap tier pins the COMMITTED facts of the default input3-class
+bucketed schedule — pure host arithmetic, no lowering, milliseconds —
+so a chooser/model change that silently moves the predicted MFU or the
+launch count fails here AND in `make schedule-audit`'s golden diff.
+The jaxpr-walk unit tests trace tiny pure-jnp functions (no pallas
+compile).  Lowering the real schedule/entry points is slow-marked (it
+shares `make schedule-audit`'s work, ~15 s of interpret-mode lowering),
+and the predicted-vs-measured tolerance test runs only on real TPU.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import pathlib
+
+import jax
+import numpy as np
+import pytest
+
+from mpi_openmp_cuda_tpu.analysis import CostModelError, costmodel, traceaudit
+from mpi_openmp_cuda_tpu.models.workload import (
+    INPUT3_CLASS_NAME,
+    input3_class_problem,
+)
+from mpi_openmp_cuda_tpu.obs.metrics import validate_report, wrap_report
+
+GOLDEN_PATH = (
+    pathlib.Path(__file__).parent / "golden" / "schedule_audit.json"
+)
+
+#: The committed facts of the default input3-class schedule.  Moving
+#: any of these is a real chooser/model change: regenerate the golden
+#: with `scripts/schedule_audit.py --update` and update HERE, in the
+#: same commit that explains the drift.
+GOLDEN_FEED = "i8"
+GOLDEN_LAUNCHES = 4
+GOLDEN_EXECUTABLES = 4
+GOLDEN_PREDICTED_MFU = 0.446
+GOLDEN_BUCKETS = [  # (l1p, l2p, cb, sb)
+    (1536, 384, 16, 12),
+    (1536, 640, 16, 12),
+    (1536, 1024, 8, 6),
+    (1536, 1152, 8, 3),
+]
+
+
+@pytest.fixture(scope="module")
+def sheet():
+    return costmodel.schedule_cost_sheet(input3_class_problem(), "pallas")
+
+
+class TestConfigCosts:
+    def test_sweep_prices_every_emittable_config(self):
+        costs = list(costmodel.sweep_config_costs())
+        assert len(costs) > 1000  # the full chooser space, not a sample
+        for c in costs:
+            assert math.isfinite(c.model_wall_s) and c.model_wall_s > 0, (
+                c.describe()
+            )
+            assert 0.0 < c.mfu_bound <= 1.0, c.describe()
+
+    def test_audit_config_space(self):
+        n, best = costmodel.audit_config_space()
+        assert n == sum(1 for _ in costmodel.sweep_config_costs())
+        assert 0.0 < best.mfu_bound <= 1.0
+        assert "mfu<=" in best.describe()
+
+    def test_config_cost_unpacked_and_packed(self):
+        unpacked = costmodel.config_cost(12, 3, "i8", 12)
+        packed = costmodel.config_cost(12, 3, "i8", 12, l2s=128)
+        assert unpacked.kind == "unpacked" and packed.kind == "packed"
+        assert unpacked.flops > 0 and packed.flops > 0
+        assert unpacked.vmem_bytes > 0 and packed.vmem_bytes > 0
+
+    def test_unknown_feed_raises(self):
+        with pytest.raises((CostModelError, KeyError)):
+            costmodel.config_cost(12, 3, "f64", 12)
+
+
+class TestScheduleCostSheetGolden:
+    def test_feed_and_counts(self, sheet):
+        assert sheet["feed"] == GOLDEN_FEED
+        assert sheet["totals"]["launches"] == GOLDEN_LAUNCHES
+        assert sheet["totals"]["executables"] == GOLDEN_EXECUTABLES
+
+    def test_predicted_mfu_pin(self, sheet):
+        # The headline number bench.py emits next to the measured MFU.
+        # Predicted 0.446 vs measured ~0.217 (BENCH_r05) is the
+        # deliberately unfitted between-kernel loss (ROADMAP item 2) —
+        # the model prices kernels + nominal launch overhead only.
+        assert sheet["predicted_mfu_vs_feed_roofline"] == GOLDEN_PREDICTED_MFU
+
+    def test_bucket_configs_pin(self, sheet):
+        got = [
+            (b["l1p"], b["l2p"], b["cb"], b["sb"]) for b in sheet["buckets"]
+        ]
+        assert got == GOLDEN_BUCKETS
+        assert all(b["formulation"] == "pallas" for b in sheet["buckets"])
+        assert all(b["l2s"] is None for b in sheet["buckets"])  # no packing
+
+    def test_hot_configs_ranked(self, sheet):
+        hot = sheet["hot_configs"]
+        assert [r["rank"] for r in hot] == list(range(1, len(hot) + 1))
+        shares = [r["wall_share"] for r in hot]
+        assert shares == sorted(shares, reverse=True)
+        assert abs(sum(shares) - 1.0) < 0.02  # shares partition the wall
+
+    def test_sheet_is_json_ready(self, sheet):
+        json.dumps(sheet)
+
+    def test_committed_golden_agrees(self, sheet):
+        # The same facts, read back from the file `make schedule-audit`
+        # diffs against: the test pin and the golden cannot drift apart.
+        want = json.loads(GOLDEN_PATH.read_text())
+        assert want["workload"] == INPUT3_CLASS_NAME
+        assert want["feed"] == sheet["feed"]
+        assert want["launches"] == sheet["totals"]["launches"]
+        assert want["executables"] == sheet["totals"]["executables"]
+        assert (
+            want["predicted_mfu_vs_feed_roofline"]
+            == sheet["predicted_mfu_vs_feed_roofline"]
+        )
+        assert [
+            (b["l1p"], b["l2p"], b["cb"], b["sb"]) for b in want["buckets"]
+        ] == GOLDEN_BUCKETS
+
+    def test_scalar_accessor(self):
+        pred = costmodel.predicted_mfu_vs_feed_roofline(
+            input3_class_problem(), "pallas"
+        )
+        assert pred == GOLDEN_PREDICTED_MFU
+
+
+class TestTraceWalk:
+    def test_widening_counted(self):
+        def widen(x):
+            return x.astype(np.float32) * 2.0
+
+        x = jax.ShapeDtypeStruct((8, 8), np.int8)
+        counts = traceaudit.walk_counts(widen, x)
+        assert counts["convert_widenings"] == 1
+        assert counts["pallas_calls"] == 0
+
+    def test_narrowing_not_counted(self):
+        def narrow(x):
+            return x.astype(np.int8)
+
+        x = jax.ShapeDtypeStruct((8, 8), np.float32)
+        counts = traceaudit.walk_counts(narrow, x)
+        assert counts["convert_widenings"] == 0
+
+    def test_nested_jaxpr_walked(self):
+        @jax.jit
+        def inner(x):
+            return x.astype(np.float32)
+
+        def outer(x):
+            return inner(x) + 1.0
+
+        x = jax.ShapeDtypeStruct((8, 8), np.int8)
+        counts = traceaudit.walk_counts(outer, x)
+        assert counts["convert_widenings"] == 1  # inside the pjit body
+
+
+class TestDonationAudit:
+    # 128x128 int32 = 64 KiB: comfortably over LARGE_BUFFER_BYTES.
+    _ARG = jax.ShapeDtypeStruct((128, 128), np.int32)
+
+    def test_undonated_large_buffer_listed(self):
+        infos = traceaudit.buffer_infos(lambda x: x + 1, self._ARG)
+        (large,) = [i for i in infos if i.nbytes >= traceaudit.LARGE_BUFFER_BYTES]
+        assert not large.donated
+        assert "UNDONATED" in large.describe()
+
+    def test_donated_buffer_marked(self):
+        infos = traceaudit.buffer_infos(
+            lambda x: x + 1, self._ARG, donate_argnums=(0,)
+        )
+        (large,) = [i for i in infos if i.nbytes >= traceaudit.LARGE_BUFFER_BYTES]
+        assert large.donated
+        assert "donated" in large.describe()
+
+    def test_small_buffers_below_threshold(self):
+        small = jax.ShapeDtypeStruct((4,), np.int32)
+        infos = traceaudit.buffer_infos(lambda x: x + 1, small)
+        assert all(i.nbytes < traceaudit.LARGE_BUFFER_BYTES for i in infos)
+
+
+class TestScheduleAuditReportSchema:
+    def _body(self):
+        return {
+            "workload": INPUT3_CLASS_NAME,
+            "cost_sheet": {
+                "buckets": [],
+                "totals": {"launches": 4, "executables": 4},
+                "predicted_mfu_vs_feed_roofline": 0.446,
+            },
+            "trace_audit": {
+                "buckets": [],
+                "donation": {"undonated_large_buffers": 4},
+            },
+            "entry_points": [],
+        }
+
+    def test_valid_report_passes(self):
+        validate_report(wrap_report("schedule-audit", self._body()))
+
+    def test_null_prediction_is_legal(self):
+        body = self._body()
+        body["cost_sheet"]["predicted_mfu_vs_feed_roofline"] = None
+        validate_report(wrap_report("schedule-audit", body))
+
+    @pytest.mark.parametrize(
+        "mutate",
+        [
+            lambda b: b.pop("cost_sheet"),
+            lambda b: b.pop("trace_audit"),
+            lambda b: b.pop("entry_points"),
+            lambda b: b["cost_sheet"].__setitem__("buckets", "nope"),
+            lambda b: b["cost_sheet"]["totals"].__setitem__("launches", "4"),
+            lambda b: b["cost_sheet"].__setitem__(
+                "predicted_mfu_vs_feed_roofline", "0.446"
+            ),
+            lambda b: b["trace_audit"].__setitem__("donation", {}),
+        ],
+    )
+    def test_malformed_reports_rejected(self, mutate):
+        body = self._body()
+        mutate(body)
+        with pytest.raises(ValueError, match="invalid run report"):
+            validate_report(wrap_report("schedule-audit", body))
+
+
+@pytest.mark.slow
+class TestScheduleTraceSlow:
+    """Lowers the real bucket bodies (interpret-mode pallas): slow tier
+    only — the default tier's compile budget is the scarce resource
+    (conftest header), and `make schedule-audit` runs this same audit
+    against the committed golden anyway."""
+
+    def test_trace_matches_cost_sheet(self):
+        problem = input3_class_problem()
+        sheet = costmodel.schedule_cost_sheet(problem, "pallas")
+        trace = traceaudit.audit_schedule(problem, "pallas")
+        assert trace["launches"] == sheet["totals"]["launches"]
+        assert trace["executables"] == sheet["totals"]["executables"]
+        for b in trace["buckets"]:
+            assert b["pallas_calls_per_chunk"] == 1
+            assert b["device_puts"] == 0
+        # The acceptance bar: un-donated large buffers are LISTED.
+        don = trace["donation"]
+        listed = [
+            row for b in trace["buckets"] for row in b["undonated_large_buffers"]
+        ]
+        assert len(listed) == don["undonated_large_buffers"] > 0
+        assert all("UNDONATED" in row for row in listed)
+        assert not don["covered"]
+
+
+@pytest.mark.slow
+class TestPredictedVsMeasuredTPU:
+    """Model-vs-hardware tolerance: real TPU only (interpret-mode walls
+    measure the CPU emulator, not the machine the model prices)."""
+
+    def test_predicted_within_tolerance_of_measured(self):
+        if jax.default_backend() != "tpu":
+            pytest.skip("predicted-vs-measured MFU needs a real TPU")
+        import bench
+
+        problem = input3_class_problem()
+        backend = "pallas"
+        pred = costmodel.predicted_mfu_vs_feed_roofline(problem, backend)
+        assert pred is not None
+        wall = bench.steady_state_wall(problem, backend, reps=32, medians=3)
+        flops, _, feed = bench.kernel_floor_counts(problem, backend)
+        roof = costmodel.FEED_ROOFLINE_TFLOPS[feed] * 1e12
+        measured = flops / wall / roof
+        # Generous by design: the gap IS the unfitted between-kernel
+        # loss the roadmap tracks.  The gate catches order-of-magnitude
+        # model rot, not the loss itself.
+        assert measured / 4 <= pred <= measured * 4, (pred, measured)

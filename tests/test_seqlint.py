@@ -280,6 +280,181 @@ class TestSeq007BlockingWaits:
         assert "SEQ005" in [f.code for f in findings]
 
 
+class TestSeq008SharedState:
+    def test_unguarded_mutation_in_guarded_class(self, tmp_path):
+        findings = _lint_snippet(
+            tmp_path,
+            "serve/foo.py",
+            """
+            import threading
+
+            class Q:
+                def __init__(self):
+                    self._cond = threading.Condition()
+                    self._items = []
+
+                def submit(self, x):
+                    self._items.append(x)
+            """,
+        )
+        assert [f.code for f in findings] == ["SEQ008"]
+        assert "json.loads" in findings[0].message  # the reader contract
+
+    def test_guarded_mutation_is_clean(self, tmp_path):
+        assert not _lint_snippet(
+            tmp_path,
+            "serve/foo.py",
+            """
+            import threading
+
+            class Q:
+                def __init__(self):
+                    self._cond = threading.Condition()
+                    self._items = []
+
+                def submit(self, x):
+                    with self._cond:
+                        self._items.append(x)
+                        self._seq = 1
+            """,
+        )
+
+    def test_tuple_and_slice_targets_are_mutations(self, tmp_path):
+        # The pop idiom: `popped, self._items[:n] = self._items[:n], []`
+        # rebinding through a tuple/slice target is still shared-state
+        # mutation and must hold the lock.
+        findings = _lint_snippet(
+            tmp_path,
+            "serve/foo.py",
+            """
+            import threading
+
+            class Q:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._items = []
+
+                def pop(self, n):
+                    popped, self._items[:n] = self._items[:n], []
+                    return popped
+            """,
+        )
+        assert [f.code for f in findings] == ["SEQ008"]
+
+    def test_init_is_exempt(self, tmp_path):
+        # Construction happens before the object is shared; __init__
+        # assigns freely (that is where the guard itself is born).
+        assert not _lint_snippet(
+            tmp_path,
+            "serve/foo.py",
+            """
+            import threading
+
+            class Q:
+                def __init__(self, depth):
+                    self._cond = threading.Condition()
+                    self.max_depth = int(depth)
+                    self._items = []
+            """,
+        )
+
+    def test_unguarded_class_is_out_of_scope(self, tmp_path):
+        # Session-style classes confined to the main loop thread own no
+        # lock — SEQ008 only polices classes that DECLARE a guard.
+        assert not _lint_snippet(
+            tmp_path,
+            "serve/foo.py",
+            """
+            class Session:
+                def fill(self, j, row):
+                    self._have[j] = True
+                    self._emitted += 1
+            """,
+        )
+
+    def test_outside_serve_is_out_of_scope(self, tmp_path):
+        assert not _lint_snippet(
+            tmp_path,
+            "resilience/foo.py",
+            """
+            import threading
+
+            class Q:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._items = []
+
+                def submit(self, x):
+                    self._items.append(x)
+            """,
+        )
+
+    def test_mutator_method_call_flagged(self, tmp_path):
+        findings = _lint_snippet(
+            tmp_path,
+            "serve/foo.py",
+            """
+            import threading
+
+            class Q:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._seen = set()
+
+                def mark(self, x):
+                    self._seen.add(x)
+            """,
+        )
+        assert [f.code for f in findings] == ["SEQ008"]
+
+
+class TestModuleClassification:
+    def test_every_package_module_is_classified(self):
+        # SEQ009's real-tree contract: a module the registry does not
+        # know about escapes every scoped rule — adding a module MUST
+        # come with a deliberate classification.
+        from pathlib import Path
+
+        root = Path(seqlint.__file__).resolve().parent.parent
+        for path in sorted(root.rglob("*.py")):
+            if "__pycache__" in path.parts:
+                continue
+            rel = str(path.relative_to(root.parent))
+            assert seqlint.module_roles(rel) is not None, rel
+
+    def test_unclassified_module_is_a_finding(self, tmp_path):
+        findings = _lint_snippet(tmp_path, "rogue.py", "x = 1\n")
+        assert [f.code for f in findings] == ["SEQ009"]
+        assert "_MODULE_CLASSES" in findings[0].message
+
+    def test_pr6_modules_are_now_classified(self):
+        # The drift this registry exists to fix: PR 6 shipped these
+        # without touching any rule list.
+        assert seqlint.module_roles("pkg/io/pipeline.py") == (
+            seqlint.ROLE_INSTRUMENTED,
+        )
+        assert seqlint.ROLE_SERVE in seqlint.module_roles(
+            "pkg/serve/loop.py"
+        )
+        assert seqlint.ROLE_INSTRUMENTED in seqlint.module_roles(
+            "pkg/serve/session.py"
+        )
+        assert seqlint.ROLE_DETERMINISTIC in seqlint.module_roles(
+            "pkg/serve/queue.py"
+        )
+        assert seqlint.module_roles("pkg/serve/clock.py") == (
+            seqlint.ROLE_WAIT_HOME,
+        )
+
+    def test_exact_entry_overrides_directory(self):
+        assert seqlint.ROLE_INSTRUMENTED in seqlint.module_roles(
+            "pkg/ops/dispatch.py"
+        )
+        assert seqlint.module_roles("pkg/ops/other.py") == (
+            seqlint.ROLE_TRACED,
+        )
+
+
 class TestSuppressions:
     def test_per_line_disable(self, tmp_path):
         assert not _lint_snippet(
