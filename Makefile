@@ -18,7 +18,7 @@ PY_CFLAGS  := $(shell $(PYCONFIG) --includes)
 PY_LDFLAGS := $(shell $(PYCONFIG) --ldflags --embed)
 INPUT      ?= /root/reference/input5.txt
 
-.PHONY: build run run2 runOn2 test chaos chaos-kill analyze schedule-audit metrics-smoke serve-smoke bench bench-table bench-gather check clean
+.PHONY: build run run2 runOn2 test chaos chaos-kill analyze schedule-audit metrics-smoke serve-smoke aot-smoke bench bench-table bench-gather check clean
 
 build: final
 
@@ -120,6 +120,15 @@ metrics-smoke:
 # schema.  CPU-only, seconds.
 serve-smoke:
 	JAX_PLATFORMS=cpu $(PYTHON) scripts/serve_smoke.py
+
+# AOT warm-plane smoke gate (docs/ARCHITECTURE.md §13): cross-check the
+# warm set against the committed hot-config ranking, populate a
+# throwaway cache with a real --prewarm batch subprocess (gate the
+# manifest), then RESTART into --serve --prewarm and hard-gate
+# steady_compiles == 0 from tick 0 — the restarted process answers its
+# first request with zero backend compiles.  CPU-only, seconds.
+aot-smoke:
+	JAX_PLATFORMS=cpu $(PYTHON) scripts/prewarm_smoke.py
 
 # Full coverage in TWO pytest processes: the fast tier, then the
 # slow-marked tests alone.  A single combined process segfaults jaxlib's

@@ -94,7 +94,9 @@ ROLE_HOST = "host"  # plain host-side module; only SEQ002/SEQ004 apply
 #: touching these lists; this registry turns that into a failure).
 _MODULE_CLASSES: dict[str, tuple[str, ...]] = {
     # -- exact files (override the directory default) ----------------------
-    "utils/platform.py": (ROLE_ENV_HOME,),
+    # platform.py is also INSTRUMENTED since the AOT plane: its cache-
+    # disabled warning rides the event bus (SEQ006), not bare stderr.
+    "utils/platform.py": (ROLE_ENV_HOME, ROLE_INSTRUMENTED),
     "utils/journal.py": (ROLE_DETERMINISTIC, ROLE_INSTRUMENTED),
     "ops/dispatch.py": (ROLE_TRACED, ROLE_INSTRUMENTED),
     "parallel/distributed.py": (ROLE_TRACED, ROLE_INSTRUMENTED),
@@ -104,6 +106,10 @@ _MODULE_CLASSES: dict[str, tuple[str, ...]] = {
     "serve/loop.py": (ROLE_SERVE, ROLE_INSTRUMENTED),
     "serve/session.py": (ROLE_SERVE, ROLE_INSTRUMENTED),
     # -- directory defaults ------------------------------------------------
+    # The AOT warm plane is host-side orchestration whose diagnostics
+    # ride the event bus; its timers (compile walls) are measurements,
+    # not decisions, so SEQ005 does not apply.
+    "aot/": (ROLE_INSTRUMENTED,),
     "ops/": (ROLE_TRACED,),
     "parallel/": (ROLE_TRACED,),
     "resilience/": (ROLE_DETERMINISTIC, ROLE_INSTRUMENTED),

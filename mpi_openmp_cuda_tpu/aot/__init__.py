@@ -1,0 +1,38 @@
+"""AOT warm plane: persistent executable cache + startup prewarm.
+
+The reference program pays zero compile cost at run time — ``nvcc``
+AOT-compiles its one CUDA kernel at build time (cudaFunctions.cu) — while
+our JIT-compiled scorer re-pays 3.6-3.8 s of XLA/Mosaic compiles on every
+process start (BENCH_r04/r05).  That tax is fatal for autoscaling serve
+replicas and for preemption recovery: a rescued host must rejoin in
+milliseconds, not seconds (ROADMAP item 5).
+
+Four modules, one contract:
+
+* :mod:`.warmset` — WHAT to compile: the resolved production-schedule
+  bucket configs for the current problem (``ops/schedule.kernel_configs``
+  keys), the serve superblock shapes, and the top-K of the cost model's
+  hot-config ranking, each keyed on ``cache_key`` x ``n_chunks`` x a
+  backend/jax-version fingerprint.
+* :mod:`.compile` — HOW: ``jit(...).lower(args).compile()`` PLUS one
+  executed call, through the SAME module-level jitted callables the
+  dispatch layer calls.  The AOT compile performs the backend compile
+  and writes JAX's persistent compilation cache (a restarted process
+  replays disk hits in milliseconds instead of recompiling); the
+  executed call primes the in-memory pjit cache — the only
+  event-silent dispatch path, since jax's backend-compile monitoring
+  event fires even on persistent-cache hits.  Together they are what
+  lets the ``analysis/recompile.py`` zero-compile oracle hold on the
+  first post-prewarm dispatch.
+* :mod:`.manifest` — the atomic, versioned warm-set manifest (entry,
+  cache_key, fingerprint, compile_wall_s, bytes) in the obs run-report
+  envelope, with staleness detection: a fingerprint mismatch makes an
+  entry invalid — listed and re-warmed, never silently reused.
+* :mod:`.prewarm` — the process-start orchestration behind ``--prewarm``
+  / ``SEQALIGN_PREWARM``: manifest replay + problem-derived warm set,
+  wired into serve startup (so the steady-state recompile gate holds
+  from tick 0) and the batch/``--resume`` path (so drain -> resume
+  restarts rejoin warm).
+"""
+
+from __future__ import annotations

@@ -1,0 +1,132 @@
+"""The warm-set manifest: what was compiled, under which fingerprint,
+at what cost — atomic on disk, versioned through the obs run-report
+envelope.
+
+The manifest is the restart half of the warm plane.  A prewarming
+process records every entry it compiled (``cache_key``, fingerprint
+digest, ``compile_wall_s``, serialized ``bytes``); the NEXT process
+loads it and replays those entries through ``compile.compile_entry``
+before serving — persistent-cache hits, milliseconds each — without
+needing the original problem in hand.
+
+Staleness contract: an entry whose recorded fingerprint digest differs
+from the current :func:`~.warmset.backend_fingerprint` is INVALID — a
+jax upgrade, backend switch, or device-count change means its cached
+executable may not even deserialize (the cross-config segfault
+documented in ``utils/platform.enable_compilation_cache``).  Stale
+entries are split out by :func:`split_entries`, listed in the next
+manifest's ``stale`` section, and re-warmed under the new fingerprint
+by ``prewarm`` — never silently reused.
+
+Loading is deliberately forgiving (missing/corrupt manifest -> ``None``
+plus a logged line): prewarm is an optimization and must never be the
+reason a process fails to start.  Writing is strict and atomic
+(tmp + ``os.replace``, the obs exporter's idiom): a reader never sees a
+torn manifest.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+from ..obs.events import log_line
+from ..obs.metrics import validate_report, wrap_report
+
+#: Envelope kind (validate_report knows this branch).
+MANIFEST_KIND = "aot-manifest"
+
+
+def default_manifest_path() -> str | None:
+    """``<cache home>/aot/<platform tag>.json`` — partitioned by the
+    same platform/flags tag as the persistent compilation cache, so a
+    CPU manifest never drives a TPU replay.  ``None`` when caching is
+    disabled (nowhere durable to point at)."""
+    from ..utils.platform import cache_home, platform_tag
+
+    home = cache_home()
+    if home is None:
+        return None
+    return os.path.join(home, "aot", f"{platform_tag()}.json")
+
+
+def build_manifest(results, fingerprint: dict, *, stale=()) -> dict:
+    """Wrap compile results into the versioned report envelope.
+
+    ``results`` is ``[(WarmEntry, compile_wall_s, bytes_or_None), ...]``;
+    ``stale`` lists superseded entry dicts (prior-fingerprint entries
+    re-warmed this run) so the staleness event is auditable, not
+    silent."""
+    entries = []
+    total_wall = 0.0
+    total_bytes = 0
+    for entry, wall_s, nbytes in results:
+        d = entry.to_dict()
+        d["fingerprint"] = fingerprint["digest"]
+        d["compile_wall_s"] = round(float(wall_s), 6)
+        d["bytes"] = nbytes
+        entries.append(d)
+        total_wall += float(wall_s)
+        total_bytes += int(nbytes or 0)
+    body = {
+        "fingerprint": dict(fingerprint),
+        "entries": entries,
+        "stale": [dict(s) for s in stale],
+        "totals": {
+            "entries": len(entries),
+            "compile_wall_s": round(total_wall, 6),
+            "bytes": total_bytes,
+        },
+    }
+    return wrap_report(MANIFEST_KIND, body)
+
+
+def write_manifest(report: dict, path: str) -> None:
+    """Validate, then atomically persist (tmp + ``os.replace``) —
+    a crashing prewarm leaves the previous manifest intact."""
+    validate_report(report)
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        f.write(json.dumps(report, indent=2, sort_keys=True) + "\n")
+    os.replace(tmp, path)
+
+
+def load_manifest(path: str) -> dict | None:
+    """The forgiving loader: a valid report dict, or ``None`` (absent,
+    unparseable, or schema-invalid — each logged, none fatal)."""
+    try:
+        with open(path) as f:
+            report = json.load(f)
+    except FileNotFoundError:
+        return None
+    except (OSError, ValueError) as e:
+        log_line(f"mpi_openmp_cuda_tpu: aot manifest unreadable ({e})")
+        return None
+    try:
+        validate_report(report)
+    except ValueError as e:
+        log_line(f"mpi_openmp_cuda_tpu: aot manifest invalid ({e})")
+        return None
+    if report.get("kind") != MANIFEST_KIND:
+        log_line(
+            f"mpi_openmp_cuda_tpu: aot manifest has kind "
+            f"{report.get('kind')!r}, want {MANIFEST_KIND!r}"
+        )
+        return None
+    return report
+
+
+def split_entries(report: dict, digest: str):
+    """(fresh WarmEntries, stale entry dicts) under the CURRENT
+    fingerprint digest — the staleness gate.  Fresh entries replay;
+    stale ones are re-warmed under the new fingerprint and listed."""
+    from .warmset import WarmEntry
+
+    fresh, stale = [], []
+    for d in report.get("entries", []):
+        if d.get("fingerprint") == digest:
+            fresh.append(WarmEntry.from_dict(d))
+        else:
+            stale.append(d)
+    return fresh, stale

@@ -1,0 +1,137 @@
+"""Process-start prewarming: replay the manifest, warm the problem's
+schedule, rewrite the manifest — all before the first real dispatch.
+
+Called from the CLI behind ``--prewarm`` / ``SEQALIGN_PREWARM``:
+
+* **serve startup** — before the loop's first tick, so
+  ``ServeLoop.baseline_steady`` can pin the steady-compile baseline at
+  tick 0 instead of absorbing the first block as warmup, and the
+  recompile detector's steady-state-zero gate holds from the FIRST
+  request;
+* **batch / --resume** — a drain -> resume restart (resilience plane)
+  replays its predecessor's manifest and rejoins warm instead of
+  re-paying the 3.6-3.8 s first-compile tax the bench measures.
+
+Failure policy: prewarming is an optimization.  Every per-entry compile
+is individually guarded (a failed entry is counted on ``aot.failed``
+and logged, the rest proceed), and the CLI wraps the whole call — no
+prewarm outcome may fail the run.
+
+Emits ``aot.entries`` / ``aot.compiled`` / ``aot.stale`` /
+``aot.failed`` counters and the ``prewarm_wall_s`` gauge into the obs
+registry, so the run report shows exactly what warmth cost.
+"""
+
+from __future__ import annotations
+
+import time
+
+from ..obs.events import log_line
+from ..obs.metrics import gauge, inc
+from .compile import compile_entry, ensure_persistence
+from .manifest import (
+    build_manifest,
+    default_manifest_path,
+    load_manifest,
+    split_entries,
+    write_manifest,
+)
+from .warmset import WarmEntry, backend_fingerprint, select_warmset
+
+
+def _replay_entries(manifest_path: str | None, digest: str):
+    """(fresh, stale) from the on-disk manifest; ([], []) when there is
+    no manifest to replay."""
+    if manifest_path is None:
+        return [], []
+    report = load_manifest(manifest_path)
+    if report is None:
+        return [], []
+    return split_entries(report, digest)
+
+
+def prewarm(
+    problem=None,
+    backend: str | None = None,
+    *,
+    rows_per_block: int | None = None,
+    manifest_path: str | None = None,
+    top_k: int | None = None,
+) -> dict:
+    """Warm the process: manifest replay + (when a problem is in hand)
+    the problem-derived warm set; returns a summary dict.
+
+    Merge order — manifest first (known-hot from a real prior run),
+    then the problem's selected set, then stale re-warms (prior-
+    fingerprint entries recompiled under the CURRENT fingerprint,
+    source ``stale-rewarm`` — listed in the new manifest, never
+    silently replayed) — deduplicated on ``executable_key``.
+    """
+    t0 = time.perf_counter()
+    fp = backend_fingerprint()
+    cache_dir = ensure_persistence()
+    if manifest_path is None:
+        manifest_path = default_manifest_path()
+
+    fresh, stale = _replay_entries(manifest_path, fp["digest"])
+    merged: dict[tuple, WarmEntry] = {}
+    for e in fresh:
+        merged.setdefault(e.executable_key, e)
+    if problem is not None and backend not in (None, "oracle"):
+        kwargs = {"rows_per_block": rows_per_block}
+        if top_k is not None:
+            kwargs["top_k"] = top_k
+        for e in select_warmset(problem, backend, **kwargs):
+            merged.setdefault(e.executable_key, e)
+    for d in stale:
+        try:
+            e = WarmEntry.from_dict({**d, "source": "stale-rewarm"})
+        except (ValueError, TypeError) as err:
+            log_line(f"mpi_openmp_cuda_tpu: aot stale entry dropped ({err})")
+            continue
+        merged.setdefault(e.executable_key, e)
+
+    results = []
+    failed = 0
+    for entry in merged.values():
+        try:
+            wall_s, nbytes = compile_entry(entry)
+        except Exception as err:
+            failed += 1
+            inc("aot.failed")
+            log_line(
+                "mpi_openmp_cuda_tpu: aot compile failed for "
+                f"{entry.executable_key} ({err})"
+            )
+            continue
+        results.append((entry, wall_s, nbytes))
+
+    if manifest_path is not None and results:
+        report = build_manifest(results, fp, stale=stale)
+        try:
+            write_manifest(report, manifest_path)
+        except OSError as err:
+            log_line(f"mpi_openmp_cuda_tpu: aot manifest write failed ({err})")
+            manifest_path = None
+
+    wall = time.perf_counter() - t0
+    inc("aot.entries", len(merged))
+    inc("aot.compiled", len(results))
+    inc("aot.stale", len(stale))
+    gauge("prewarm_wall_s", round(wall, 6))
+    log_line(
+        f"mpi_openmp_cuda_tpu: prewarmed {len(results)}/{len(merged)} "
+        f"executables in {wall:.3f}s "
+        f"(replayed {len(fresh)}, stale {len(stale)}, failed {failed}; "
+        f"cache={'on' if cache_dir else 'off'})"
+    )
+    return {
+        "entries": len(merged),
+        "compiled": len(results),
+        "replayed": len(fresh),
+        "stale": len(stale),
+        "failed": failed,
+        "prewarm_wall_s": wall,
+        "cache_dir": cache_dir,
+        "manifest_path": manifest_path,
+    }

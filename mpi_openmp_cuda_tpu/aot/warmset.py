@@ -1,0 +1,331 @@
+"""Warm-set selection: WHICH executables to compile before the first
+request, and the fingerprint that scopes their validity.
+
+An executable's identity has two halves:
+
+* the **static half** — ``ops/schedule.BucketKernelConfig.cache_key``
+  (formulation, feed, shape bucket, chunk, superblock, packing class)
+  plus the traced ``n_chunks`` leading dimension and, on the matmul
+  path, the static ``mm_precision`` argument.  :class:`WarmEntry`
+  carries exactly this; its :attr:`WarmEntry.executable_key` is the
+  dedup key of the warm set.
+* the **environment half** — :func:`backend_fingerprint`: jax/jaxlib
+  versions, the resolved backend, and the platform/flags tag
+  ``utils.platform.platform_tag`` already partitions the persistent
+  cache by.  A manifest entry whose recorded fingerprint differs from
+  the current one is STALE: re-warmed under the new fingerprint, never
+  silently reused (the cross-config deserialization crash documented in
+  ``utils/platform.enable_compilation_cache`` is what "silently reused"
+  costs).
+
+:func:`select_warmset` merges three sources, most valuable first:
+
+1. the top-K of ``analysis/costmodel.schedule_cost_sheet``'s hot-config
+   ranking (built "for AOT warming"; pallas schedules only — the sheet
+   prices the fused kernel),
+2. the problem's full production bucket schedule (one entry per bucket,
+   resolved through the same routing ``AlignmentScorer._score_local``
+   applies at dispatch time), and
+3. the serve superblock shapes (every ``--serve`` dispatch is exactly
+   ``rows_per_block`` padded rows per L2P bucket), so a batch-mode
+   prewarm also warms a later serve replica of the same problem key.
+
+Caveat recorded, not hidden: serve-block pallas entries are resolved
+with full-length rows (the padded-tail shape a partially-filled block
+actually has).  A pallas block of ALL-short real rows may pick a
+different superblock/packing class and still pay one compile; the XLA
+formulations are shape-only, so the CPU serve path is warmed exactly.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+
+import numpy as np
+
+#: Hot-config rows taken from the cost sheet's ranking by default.
+DEFAULT_TOP_K = 4
+
+
+@dataclasses.dataclass(frozen=True)
+class WarmEntry:
+    """One AOT-compilable executable identity (the static half)."""
+
+    formulation: str  # 'pallas' | 'xla-mm' | 'xla-gather'
+    feed: str | None  # MXU feed (pallas only)
+    mm_hi: bool  # xla-mm: Precision.HIGHEST (static argument)
+    l1p: int
+    l2p: int
+    len1: int  # provenance only: a traced RUNTIME scalar, not identity
+    cb: int  # rows per chunk (the traced [NC, CB, L2P] middle dim)
+    n_chunks: int  # the traced leading dim
+    sb: int | None  # offset-superblock width (static, pallas)
+    l2s: int | None  # row-packing class (static, pallas)
+    source: str = "schedule"  # schedule | hot-config | serve-block | manifest
+
+    @property
+    def cache_key(self) -> tuple:
+        """Mirrors ``BucketKernelConfig.cache_key`` field for field."""
+        return (
+            self.formulation, self.feed, self.l1p, self.l2p, self.cb,
+            self.sb, self.l2s,
+        )
+
+    @property
+    def executable_key(self) -> tuple:
+        """The dedup key: cache_key x traced chunk count x the matmul
+        path's static precision.  ``len1`` is excluded deliberately —
+        it is a runtime scalar operand, so two entries differing only
+        in len1 share one compiled program."""
+        return self.cache_key + (self.n_chunks, bool(self.mm_hi))
+
+    def to_dict(self) -> dict:
+        d = dataclasses.asdict(self)
+        d["cache_key"] = list(self.cache_key)
+        return d
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "WarmEntry":
+        fields = {f.name for f in dataclasses.fields(cls)}
+        kw = {k: v for k, v in d.items() if k in fields}
+        missing = {
+            "formulation", "l1p", "l2p", "cb", "n_chunks",
+        } - set(kw)
+        if missing:
+            raise ValueError(
+                f"warm entry missing fields {sorted(missing)}: {d!r}"
+            )
+        kw.setdefault("feed", None)
+        kw.setdefault("mm_hi", False)
+        kw.setdefault("len1", 0)
+        kw.setdefault("sb", None)
+        kw.setdefault("l2s", None)
+        return cls(**kw)
+
+
+def backend_fingerprint() -> dict:
+    """The environment half of an executable's identity, with a stable
+    ``digest`` the manifest staleness check compares.
+
+    Includes the resolved runtime backend (initialising it is fine here:
+    prewarm runs at process start, after ``apply_platform_override``)
+    and the same platform/flags tag the persistent cache partitions its
+    directory by — writers and readers of a warm set must agree on every
+    component, exactly like the cache partitioning they ride on."""
+    import jax
+
+    from ..utils.platform import platform_tag
+
+    try:
+        import jaxlib
+
+        jaxlib_version = getattr(jaxlib, "__version__", jax.__version__)
+    except Exception:  # pragma: no cover - jaxlib always ships with jax
+        jaxlib_version = jax.__version__
+    fp = {
+        "jax": jax.__version__,
+        "jaxlib": jaxlib_version,
+        "backend": jax.default_backend(),
+        "platform_tag": platform_tag(),
+    }
+    fp["digest"] = hashlib.sha256(
+        json.dumps(fp, sort_keys=True).encode()
+    ).hexdigest()[:16]
+    return fp
+
+
+def _resolve_entry_config(backend, val_flat, l1p, l2p, len1, lens):
+    """(formulation, feed, sb, l2s, mm_hi) for one padded bucket —
+    the same routing ``AlignmentScorer._score_local`` applies, via the
+    same single-source policy helpers, so a warm entry names exactly
+    the program the dispatch will call."""
+    from ..ops.dispatch import (
+        choose_pallas_formulation,
+        choose_rowpack,
+        xla_formulation_mode,
+    )
+    from ..ops.values import max_abs_value
+
+    if backend == "pallas":
+        fm = choose_pallas_formulation(val_flat, (), l2p)
+        if fm[0] == "pallas":
+            from ..ops.pallas_scorer import choose_superblock
+
+            feed = fm[1]
+            sb = choose_superblock(l1p // 128, l2p // 128, int(len1), lens, feed)
+            l2s = choose_rowpack(feed, l2p, lens, maxv=max_abs_value(val_flat))
+            return ("pallas", feed, sb, l2s, False)
+        backend = "xla-gather"  # the overflow-risk fallback routing
+    if xla_formulation_mode(backend, val_flat, l2p) == "mm":
+        from ..ops.matmul_scorer import mm_precision
+
+        return ("xla-mm", None, None, None, mm_precision(val_flat) is not None)
+    return ("xla-gather", None, None, None, False)
+
+
+def _schedule_entries(problem, backend, val_flat) -> list[WarmEntry]:
+    """One entry per production-schedule bucket (source 2)."""
+    from ..ops.schedule import production_schedule
+
+    _, sched = production_schedule(problem, backend)
+    out = []
+    for part in sched:
+        batch = part["batch"]
+        nc, cb = part["lens"].shape
+        form, feed, sb, l2s, mm_hi = _resolve_entry_config(
+            backend, val_flat, batch.l1p, batch.l2p, batch.len1, batch.len2
+        )
+        out.append(
+            WarmEntry(
+                formulation=form, feed=feed, mm_hi=mm_hi,
+                l1p=batch.l1p, l2p=batch.l2p, len1=batch.len1,
+                cb=cb, n_chunks=nc, sb=sb, l2s=l2s, source="schedule",
+            )
+        )
+    return out
+
+
+def _serve_block_entries(
+    problem, backend, val_flat, rows_per_block: int
+) -> list[WarmEntry]:
+    """One entry per L2P bucket at the serve superblock shape (source 3).
+
+    ``serve/batcher.plan_blocks`` buckets rows with ``packable=False,
+    min_rows=1`` and pads every block to exactly ``rows_per_block``
+    rows with full-length pad rows — so the dispatched shape per bucket
+    is ``[rows_per_block, l2p]`` and (for packing purposes) the lens
+    vector of a padded block maxes out at ``l2p``."""
+    from ..ops.dispatch import (
+        DEFAULT_CHUNK_BUDGET,
+        PaddedBatch,
+        choose_chunk,
+        effective_backend,
+        plan_buckets,
+        round_up,
+    )
+    from ..utils.constants import BUF_SIZE_SEQ2
+
+    len1 = int(problem.seq1_codes.size)
+    l1p = round_up(len1, 128)
+    groups = plan_buckets(
+        [c.size for c in problem.seq2_codes], packable=False, min_rows=1
+    )
+    out = []
+    for l2p in sorted(groups):
+        real = sorted(
+            int(problem.seq2_codes[i].size) for i in groups[l2p]
+        )[:rows_per_block]
+        # plan_blocks pads tail blocks with rows of min(l2p, buffer cap)
+        # characters, so that is the padded block's lens fill value.
+        lens = np.full(
+            rows_per_block, min(int(l2p), BUF_SIZE_SEQ2), dtype=np.int32
+        )
+        lens[: len(real)] = real
+        batch = PaddedBatch(
+            seq1ext=np.zeros(l1p + l2p + 1, dtype=np.int32),
+            len1=len1,
+            seq2=np.zeros((rows_per_block, l2p), dtype=np.int32),
+            len2=lens,
+            l1p=l1p,
+            l2p=l2p,
+        )
+        cb = choose_chunk(
+            batch,
+            DEFAULT_CHUNK_BUDGET,
+            backend=effective_backend(backend, val_flat, l2p),
+        )
+        nc = round_up(rows_per_block, cb) // cb
+        form, feed, sb, l2s, mm_hi = _resolve_entry_config(
+            backend, val_flat, l1p, l2p, len1, lens
+        )
+        out.append(
+            WarmEntry(
+                formulation=form, feed=feed, mm_hi=mm_hi,
+                l1p=l1p, l2p=l2p, len1=len1,
+                cb=cb, n_chunks=nc, sb=sb, l2s=l2s, source="serve-block",
+            )
+        )
+    return out
+
+
+def _hot_config_entries(problem, backend, top_k: int) -> list[WarmEntry]:
+    """Top-K of the cost sheet's hot-config ranking (source 1).
+
+    The sheet prices the fused kernel only, so this source contributes
+    nothing off the pallas backend (``hot_configs`` is empty there) —
+    the schedule source still covers those buckets.  Per-entry
+    ``n_chunks`` comes from the matching ``kernel_configs`` bucket (the
+    hot row's ``launches`` aggregates across buckets sharing a key and
+    is NOT a traced dimension)."""
+    if backend != "pallas":
+        return []
+    from ..analysis.costmodel import schedule_cost_sheet
+    from ..ops.schedule import kernel_configs
+
+    sheet = schedule_cost_sheet(problem, backend)
+    cfgs = kernel_configs(problem, backend) or []
+    by_key: dict[tuple, object] = {}
+    for c in cfgs:
+        by_key.setdefault(c.cache_key, c)
+    out = []
+    for row in sheet["hot_configs"][:top_k]:
+        key = (
+            row["formulation"], row["feed"], row["l1p"], row["l2p"],
+            row["cb"], row["sb"], row["l2s"],
+        )
+        cfg = by_key.get(key)
+        if cfg is None:
+            continue
+        out.append(
+            WarmEntry(
+                formulation=cfg.formulation, feed=cfg.feed, mm_hi=False,
+                l1p=cfg.l1p, l2p=cfg.l2p, len1=cfg.len1,
+                cb=cfg.cb, n_chunks=cfg.n_chunks, sb=cfg.sb, l2s=cfg.l2s,
+                source="hot-config",
+            )
+        )
+    return out
+
+
+def select_warmset(
+    problem,
+    backend: str,
+    *,
+    rows_per_block: int | None = None,
+    top_k: int = DEFAULT_TOP_K,
+) -> list[WarmEntry]:
+    """The deduplicated warm set for one problem/backend, hot configs
+    first (most modelled wall saved per compile), then the full bucket
+    schedule, then the serve superblock shapes."""
+    if backend == "oracle":
+        return []  # host numpy: nothing compiles
+    from ..ops.values import value_table
+
+    val_flat = value_table(problem.weights).astype(np.int32).reshape(-1)
+    merged: dict[tuple, WarmEntry] = {}
+    for entry in _hot_config_entries(problem, backend, top_k):
+        merged.setdefault(entry.executable_key, entry)
+    for entry in _schedule_entries(problem, backend, val_flat):
+        merged.setdefault(entry.executable_key, entry)
+    if rows_per_block:
+        for entry in _serve_block_entries(
+            problem, backend, val_flat, int(rows_per_block)
+        ):
+            merged.setdefault(entry.executable_key, entry)
+    return list(merged.values())
+
+
+def crosscheck_hot_configs(entries, hot_rows) -> list[dict]:
+    """Hot-ranking rows with NO covering warm entry (empty = the warm
+    set subsumes the ranking).  Keys on the golden-view fields
+    ``(l1p, l2p, cb, sb, l2s)`` so it accepts both live cost-sheet rows
+    and the committed ``tests/golden/schedule_audit.json`` view."""
+    have = {(e.l1p, e.l2p, e.cb, e.sb, e.l2s) for e in entries}
+    return [
+        r
+        for r in hot_rows
+        if (r["l1p"], r["l2p"], r["cb"], r.get("sb"), r.get("l2s"))
+        not in have
+    ]

@@ -272,6 +272,18 @@ def build_arg_parser() -> argparse.ArgumentParser:
         "mpi_openmp_cuda_tpu/analysis); the SEQALIGN_CHECK env var "
         "enables the same checks when this flag is absent",
     )
+    p.add_argument(
+        "--prewarm",
+        action="store_true",
+        help="AOT-compile the scorer's executables at process start "
+        "(manifest replay + the problem's warm set) through JAX's "
+        "persistent compilation cache, so a restarted process — an "
+        "autoscaled serve replica, or a drain->--resume rerun — answers "
+        "its first request without paying the multi-second first-compile "
+        "tax; under --serve the steady-recompile gate then holds from "
+        "the FIRST tick (SEQALIGN_PREWARM; cache home: "
+        "SEQALIGN_CACHE_DIR)",
+    )
     return p
 
 
@@ -410,6 +422,42 @@ def _make_scorer(args, distributed_active: bool) -> AlignmentScorer:
         sharding=sharding,
         check=bool(args.check) or env_flag("SEQALIGN_CHECK"),
     )
+
+
+def _prewarm_enabled(args) -> bool:
+    return bool(args.prewarm or env_flag("SEQALIGN_PREWARM"))
+
+
+def _run_prewarm(args, timer, *, problem=None, backend=None) -> bool:
+    """Run the AOT warm plane at process start (behind --prewarm /
+    SEQALIGN_PREWARM).  Prewarming is an optimization: ANY failure is a
+    stderr warning, never a run failure.  Returns True when the prewarm
+    actually ran (serve uses it to pin the tick-0 steady baseline)."""
+    if not _prewarm_enabled(args):
+        return False
+    try:
+        from ..aot.prewarm import prewarm
+        from ..serve.batcher import DEFAULT_BLOCK_ROWS
+
+        with timer.phase("prewarm"):
+            # A problem-bearing prewarm also warms the SERVE block
+            # shapes this problem's length distribution would produce
+            # (manifest forward-coverage: the batch run's manifest is
+            # what a later `--serve --prewarm` restart replays).
+            prewarm(
+                problem=problem,
+                backend=backend,
+                rows_per_block=(
+                    env_int("SEQALIGN_SERVE_BLOCK_ROWS") or DEFAULT_BLOCK_ROWS
+                ),
+            )
+        return True
+    except Exception as e:
+        print(
+            f"mpi_openmp_cuda_tpu: warning: prewarm failed ({e})",
+            file=sys.stderr,
+        )
+        return False
 
 
 def _run_streaming_worker(args, timer: PhaseTimer, dist, policy) -> int:
@@ -881,8 +929,14 @@ def run(argv: list[str] | None = None) -> int:
                 # superblock shape seen so far.
                 deg = _make_degrader(args, _make_scorer(args, False))
             obs_gauge("backend", deg.scorer.backend)
+            # Serve prewarm is manifest replay: the shapes a fresh
+            # replica must answer warm are whatever a prior process
+            # (batch or serve) recorded.  When it ran, the loop pins its
+            # steady-compile baseline at tick 0.
+            prewarmed = _run_prewarm(args, timer, backend=deg.scorer.backend)
             rc = serve_mod.run_serve(
-                args, timer, policy, deg, out_stream=out_stream
+                args, timer, policy, deg, out_stream=out_stream,
+                prewarmed=prewarmed,
             )
             return rc
         coordinator = True
@@ -906,6 +960,11 @@ def run(argv: list[str] | None = None) -> int:
                 dist.initialize_distributed()
                 coordinator = dist.is_coordinator()
         if args.stream:
+            if not args.distributed:
+                # Replay-only (no materialised problem before the stream
+                # starts): a drain -> --resume rerun rejoins warm from
+                # its predecessor's manifest.
+                _run_prewarm(args, timer)
             rc = _run_streaming(
                 args,
                 timer,
@@ -936,6 +995,13 @@ def run(argv: list[str] | None = None) -> int:
             # replaces the backend for the retry that follows it.
             deg = _make_degrader(args, _make_scorer(args, args.distributed))
         obs_gauge("backend", deg.scorer.backend)
+        if not args.distributed and deg.scorer.sharding is None:
+            # Batch prewarm gets the problem: the warm set mirrors the
+            # LOCAL dispatch routing, so sharded/multi-host runs (whose
+            # programs are per-device) stay replay-free here.
+            _run_prewarm(
+                args, timer, problem=problem, backend=deg.scorer.backend
+            )
         journal, done = None, None
         if args.journal:
 

@@ -323,6 +323,38 @@ def validate_report(rec) -> None:
             problems.append(
                 f"entry_points: want a list, got {rec.get('entry_points')!r}"
             )
+    elif kind == "aot-manifest":
+        # aot/manifest.py's warm-set manifest.
+        fp = rec.get("fingerprint")
+        if not isinstance(fp, dict) or not isinstance(fp.get("digest"), str):
+            problems.append(
+                f"fingerprint: want an object with a digest string, got {fp!r}"
+            )
+        entries = rec.get("entries")
+        if not isinstance(entries, list):
+            problems.append(f"entries: want a list, got {entries!r}")
+        else:
+            for i, e in enumerate(entries):
+                if not isinstance(e, dict):
+                    problems.append(f"entries[{i}]: want an object, got {e!r}")
+                    continue
+                if not isinstance(e.get("cache_key"), list):
+                    problems.append(f"entries[{i}].cache_key: want a list")
+                if not isinstance(e.get("fingerprint"), str):
+                    problems.append(f"entries[{i}].fingerprint: want a string")
+                if not isinstance(e.get("compile_wall_s"), (int, float)):
+                    problems.append(
+                        f"entries[{i}].compile_wall_s: want a number"
+                    )
+        if not isinstance(rec.get("stale"), list):
+            problems.append(f"stale: want a list, got {rec.get('stale')!r}")
+        totals = rec.get("totals")
+        if not isinstance(totals, dict) or not isinstance(
+            totals.get("entries"), int
+        ):
+            problems.append(
+                f"totals: want an object with an int entry count, got {totals!r}"
+            )
     if problems:
         raise ValueError(
             "invalid run report: " + "; ".join(problems)

@@ -123,6 +123,16 @@ class BucketKernelConfig:
             self.sb, self.l2s,
         )
 
+    @property
+    def executable_key(self) -> tuple:
+        """``cache_key`` extended with the traced leading chunk count —
+        the FULL static identity of one compiled program as the AOT warm
+        plane keys it (``aot/warmset.WarmEntry.executable_key`` mirrors
+        this): two buckets sharing a cache_key but walking different
+        ``n_chunks`` trace different [NC, CB, L2P] programs.  ``len1``
+        stays excluded — it is a runtime scalar operand."""
+        return self.cache_key + (self.n_chunks,)
+
 
 def kernel_configs(problem, backend: str, buckets: bool = True):
     """Resolve the per-bucket kernel decisions of ``problem``'s

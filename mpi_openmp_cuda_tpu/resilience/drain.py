@@ -24,6 +24,12 @@ Mechanics:
   "temporary; rerun with ``--resume``") versus 65 for fatal errors.
 * A **second** signal during the drain force-exits immediately
   (``os._exit(128 + signum)``): the operator escalated, obey.
+* The rerun side: a drained process leaves both a resumable journal AND
+  a populated persistent compile cache + AOT manifest behind, so
+  ``--resume --prewarm`` rejoins **warm** — the restarted process
+  replays its predecessor's executables (``aot/prewarm``) instead of
+  re-paying the multi-second first-compile tax on top of the preemption
+  it just survived.
 
 :class:`DrainInterrupt` derives from ``BaseException`` deliberately —
 the retry policy's transient net (``except Exception``) must not catch

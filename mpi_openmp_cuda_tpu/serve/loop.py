@@ -26,7 +26,11 @@ requests before reading any new input.
 **Steady-state compiles**: the PR-3 recompile detector baselines after
 the first block finishes; everything after must hit warm caches.  The
 delta is exported as the ``serve_steady_compiles`` gauge —
-``make serve-smoke`` gates on it being 0.
+``make serve-smoke`` gates on it being 0.  Under ``--prewarm`` the AOT
+warm plane compiles the block shapes BEFORE the loop starts and
+:meth:`ServeLoop.baseline_steady` pins the baseline at tick 0 — the
+first block is no longer a grace period, and ``make aot-smoke`` gates
+the stricter contract.
 
 Threading: socket reader threads only ``json.loads`` + enqueue (see
 :mod:`.queue`); parsing, scoring, span recording, and ALL journal/metric
@@ -176,7 +180,18 @@ class ServeLoop:
         if self._steady_base is None:
             # Baseline AFTER the first block: its compiles are the warmup;
             # everything later must be cache hits (ROADMAP Open item 5).
+            # A prewarmed loop never reaches this — baseline_steady()
+            # already pinned the baseline at tick 0.
             self._steady_base = compile_count()
+
+    def baseline_steady(self) -> None:
+        """Pin the steady-compile baseline NOW — called after a prewarm,
+        BEFORE the first tick, so the very first block is already held
+        to the zero-recompile standard instead of being absorbed as
+        warmup.  Exports ``serve_prewarmed`` so the smoke gate can
+        verify the strict baseline was actually armed."""
+        self._steady_base = compile_count()
+        obs_gauge("serve_prewarmed", 1)
 
     def tick(self) -> bool:
         """One loop iteration; returns False once idle with no sources
@@ -280,7 +295,7 @@ def _accept_loop(loop: ServeLoop, sock) -> None:
         ).start()
 
 
-def run_serve(args, timer, policy, deg, out_stream=None) -> int:
+def run_serve(args, timer, policy, deg, out_stream=None, prewarmed=False) -> int:
     """CLI entry for ``--serve`` (called with the observability plane,
     faults, watchdog, and drain guard already armed by ``run()``).
 
@@ -289,6 +304,10 @@ def run_serve(args, timer, policy, deg, out_stream=None) -> int:
     — or with an explicit ``--input`` — requests are read line-by-line
     from the file/stdin on the main thread and the loop runs until the
     queue drains, which makes pipe mode fully deterministic for tests.
+
+    ``prewarmed=True`` (the CLI ran the AOT prewarm) pins the steady-
+    compile baseline before any tick, so the recompile gate covers the
+    first request too.
     """
     from ..io.pipeline import ChunkPipeline
     from ..io.parse import open_input
@@ -296,6 +315,8 @@ def run_serve(args, timer, policy, deg, out_stream=None) -> int:
     loop = ServeLoop(
         ChunkPipeline(policy, deg), policy, journal_path=args.journal
     )
+    if prewarmed:
+        loop.baseline_steady()
     out_responder = Responder(out_stream or sys.stdout)
     if args.journal:
         resumed = load_drained(args.journal)

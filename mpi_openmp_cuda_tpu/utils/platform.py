@@ -60,6 +60,21 @@ ENV_VARS: tuple[EnvVar, ...] = (
         "persistent compile-cache directory ('off'/'0' disables)",
     ),
     EnvVar(
+        "SEQALIGN_CACHE_DIR",
+        "str",
+        None,
+        "warm-plane cache home: persistent compile cache under "
+        "<dir>/jax/<platform-tag> and the AOT warm-set manifest under "
+        "<dir>/aot (TPU_SEQALIGN_COMPILE_CACHE=off still disables)",
+    ),
+    EnvVar(
+        "SEQALIGN_PREWARM",
+        "flag",
+        False,
+        "AOT-prewarm the scorer executables at process start (same as "
+        "--prewarm): manifest replay + the problem's warm set",
+    ),
+    EnvVar(
         "TPU_SEQALIGN_STREAM_DEPTH",
         "int",
         4,
@@ -277,12 +292,15 @@ def apply_platform_override() -> None:
         jax.config.update("jax_platforms", envp)
 
 
-def _platform_tag() -> str:
+def platform_tag() -> str:
     """The cache-partition tag for this process's platform configuration:
     ``JAX_PLATFORMS`` (or, unset, an init-free TPU-plugin-presence proxy —
     querying the backend here would initialize it, which must stay AFTER
     ``jax.distributed.initialize`` on multi-host) plus any virtual
-    host-device count from ``XLA_FLAGS``."""
+    host-device count from ``XLA_FLAGS``.  Shared by the persistent
+    compilation cache AND the AOT warm-set manifest (``aot/manifest``):
+    both partition on it so writers and readers agree on the whole
+    platform configuration, never just the backend name."""
     tag = os.environ.get("JAX_PLATFORMS", "").replace(",", "-")
     if not tag:
         import importlib.util
@@ -298,6 +316,47 @@ def _platform_tag() -> str:
     return tag
 
 
+# Back-compat alias (pre-AOT-plane name).
+_platform_tag = platform_tag
+
+
+def cache_home() -> str | None:
+    """The warm-plane root directory, or ``None`` when caching is
+    disabled (``TPU_SEQALIGN_COMPILE_CACHE=off``/``0``).
+
+    Precedence: ``SEQALIGN_CACHE_DIR`` (the warm-plane home: compile
+    cache under ``<dir>/jax/<tag>``, AOT manifests under ``<dir>/aot``),
+    else the legacy ``TPU_SEQALIGN_COMPILE_CACHE`` directory, else
+    ``~/.cache/mpi_openmp_cuda_tpu``."""
+    legacy = os.environ.get("TPU_SEQALIGN_COMPILE_CACHE")
+    if legacy is not None and legacy.strip().lower() in ("off", "0", ""):
+        return None
+    explicit = os.environ.get("SEQALIGN_CACHE_DIR")
+    if explicit:
+        return explicit
+    if legacy:
+        return legacy
+    return os.path.join(os.path.expanduser("~"), ".cache", "mpi_openmp_cuda_tpu")
+
+
+def compilation_cache_dir() -> str | None:
+    """The resolved, platform-partitioned persistent compile-cache
+    directory, or ``None`` when disabled.
+
+    A legacy explicit ``TPU_SEQALIGN_COMPILE_CACHE=<dir>`` keeps its
+    pre-AOT layout ``<dir>/<tag>`` exactly (existing caches stay valid);
+    the ``SEQALIGN_CACHE_DIR`` home and the default both use
+    ``<home>/jax/<tag>``."""
+    home = cache_home()
+    if home is None:
+        return None
+    if os.environ.get("TPU_SEQALIGN_COMPILE_CACHE") and not os.environ.get(
+        "SEQALIGN_CACHE_DIR"
+    ):
+        return os.path.join(home, platform_tag())
+    return os.path.join(home, "jax", platform_tag())
+
+
 def enable_compilation_cache() -> None:
     """Point JAX's persistent compilation cache at a stable directory.
 
@@ -310,7 +369,7 @@ def enable_compilation_cache() -> None:
 
     ``TPU_SEQALIGN_COMPILE_CACHE`` overrides the location; ``off`` (or
     ``0``) disables.  Explicit locations get the same per-platform-config
-    subdirectory as the default (see ``_platform_tag``): an override names
+    subdirectory as the default (see ``compilation_cache_dir``): an override names
     where the cache lives, never permission to share one directory across
     platform configurations — that sharing is exactly the cross-config
     deserialization crash the partitioning exists to prevent.  Failures
@@ -322,24 +381,19 @@ def enable_compilation_cache() -> None:
     if getattr(enable_compilation_cache, "_done", False):
         return
     enable_compilation_cache._done = True
-    loc = os.environ.get("TPU_SEQALIGN_COMPILE_CACHE")
-    if loc is not None and loc.strip().lower() in ("off", "0", ""):
-        return
-    if loc is None:
-        loc = os.path.join(
-            os.path.expanduser("~"), ".cache", "mpi_openmp_cuda_tpu", "jax"
-        )
-    # Partition the location by platform configuration.  One shared
-    # directory is NOT safe: entries written by a TPU-plugin process and
-    # read by a JAX_PLATFORMS=cpu process (or written under a different
-    # virtual-device-count XLA_FLAGS) deserialize XLA:CPU executables
-    # compiled for a different machine configuration — observed as
-    # "Compile machine features ... doesn't match" warnings and,
-    # reproducibly, a segfault inside
+    # Partitioned by platform configuration (compilation_cache_dir).  One
+    # shared directory is NOT safe: entries written by a TPU-plugin
+    # process and read by a JAX_PLATFORMS=cpu process (or written under a
+    # different virtual-device-count XLA_FLAGS) deserialize XLA:CPU
+    # executables compiled for a different machine configuration —
+    # observed as "Compile machine features ... doesn't match" warnings
+    # and, reproducibly, a segfault inside
     # compilation_cache.get_executable_and_time during the test suite.
     # Writers and readers must share the tag exactly, so explicit
     # override paths are partitioned too.
-    loc = os.path.join(loc, _platform_tag())
+    loc = compilation_cache_dir()
+    if loc is None:
+        return
     try:
         os.makedirs(loc, exist_ok=True)
         import jax
@@ -347,11 +401,14 @@ def enable_compilation_cache() -> None:
         jax.config.update("jax_compilation_cache_dir", loc)
         # Cache every compile worth having: the kernel's Mosaic compiles
         # take seconds, but even sub-second XLA epilogues add up across
-        # the six fixtures' bucket shapes.
+        # the six fixtures' bucket shapes.  (aot/compile.ensure_persistence
+        # drops the floor to 0 during a prewarm so fast CPU executables
+        # persist too.)
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.2)
         jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
     except Exception as e:  # pragma: no cover - depends on local FS/jax
-        print(
-            f"mpi_openmp_cuda_tpu: persistent compilation cache disabled ({e})",
-            file=__import__("sys").stderr,
+        from ..obs.events import log_line
+
+        log_line(
+            f"mpi_openmp_cuda_tpu: persistent compilation cache disabled ({e})"
         )

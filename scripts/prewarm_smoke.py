@@ -1,0 +1,235 @@
+"""End-to-end smoke gate for the AOT warm plane (``make aot-smoke``).
+
+Three phases, hard-failed together in the same all-problems-at-once
+style as serve_smoke:
+
+1. **In-process cross-check** — the selected warm set covers every row
+   of the committed schedule-audit golden's hot-config ranking (the
+   cost model and the warm plane must agree about what is hot).
+2. **Populate** — a real ``--prewarm`` batch subprocess on the tiny
+   fixture with a throwaway ``SEQALIGN_CACHE_DIR``; gates that the
+   warm-set manifest exists, validates against the shared run-report
+   schema, and is non-empty.
+3. **Restart** — a FRESH ``--serve --port 0 --prewarm`` subprocess on
+   the same cache dir answers its first (and only) request, then
+   SIGTERM -> 75.  Gates ``gauges.serve_prewarmed == 1`` (the strict
+   tick-0 baseline was armed) and ``gauges.serve_steady_compiles == 0``:
+   the restarted process answered its first request with ZERO backend
+   compiles — the replayed manifest executed the real entry points, so
+   the in-memory pjit cache was primed before the baseline pinned.
+
+Exit 0 on success, 1 with every problem listed on failure.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import signal
+import socket
+import subprocess
+import sys
+import tempfile
+import threading
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from mpi_openmp_cuda_tpu.obs.metrics import validate_report  # noqa: E402
+
+FIXTURE = os.path.join(REPO, "tests", "fixtures", "tiny.txt")
+GOLDEN = os.path.join(REPO, "tests", "golden", "schedule_audit.json")
+PORT_RE = re.compile(r"serving on 127\.0\.0\.1:(\d+)")
+# The serve request reuses the tiny fixture's problem key (weights +
+# Seq1) and stays inside its l2p=128 length bucket, so the restarted
+# process's block shapes are exactly the ones phase 2's manifest warmed.
+WEIGHTS = [4, 3, 2, 1]
+SEQ1 = "YYG"
+SEQ2 = ["AG", "GGA", "T"]
+
+
+def _crosscheck() -> list[str]:
+    from mpi_openmp_cuda_tpu.aot.warmset import (
+        crosscheck_hot_configs,
+        select_warmset,
+    )
+    from mpi_openmp_cuda_tpu.models.workload import input3_class_problem
+
+    with open(GOLDEN, encoding="utf-8") as fh:
+        golden = json.load(fh)
+    entries = select_warmset(
+        input3_class_problem(), "pallas", rows_per_block=64
+    )
+    uncovered = crosscheck_hot_configs(entries, golden["hot_configs"])
+    if uncovered:
+        return [f"golden hot-config rows missing from warm set: {uncovered}"]
+    return []
+
+
+def _run_batch_prewarm(env: dict, report_path: str) -> list[str]:
+    with open(FIXTURE, "rb") as fh:
+        proc = subprocess.run(
+            [
+                sys.executable, "-m", "mpi_openmp_cuda_tpu",
+                "--prewarm", "--metrics-out", report_path,
+            ],
+            stdin=fh,
+            capture_output=True,
+            cwd=REPO,
+            env=env,
+            timeout=600,
+        )
+    if proc.returncode != 0:
+        sys.stderr.write(proc.stderr.decode(errors="replace"))
+        return [f"batch --prewarm exited {proc.returncode}"]
+    return []
+
+
+def _check_manifest(cache_dir: str) -> list[str]:
+    manifest_path = os.path.join(cache_dir, "aot", "cpu.json")
+    try:
+        with open(manifest_path, encoding="utf-8") as fh:
+            rec = json.load(fh)
+    except (OSError, json.JSONDecodeError) as e:
+        return [f"no readable manifest at {manifest_path}: {e}"]
+    problems = []
+    try:
+        validate_report(rec)
+    except ValueError as e:
+        problems.append(f"manifest schema: {e}")
+        return problems
+    if not rec["entries"]:
+        problems.append("manifest.entries: want non-empty")
+    digest = rec["fingerprint"]["digest"]
+    for ent in rec["entries"]:
+        if ent["fingerprint"] != digest:
+            problems.append(
+                f"manifest entry {ent.get('cache_key')}: fingerprint "
+                f"{ent['fingerprint']!r} != manifest digest {digest!r}"
+            )
+    return problems
+
+
+def _serve_restart(env: dict, report_path: str) -> tuple[list[str], dict | None]:
+    problems: list[str] = []
+    proc = subprocess.Popen(
+        [
+            sys.executable, "-m", "mpi_openmp_cuda_tpu",
+            "--serve", "--port", "0", "--prewarm",
+            "--metrics-out", report_path,
+        ],
+        stdin=subprocess.DEVNULL,
+        stdout=subprocess.DEVNULL,
+        stderr=subprocess.PIPE,
+        cwd=REPO,
+        env=env,
+        text=True,
+    )
+    try:
+        port = None
+        stderr_lines: list[str] = []
+        for line in proc.stderr:
+            stderr_lines.append(line)
+            m = PORT_RE.search(line)
+            if m:
+                port = int(m.group(1))
+                break
+        if port is None:
+            sys.stderr.write("".join(stderr_lines))
+            return ["restarted server never announced its port"], None
+        drain = threading.Thread(
+            target=lambda: stderr_lines.extend(proc.stderr), daemon=True
+        )
+        drain.start()
+
+        buf = b""
+        with socket.create_connection(("127.0.0.1", port), timeout=60) as conn:
+            req = {"id": "r0", "weights": WEIGHTS, "seq1": SEQ1, "seq2": SEQ2}
+            conn.sendall((json.dumps(req) + "\n").encode())
+            conn.settimeout(120)
+            while b'"done"' not in buf:
+                chunk = conn.recv(65536)
+                if not chunk:
+                    break
+                buf += chunk
+        recs = [json.loads(l) for l in buf.decode().splitlines() if l]
+        if not any(r.get("done") for r in recs):
+            problems.append(f"first request: no done record in {recs}")
+        if sum(1 for r in recs if "line" in r) != len(SEQ2):
+            problems.append(
+                f"first request: want {len(SEQ2)} result lines, got {recs}"
+            )
+        proc.send_signal(signal.SIGTERM)
+        rc = proc.wait(timeout=120)
+        drain.join(10)
+        if rc != 75:
+            problems.append(f"serve exit code: want 75 (drained), got {rc}")
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=30)
+
+    try:
+        with open(report_path, encoding="utf-8") as fh:
+            rec = json.load(fh)
+    except (OSError, json.JSONDecodeError) as e:
+        problems.append(f"no readable serve report at {report_path}: {e}")
+        return problems, None
+    try:
+        validate_report(rec)
+    except ValueError as e:
+        problems.append(f"serve report schema: {e}")
+        return problems, rec
+    gauges = rec["gauges"]
+    if gauges.get("serve_prewarmed") != 1:
+        problems.append(
+            "gauges.serve_prewarmed: want 1 (tick-0 baseline armed), got "
+            f"{gauges.get('serve_prewarmed')}"
+        )
+    # THE gate: the restarted process answered its first request with
+    # zero backend compiles — steady state from tick 0, not tick 1.
+    if gauges.get("serve_steady_compiles") != 0:
+        problems.append(
+            "gauges.serve_steady_compiles: want 0 from tick 0, got "
+            f"{gauges.get('serve_steady_compiles')}"
+        )
+    return problems, rec
+
+
+def main() -> int:
+    out_dir = tempfile.mkdtemp(prefix="prewarm_smoke_")
+    cache_dir = os.path.join(out_dir, "cache")
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    env["SEQALIGN_CACHE_DIR"] = cache_dir
+    env.pop("TPU_SEQALIGN_COMPILE_CACHE", None)
+    env.pop("SEQALIGN_PREWARM", None)
+
+    problems = _crosscheck()
+    problems += _run_batch_prewarm(
+        env, os.path.join(out_dir, "batch.json")
+    )
+    if not problems:
+        problems += _check_manifest(cache_dir)
+    rec = None
+    if not problems:
+        more, rec = _serve_restart(env, os.path.join(out_dir, "serve.json"))
+        problems += more
+
+    if problems:
+        for p in problems:
+            print(f"aot-smoke: FAIL: {p}")
+        return 1
+    manifest = os.path.join(cache_dir, "aot", "cpu.json")
+    with open(manifest, encoding="utf-8") as fh:
+        n = len(json.load(fh)["entries"])
+    print(
+        f"aot-smoke: OK (manifest entries={n}, steady_compiles=0 from "
+        f"tick 0, prewarmed=1, exit=75, artifacts={out_dir})"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -117,6 +117,49 @@ def run_cli_inproc(*args, capsys, rc_want=0):
     return captured.out, captured.err
 
 
+@pytest.fixture
+def tmp_compile_cache(tmp_path):
+    """Arm a throwaway persistent compile cache for ONE test.
+
+    The suite-wide default keeps the cache OFF (see the incident note at
+    the top of this file) — the AOT warm-plane tests are the exception:
+    they are ABOUT persistence, and they keep the program count tiny
+    (single-bucket problems) so the hundreds-of-programs fragility the
+    note describes never builds up.  Sets jax.config directly (the env
+    latch above already ran), restores the defaults on teardown, and
+    best-effort resets jax's cache object so the tmpdir is forgotten.
+    """
+    import jax
+
+    cache_dir = tmp_path / "xla-cache"
+    prev = {
+        "jax_compilation_cache_dir": getattr(
+            jax.config, "jax_compilation_cache_dir", None
+        ),
+        "jax_persistent_cache_min_compile_time_secs": getattr(
+            jax.config, "jax_persistent_cache_min_compile_time_secs", 1.0
+        ),
+        "jax_persistent_cache_min_entry_size_bytes": getattr(
+            jax.config, "jax_persistent_cache_min_entry_size_bytes", 0
+        ),
+    }
+    jax.config.update("jax_enable_compilation_cache", True)
+    jax.config.update("jax_compilation_cache_dir", str(cache_dir))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    try:
+        yield str(cache_dir)
+    finally:
+        for key, val in prev.items():
+            jax.config.update(key, val)
+        try:
+            from jax._src import compilation_cache
+
+            compilation_cache.reset_cache()
+        except Exception:
+            pass
+
+
 @pytest.fixture(autouse=True, scope="module")
 def _clear_jax_caches_between_modules():
     """Drop compiled executables at module boundaries.

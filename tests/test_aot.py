@@ -1,0 +1,198 @@
+"""AOT warm plane (``mpi_openmp_cuda_tpu/aot``): warm-set selection,
+manifest round-trip/staleness, and the restart zero-compile oracle.
+
+The heavy test here (`test_prewarm_restart_zero_compiles`) is the
+in-process form of the acceptance contract: prewarm on a throwaway
+persistent cache, simulate a restart with ``jax.clear_caches()``,
+replay-prewarm, and pin the first production dispatch at ZERO backend
+compiles with the PR-3 recompile detector.  Cross-process coverage of
+the same contract lives in ``scripts/prewarm_smoke.py`` (`make
+aot-smoke`).
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import os
+
+import pytest
+
+from mpi_openmp_cuda_tpu.aot.manifest import (
+    MANIFEST_KIND,
+    build_manifest,
+    load_manifest,
+    split_entries,
+    write_manifest,
+)
+from mpi_openmp_cuda_tpu.aot.warmset import (
+    WarmEntry,
+    backend_fingerprint,
+    crosscheck_hot_configs,
+    select_warmset,
+)
+from mpi_openmp_cuda_tpu.io.parse import parse_problem
+from mpi_openmp_cuda_tpu.models.workload import input3_class_problem
+from mpi_openmp_cuda_tpu.obs.metrics import validate_report
+
+GOLDEN = os.path.join(os.path.dirname(__file__), "golden", "schedule_audit.json")
+
+
+def tiny_problem():
+    """One-bucket problem (l2p=128): the smallest real warm set."""
+    return parse_problem(
+        io.StringIO("4 3 2 1\nACGTACGTACGTACGT\n3\nACGT\nGATTACA\nTTT\n")
+    )
+
+
+# -- warm-set selection -------------------------------------------------------
+
+
+def test_warmset_covers_production_schedule():
+    """Every bucket program of the production schedule has a warm entry
+    with the same full executable identity (ops/schedule.kernel_configs
+    is the reference derivation)."""
+    from mpi_openmp_cuda_tpu.ops.schedule import kernel_configs
+
+    prob = input3_class_problem()
+    entries = select_warmset(prob, "pallas", rows_per_block=64)
+    assert entries, "warm set empty for the input3-class problem"
+    covered = {e.cache_key + (e.n_chunks,) for e in entries}
+    cfgs = kernel_configs(prob, "pallas")
+    assert cfgs, "input3-class schedule fell off the fused kernel"
+    for cfg in cfgs:
+        assert cfg.executable_key in covered, (
+            f"schedule bucket {cfg.executable_key} not in warm set"
+        )
+
+
+def test_warmset_crosschecks_golden_hot_configs():
+    """The committed schedule-audit golden's hot-config ranking is fully
+    covered by the selected warm set (the ISSUE acceptance cross-check:
+    the warm plane warms what the cost model says is hot)."""
+    with open(GOLDEN, encoding="utf-8") as fh:
+        golden = json.load(fh)
+    entries = select_warmset(input3_class_problem(), "pallas", rows_per_block=64)
+    uncovered = crosscheck_hot_configs(entries, golden["hot_configs"])
+    assert uncovered == [], f"hot configs missing from warm set: {uncovered}"
+
+
+def test_warmset_oracle_backend_empty():
+    assert select_warmset(tiny_problem(), "oracle") == []
+
+
+def test_warm_entry_roundtrip():
+    e = WarmEntry(
+        formulation="xla-mm", feed=None, mm_hi=True, l1p=128, l2p=256,
+        len1=16, cb=8, n_chunks=2, sb=None, l2s=None,
+    )
+    d = e.to_dict()
+    assert d["cache_key"] == list(e.cache_key)
+    back = WarmEntry.from_dict(d)
+    assert back.executable_key == e.executable_key
+    assert back.mm_hi is True
+
+
+# -- manifest -----------------------------------------------------------------
+
+
+def _manifest_for(entries, fp):
+    return build_manifest([(e, 0.25, 1024) for e in entries], fp)
+
+
+def test_manifest_roundtrip_and_staleness(tmp_path):
+    fp = backend_fingerprint()
+    entries = select_warmset(tiny_problem(), "xla")
+    assert entries
+    path = str(tmp_path / "aot" / "manifest.json")
+    report = _manifest_for(entries, fp)
+    validate_report(report)
+    write_manifest(report, path)
+
+    loaded = load_manifest(path)
+    assert loaded is not None and loaded["kind"] == MANIFEST_KIND
+    fresh, stale = split_entries(loaded, fp["digest"])
+    assert {e.executable_key for e in fresh} == {
+        e.executable_key for e in entries
+    }
+    assert stale == []
+
+    # A fingerprint mismatch (new jax / new backend) invalidates every
+    # entry: listed as stale, never silently replayed as fresh.
+    fresh2, stale2 = split_entries(loaded, "0" * 16)
+    assert fresh2 == []
+    assert len(stale2) == len(entries)
+
+
+def test_manifest_schema_rejects_corruption(tmp_path):
+    fp = backend_fingerprint()
+    report = _manifest_for(select_warmset(tiny_problem(), "xla"), fp)
+    report["entries"][0].pop("fingerprint")
+    with pytest.raises(ValueError):
+        validate_report(report)
+    # And a corrupt on-disk manifest loads as None (re-warm from
+    # scratch), never raises into process start.
+    path = str(tmp_path / "bad.json")
+    with open(path, "w", encoding="utf-8") as fh:
+        fh.write("{not json")
+    assert load_manifest(path) is None
+
+
+# -- prewarm + restart oracle -------------------------------------------------
+
+
+def test_prewarm_restart_zero_compiles(tmp_compile_cache, tmp_path):
+    """prewarm -> (simulated) restart -> replay-prewarm -> first dispatch
+    compiles NOTHING.  The replay executes the real entry points, so the
+    in-memory pjit cache — the only event-silent dispatch path — is
+    primed before the baseline pins."""
+    import jax
+
+    from mpi_openmp_cuda_tpu.analysis.recompile import assert_compiles
+    from mpi_openmp_cuda_tpu.aot.prewarm import prewarm
+    from mpi_openmp_cuda_tpu.ops.dispatch import AlignmentScorer
+
+    prob = tiny_problem()
+    manifest_path = str(tmp_path / "manifest.json")
+
+    s1 = prewarm(problem=prob, backend="xla", manifest_path=manifest_path)
+    assert s1["entries"] > 0 and s1["failed"] == 0
+    assert s1["cache_dir"] == tmp_compile_cache
+    assert os.path.exists(manifest_path)
+
+    # "Restart": drop every in-memory executable; the persistent cache
+    # and the manifest survive, exactly like a new process.
+    jax.clear_caches()
+
+    s2 = prewarm(problem=prob, backend="xla", manifest_path=manifest_path)
+    assert s2["replayed"] == s1["entries"]
+    assert s2["stale"] == 0 and s2["failed"] == 0
+
+    scorer = AlignmentScorer("xla")
+    with assert_compiles(0):
+        out = scorer.score_codes(
+            prob.seq1_codes, prob.seq2_codes, prob.weights
+        )
+    assert out.shape == (len(prob.seq2), 3)
+
+
+def test_prewarm_rewarns_stale_entries(tmp_compile_cache, tmp_path):
+    """Entries recorded under a different backend/jax fingerprint are
+    re-warmed under the current one and re-listed fresh."""
+    from mpi_openmp_cuda_tpu.aot.prewarm import prewarm
+
+    prob = tiny_problem()
+    manifest_path = str(tmp_path / "manifest.json")
+    fp = dict(backend_fingerprint())
+    fp["digest"] = "f" * 16  # some other toolchain
+    entries = select_warmset(prob, "xla")
+    write_manifest(_manifest_for(entries, fp), manifest_path)
+
+    summary = prewarm(manifest_path=manifest_path)
+    assert summary["stale"] == len(entries)
+    assert summary["compiled"] == len(entries)
+
+    reloaded = load_manifest(manifest_path)
+    fresh, stale = split_entries(reloaded, backend_fingerprint()["digest"])
+    assert len(fresh) == len(entries) and stale == []
+    assert {e.source for e in fresh} == {"stale-rewarm"}

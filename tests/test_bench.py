@@ -58,6 +58,7 @@ def test_bench_emits_contract_json_line():
     assert set(rec) <= {"schema", "schema_version", "kind",
                         "metric", "value", "unit", "vs_baseline",
                         "e2e_first_run_s", "e2e_warm_s",
+                        "cold_start_s", "prewarmed",
                         "real_tflops", "kernel_feed", "mfu_vs_probe",
                         "mxu_probe_bf16_tflops", "probe_quiet_ref_tflops",
                         "probe_gated", "probe_failed",
@@ -67,6 +68,10 @@ def test_bench_emits_contract_json_line():
                         "vpu_probe_arith_gelems", "vpu_floor_us",
                         "wall_vs_vpu_floor", "formulation"}
     assert rec["e2e_first_run_s"] >= 0 and rec["e2e_warm_s"] >= 0
+    # Cold start spans process start -> first result, so it bounds the
+    # first in-process run from above; no SEQALIGN_PREWARM in this env.
+    assert rec["cold_start_s"] >= rec["e2e_first_run_s"]
+    assert rec["prewarmed"] is False
     assert rec["unit"] == "elements/s/chip"
     assert rec["value"] > 0 and rec["vs_baseline"] > 0
     assert "stress_small.txt" in rec["metric"]
