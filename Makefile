@@ -18,7 +18,7 @@ PY_CFLAGS  := $(shell $(PYCONFIG) --includes)
 PY_LDFLAGS := $(shell $(PYCONFIG) --ldflags --embed)
 INPUT      ?= /root/reference/input5.txt
 
-.PHONY: build run run2 runOn2 test chaos chaos-kill analyze schedule-audit metrics-smoke serve-smoke aot-smoke bench bench-table bench-gather check clean
+.PHONY: build run run2 runOn2 test chaos chaos-kill analyze schedule-audit metrics-smoke serve-smoke serve-chaos aot-smoke bench bench-table bench-gather check clean
 
 build: final
 
@@ -120,6 +120,15 @@ metrics-smoke:
 # schema.  CPU-only, seconds.
 serve-smoke:
 	JAX_PLATFORMS=cpu $(PYTHON) scripts/serve_smoke.py
+
+# Serve chaos tier (docs/ARCHITECTURE.md §12, SLO armor): deterministic
+# pipe-mode --serve subprocesses under counted fault schedules — breaker
+# open→half-open→close, poison-superblock bisection, overload shedding
+# with typed retry hints, mid-stream client loss, the byte-identical
+# drained-journal golden, and the unknown-fault-site exit-64 gate.
+# CPU-only, seconds.
+serve-chaos:
+	JAX_PLATFORMS=cpu $(PYTHON) scripts/serve_chaos.py
 
 # AOT warm-plane smoke gate (docs/ARCHITECTURE.md §13): cross-check the
 # warm set against the committed hot-config ranking, populate a

@@ -105,6 +105,11 @@ _MODULE_CLASSES: dict[str, tuple[str, ...]] = {
     "serve/queue.py": (ROLE_SERVE, ROLE_DETERMINISTIC),
     "serve/loop.py": (ROLE_SERVE, ROLE_INSTRUMENTED),
     "serve/session.py": (ROLE_SERVE, ROLE_INSTRUMENTED),
+    # The admission controller's pricing and shed machine are clock-free
+    # (waits are handed IN by the loop); the breaker's windows/cooldowns
+    # are tick-counted, never wall-clock — both stay under SEQ005.
+    "serve/slo.py": (ROLE_SERVE, ROLE_DETERMINISTIC),
+    "resilience/breaker.py": (ROLE_DETERMINISTIC, ROLE_INSTRUMENTED),
     # -- directory defaults ------------------------------------------------
     # The AOT warm plane is host-side orchestration whose diagnostics
     # ride the event bus; its timers (compile walls) are measurements,

@@ -22,7 +22,7 @@ from ..resilience.degrade import (
     verify_rows_against_oracle,
 )
 from ..resilience.drain import DrainInterrupt, drain_guard, drain_requested
-from ..resilience.faults import activate_faults, deactivate_faults
+from ..resilience.faults import activate_faults, deactivate_faults, parse_spec
 from ..resilience.policy import RetryPolicy
 from ..resilience.watchdog import (
     DeadlineExpiredError,
@@ -859,6 +859,17 @@ def run(argv: list[str] | None = None) -> int:
         )
         return EX_USAGE
 
+    # A malformed --faults spec (unknown site, bad grammar) is a usage
+    # error like any other bad flag value: validate it HERE, before the
+    # broad runtime try below would translate the ValueError into 65.
+    try:
+        policy, fault_spec = _build_policy(args)
+        if fault_spec:
+            parse_spec(fault_spec)
+    except ValueError as e:
+        print(f"mpi_openmp_cuda_tpu: error: {e}", file=sys.stderr)
+        return EX_USAGE
+
     guard = None
     out_stream = None  # None -> sys.stdout
 
@@ -878,10 +889,6 @@ def run(argv: list[str] | None = None) -> int:
     metrics_out = None
     rc: int | None = None
     try:
-        # Arm the run's retry policy and (optional) fault registry first:
-        # a malformed --faults/env spec or retry floor fails fast through
-        # the normal error path below, before any expensive phase.
-        policy, fault_spec = _build_policy(args)
         # The observability plane arms before anything that can publish
         # into it (faults, watchdog, scoring); the finally below flushes
         # the run report on EVERY exit path, 65 and 75 included.

@@ -28,14 +28,47 @@ from ..resilience.degrade import (
     run_degrading,
     verify_rows_against_oracle,
 )
+from ..resilience.policy import FATAL_ERROR_TYPES
 
 
 class ChunkPipeline:
-    """One run's dispatch/materialise pair over a policy + degrader."""
+    """One run's dispatch/materialise pair over a policy + degrader.
 
-    def __init__(self, policy, degrader):
+    ``breaker`` (serve mode, --degrade only) is the circuit breaker
+    over the primary dispatch path: every primary attempt's transient
+    failure/success feeds it, and while it is OPEN dispatch bypasses
+    the primary entirely — the pinned degraded scorer is called
+    directly under the plain retry policy, skipping the
+    attempt-exhaust-degrade-reverify ladder per superblock.
+    """
+
+    def __init__(self, policy, degrader, breaker=None):
         self.policy = policy
         self.degrader = degrader
+        self.breaker = breaker
+
+    def _guard(self, fn):
+        """Wrap one attempt so the breaker sees the primary path's
+        health: transient failures count toward opening; fatal errors
+        (ValueError/TypeError — bad input, oracle mismatch) are NOT a
+        backend-health signal and pass through unrecorded."""
+        if self.breaker is None:
+            return fn
+
+        def guarded():
+            try:
+                result = fn()
+            except FATAL_ERROR_TYPES:
+                raise
+            except Exception:
+                # BaseException (drain, interrupt) passes through
+                # unrecorded — process lifecycle, not backend health.
+                self.breaker.record_failure()
+                raise
+            self.breaker.record_success()
+            return result
+
+        return guarded
 
     def _verify(self, seq1_codes, codes, weights):
         """Oracle re-verification closure for the first degraded chunk
@@ -52,10 +85,27 @@ class ChunkPipeline:
         synchronous rescore — MaterialisedRows keeps the promise
         contract for :meth:`materialise`."""
         deg = self.degrader
+        if self.breaker is not None and self.breaker.bypass_primary():
+            # Breaker open: straight to the pinned degraded backend.
+            # Synchronous scoring (the degraded contract), one oracle
+            # check the first time only — NOT per request.
+            rows = self.policy.run(
+                lambda: deg.scorer.score_codes(seq1_codes, codes, weights),
+                "chunk dispatch [breaker-open]",
+                budget=budget,
+            )
+            if deg.enabled and not deg.verified:
+                verify_rows_against_oracle(seq1_codes, codes, weights, rows)
+                deg.verified = True
+            return MaterialisedRows(rows)
         return run_degrading(
             self.policy,
             deg,
-            lambda: deg.scorer.score_codes_async(seq1_codes, codes, weights),
+            self._guard(
+                lambda: deg.scorer.score_codes_async(
+                    seq1_codes, codes, weights
+                )
+            ),
             lambda sc: sc.score_codes(seq1_codes, codes, weights),
             "chunk dispatch",
             budget=budget,
@@ -78,7 +128,7 @@ class ChunkPipeline:
         return run_degrading(
             self.policy,
             deg,
-            attempt,
+            self._guard(attempt),
             lambda sc: sc.score_codes(seq1_codes, codes, weights),
             "chunk scoring",
             budget=budget,

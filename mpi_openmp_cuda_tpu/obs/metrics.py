@@ -132,6 +132,37 @@ class MetricsRegistry:
             self.inc("serve_batches")
             self.gauge("batch_fill_ratio", float(fields.get("fill", 0.0)))
             self.gauge("queue_depth", int(fields.get("depth", 0)))
+        elif event == "serve.request.failed":
+            # Deadline misses get their own SLO counter; every other
+            # typed failure (poison isolation, ...) shares one.
+            if fields.get("error") == "deadline":
+                self.inc("serve_deadline_rejections")
+            else:
+                self.inc("serve_failures")
+        elif event == "serve.request.shed":
+            self.inc("serve_shed")
+        elif event == "serve.shed.state":
+            self.inc("serve_shed_transitions")
+            self.gauge("shed_state", str(fields.get("state", "")))
+        elif event == "serve.queue.wait":
+            self.observe("queue_wait_s", float(fields.get("wait_s", 0.0)))
+        elif event == "serve.request.abandoned":
+            self.inc("serve_abandoned")
+        elif event == "serve.request.poisoned":
+            self.inc("serve_poisoned")
+        elif event == "serve.block.failed":
+            self.inc("serve_block_failures")
+        elif event == "serve.client.lost":
+            self.inc("serve_clients_lost")
+        elif event.startswith("breaker."):
+            # breaker.open / breaker.half_open / breaker.close -> one
+            # counter each, plus the current-state gauge the chaos tier
+            # reads back out of the run report.
+            what = event.partition(".")[2]
+            self.inc(f"breaker_{what}s")
+            self.gauge(
+                "breaker_state", "closed" if what == "close" else what
+            )
         else:
             # Forward-compatible: an unmapped event still leaves a trace.
             self.inc(f"events.{event}")

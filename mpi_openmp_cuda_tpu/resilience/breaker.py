@@ -1,0 +1,179 @@
+"""Circuit breaker over the backend degrade chain (serve-plane SLO armor).
+
+The PR-1 degrade chain reacts per request: every exhausted retry budget
+walks pallas→xla→xla-gather and re-verifies the degraded backend against
+the oracle before trusting it.  That is the right shape for a one-shot
+batch run, but a persistent server facing a *systemic* primary-backend
+failure (driver wedge, bad build, device loss) would pay the full
+retry-then-degrade-then-verify cost on every superblock forever.  The
+breaker watches the dispatch path's transient failures and, after
+``threshold`` of them inside a ``window_ticks`` window, OPENS: the
+degraded backend is pinned fleet-wide via
+:meth:`~..resilience.degrade.BackendDegrader.pin` and dispatch bypasses
+the primary entirely (and, because the degrader's ``verified`` flag is
+sticky, oracle re-verification is not repeated per request).  After
+``cooldown_ticks`` the breaker goes HALF-OPEN and lets exactly one
+probe through on the restored primary: success closes the breaker,
+failure re-opens it for another cooldown.
+
+Determinism contract (seqlint SEQ005, role ``deterministic``): windows
+and cooldowns count serve-loop *ticks*, never wall clock.  The serve
+loop calls :meth:`CircuitBreaker.tick` once per iteration; given the
+same failure sequence at the same ticks, the breaker transitions
+identically on every run — which is what makes the serve chaos tier's
+open→half-open→close cycle reproducible.
+
+State machine::
+
+    closed --(threshold transient failures in window)--> open
+    open   --(cooldown_ticks elapsed)-----------------> half_open
+    half_open --(probe succeeds)----------------------> closed
+    half_open --(probe fails)-------------------------> open
+
+Every transition publishes a ``breaker.open`` / ``breaker.half_open`` /
+``breaker.close`` bus event (obs/metrics.py folds them into the
+``breaker_*`` counters and the ``breaker_state`` gauge).
+"""
+
+from __future__ import annotations
+
+import collections
+
+from ..obs.events import log_line, publish
+
+STATE_CLOSED = "closed"
+STATE_OPEN = "open"
+STATE_HALF_OPEN = "half_open"
+
+#: Transient dispatch failures inside the window that open the breaker.
+DEFAULT_THRESHOLD = 3
+#: Failure-memory horizon, in serve-loop ticks.
+DEFAULT_WINDOW_TICKS = 16
+#: Ticks an open breaker waits before probing half-open.
+DEFAULT_COOLDOWN_TICKS = 8
+
+
+class CircuitBreaker:
+    """Tick-counted breaker pinning the degrade chain while open.
+
+    Owned and ticked by the serve loop's main thread only — no locking,
+    by design: ``record_failure``/``record_success`` are invoked from
+    the dispatch path, which runs on the same thread as ``tick``.
+    """
+
+    def __init__(
+        self,
+        degrader,
+        *,
+        threshold: int = DEFAULT_THRESHOLD,
+        window_ticks: int = DEFAULT_WINDOW_TICKS,
+        cooldown_ticks: int = DEFAULT_COOLDOWN_TICKS,
+        log=log_line,
+    ):
+        if threshold < 1:
+            raise ValueError(f"breaker threshold must be >= 1, got {threshold}")
+        if window_ticks < 1:
+            raise ValueError(
+                f"breaker window must be >= 1 tick, got {window_ticks}"
+            )
+        if cooldown_ticks < 1:
+            raise ValueError(
+                f"breaker cooldown must be >= 1 tick, got {cooldown_ticks}"
+            )
+        self.degrader = degrader
+        self.threshold = int(threshold)
+        self.window_ticks = int(window_ticks)
+        self.cooldown_ticks = int(cooldown_ticks)
+        self.state = STATE_CLOSED
+        self.opens = 0
+        self._log = log
+        self._ticks = 0
+        self._opened_at = 0
+        self._failures: collections.deque[int] = collections.deque()
+
+    def tick(self) -> None:
+        """One serve-loop iteration: age the failure window; an open
+        breaker whose cooldown has elapsed moves to half-open and
+        restores the primary backend for the probe dispatch."""
+        self._ticks += 1
+        self._trim()
+        if (
+            self.state == STATE_OPEN
+            and self._ticks - self._opened_at >= self.cooldown_ticks
+        ):
+            self._half_open()
+
+    def bypass_primary(self) -> bool:
+        """True while open: dispatch goes straight to the pinned
+        degraded backend, skipping the primary attempt + retry ladder
+        (and the per-request oracle re-verification with it)."""
+        return self.state == STATE_OPEN
+
+    def record_failure(self) -> None:
+        """A transient (retryable) failure on the primary dispatch
+        path.  Fatal errors never reach here — they are not a backend
+        health signal (io/pipeline.py filters on FATAL_ERROR_TYPES)."""
+        if self.state == STATE_OPEN:
+            return
+        if self.state == STATE_HALF_OPEN:
+            self._open(reason="probe-failed")
+            return
+        if not (self.degrader.enabled and self.degrader.can_degrade()):
+            # Nothing to pin: without --degrade (or with the chain
+            # exhausted) an open breaker could only bypass onto the
+            # same failing backend.
+            return
+        self._failures.append(self._ticks)
+        self._trim()
+        if len(self._failures) >= self.threshold:
+            self._open(reason="threshold")
+
+    def record_success(self) -> None:
+        """A primary dispatch completed: a half-open probe that
+        succeeds closes the breaker (closed-state successes are not
+        state transitions — the window forgets on its own)."""
+        if self.state == STATE_HALF_OPEN:
+            self._close()
+
+    def _trim(self) -> None:
+        horizon = self._ticks - self.window_ticks
+        while self._failures and self._failures[0] < horizon:
+            self._failures.popleft()
+
+    def _open(self, reason: str) -> None:
+        pinned = self.degrader.pin() or self.degrader.scorer.backend
+        self.state = STATE_OPEN
+        self.opens += 1
+        self._opened_at = self._ticks
+        self._failures.clear()
+        publish("breaker.open", backend=pinned, reason=reason, tick=self._ticks)
+        self._log(
+            f"mpi_openmp_cuda_tpu: breaker OPEN ({reason}): backend "
+            f"{pinned!r} pinned fleet-wide; probing primary in "
+            f"{self.cooldown_ticks} tick(s)"
+        )
+
+    def _half_open(self) -> None:
+        self.state = STATE_HALF_OPEN
+        self.degrader.reset()
+        publish(
+            "breaker.half_open",
+            backend=self.degrader.scorer.backend,
+            tick=self._ticks,
+        )
+        self._log(
+            "mpi_openmp_cuda_tpu: breaker HALF-OPEN: probing primary "
+            f"backend {self.degrader.scorer.backend!r}"
+        )
+
+    def _close(self) -> None:
+        self.state = STATE_CLOSED
+        self._failures.clear()
+        publish(
+            "breaker.close",
+            backend=self.degrader.scorer.backend,
+            tick=self._ticks,
+        )
+        self._log(
+            "mpi_openmp_cuda_tpu: breaker CLOSED: primary backend healthy"
+        )

@@ -71,6 +71,8 @@ class BackendDegrader:
         self.enabled = enabled
         self.verified = False  # first degraded chunk oracle-checked yet?
         self._log = log or log_line
+        self._original = scorer
+        self._built: dict[str, object] = {}  # degraded scorers, by backend
 
     def step(self) -> str | None:
         """Fall one link down the chain; returns the new backend name, or
@@ -84,8 +86,35 @@ class BackendDegrader:
             f"exhausted its retry budget; degrading to {nxt!r} (the first "
             "degraded chunk is re-verified against the host oracle)"
         )
-        self.scorer = self._make(nxt)
+        self.scorer = self._scorer_for(nxt)
         return nxt
+
+    def can_degrade(self) -> bool:
+        """True when the chain has somewhere to fall from the ORIGINAL
+        backend (the circuit breaker's precondition for opening)."""
+        return DEGRADE_CHAIN.get(self._original.backend) is not None
+
+    def pin(self) -> str | None:
+        """Circuit-breaker open: ensure the live scorer is a degraded
+        backend and return its name.  Already-degraded chains stay where
+        they fell; from the primary this is one :meth:`step` down."""
+        if self.scorer.backend != self._original.backend:
+            return self.scorer.backend
+        return self.step()
+
+    def reset(self) -> None:
+        """Circuit-breaker half-open probe: restore the primary scorer.
+        The ``verified`` flag deliberately survives — oracle
+        re-verification is once per run, not once per pin cycle, and the
+        degraded scorers stay cached in ``_built`` with their jit caches
+        warm for the next open."""
+        self.scorer = self._original
+
+    def _scorer_for(self, backend: str):
+        scorer = self._built.get(backend)
+        if scorer is None:
+            scorer = self._built[backend] = self._make(backend)
+        return scorer
 
 
 def verify_rows_against_oracle(seq1_codes, seq2_codes, weights, rows) -> None:

@@ -67,6 +67,26 @@ Hang sites require an armed watchdog (``--deadline`` /
 error (:class:`~.watchdog.HangWithoutDeadlineError`) — the alternative
 is a run that blocks forever.  ``kind=`` is meaningless for hang/kill
 sites and rejected.
+
+Serve-plane sites (PR 9) are **marker** sites: they are consulted via
+the non-raising :func:`scheduled` probe and the serve plane itself
+shapes the failure — nothing raises at the probe point, so ``kind=`` is
+rejected for them too:
+
+==========================  ==================================================
+``slow-client``             this ``Responder.send`` behaves like a client
+                            whose socket buffer never drains — the record is
+                            dropped and the responder marked dead (the
+                            write-timeout armor's classification)
+``dead-socket-midstream``   the client vanished between records: this send
+                            finds the socket dead
+``poison-session``          the session built from this request is poisoned —
+                            every superblock containing it fails fatally
+                            until quarantine bisection isolates it
+``overload-burst``          this request arrives as part of a modelled burst
+                            that exhausts the admission bucket on its own
+                            (a typed ``overloaded`` rejection)
+==========================  ==================================================
 """
 
 from __future__ import annotations
@@ -75,21 +95,34 @@ from dataclasses import dataclass
 
 from ..obs.events import publish
 
-KNOWN_SITES = frozenset(
+# Serve-plane marker sites: consulted with scheduled(), never fire().
+SERVE_SITES = frozenset(
     {
-        "chunk_dispatch",
-        "chunk_scoring",
-        "device_transfer",
-        "journal_append",
-        "broadcast_problem",
-        "broadcast_chunk",
-        "broadcast_index_set",
-        "broadcast_stream_meta",
-        "hang:dispatch",
-        "hang:gather",
-        "hang:broadcast",
-        "kill:journal-append",
+        "slow-client",
+        "dead-socket-midstream",
+        "poison-session",
+        "overload-burst",
     }
+)
+
+KNOWN_SITES = (
+    frozenset(
+        {
+            "chunk_dispatch",
+            "chunk_scoring",
+            "device_transfer",
+            "journal_append",
+            "broadcast_problem",
+            "broadcast_chunk",
+            "broadcast_index_set",
+            "broadcast_stream_meta",
+            "hang:dispatch",
+            "hang:gather",
+            "hang:broadcast",
+            "kill:journal-append",
+        }
+    )
+    | SERVE_SITES
 )
 
 # Survival-site aliases: which *fire point* each hang/kill site rides.
@@ -179,10 +212,12 @@ def parse_spec(spec: str) -> dict[str, SiteFaults]:
                 kv[key] = n
         if "fail" not in kv:
             raise ValueError(f"--faults entry for {site!r} needs fail=N")
-        if "kind" in kv and site.partition(":")[0] in ("hang", "kill"):
+        if "kind" in kv and (
+            site.partition(":")[0] in ("hang", "kill") or site in SERVE_SITES
+        ):
             raise ValueError(
-                f"--faults site {site!r} does not take kind= (a hang is "
-                "classified by the watchdog; a kill has no classification)"
+                f"--faults site {site!r} does not take kind= (the failure "
+                "shape is the site's own, not a raised error class)"
             )
         if site in sites:
             raise ValueError(f"duplicate --faults site {site!r}")
@@ -194,7 +229,19 @@ class FaultRegistry:
     """Per-run fault state: invocation counters + the parsed schedule."""
 
     def __init__(self, spec: str | dict[str, SiteFaults]):
-        self.sites = parse_spec(spec) if isinstance(spec, str) else dict(spec)
+        if isinstance(spec, str):
+            self.sites = parse_spec(spec)
+        else:
+            unknown = sorted(set(spec) - KNOWN_SITES)
+            if unknown:
+                # Pre-built dict specs get the same unknown-site guard as
+                # the string grammar — a typo'd site must not silently
+                # test nothing.
+                raise ValueError(
+                    f"bad --faults site {unknown[0]!r}: known sites are "
+                    f"{', '.join(sorted(KNOWN_SITES))}"
+                )
+            self.sites = dict(spec)
         self.counts: dict[str, int] = {}
         self.injected = 0
 
@@ -205,6 +252,17 @@ class FaultRegistry:
         self.counts[site] = n + 1
         sf = self.sites.get(site)
         return sf is not None and sf.after <= n < sf.after + sf.fail
+
+    def scheduled(self, site: str) -> bool:
+        """Marker-site probe: bump the counter and report (never raise)
+        whether this invocation is scheduled — the serve plane shapes
+        the failure itself (a deadened responder, a poisoned session, an
+        inflated admission price)."""
+        if self._scheduled(site):
+            self.injected += 1
+            publish("fault.injected", site=site, kind="marker")
+            return True
+        return False
 
     def fire(self, site: str) -> None:
         n = self.counts.get(site, 0)
@@ -267,3 +325,10 @@ def fire(site: str) -> None:
     """Instrumentation hook: raises per the armed schedule, else no-op."""
     if _active is not None:
         _active.fire(site)
+
+
+def scheduled(site: str) -> bool:
+    """Non-raising marker probe (serve chaos sites): True when the armed
+    schedule marks this invocation; a single attribute check when no
+    registry is armed."""
+    return _active is not None and _active.scheduled(site)

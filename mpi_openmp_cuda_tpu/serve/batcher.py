@@ -64,6 +64,10 @@ def plan_blocks(sessions, rows_per_block: int) -> list[SuperBlock]:
         )
     groups: dict[tuple, list[tuple]] = {}
     for sess in sessions:
+        if getattr(sess, "closed", False):
+            # Retired mid-tick (deadline miss, quarantined poison,
+            # abandoned client): its rows must not occupy blocks.
+            continue
         key = (tuple(int(w) for w in sess.weights), sess.seq1)
         rows = groups.setdefault(key, [])
         for j, codes in enumerate(sess.seq2_codes):

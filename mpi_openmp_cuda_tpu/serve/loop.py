@@ -32,6 +32,17 @@ warm plane compiles the block shapes BEFORE the loop starts and
 first block is no longer a grace period, and ``make aot-smoke`` gates
 the stricter contract.
 
+**SLO armor** (PR 9): admission is a cost-aware token bucket plus the
+accept/shed-new/drain-only machine (:mod:`.slo`); per-request deadlines
+are checked at admission pricing, at batch planning
+(:meth:`ServeLoop._admit_sessions`), and at demux
+(:meth:`.session.Session.fill`); a superblock that fails past its whole
+retry/degrade ladder is retried once whole and then BISECTED so one
+poison request is isolated with a typed error while its co-batched
+victims re-plan onto clean blocks; and the pipeline's circuit breaker
+(:mod:`..resilience.breaker`), ticked here, pins the degraded backend
+after repeated primary failures.
+
 Threading: socket reader threads only ``json.loads`` + enqueue (see
 :mod:`.queue`); parsing, scoring, span recording, and ALL journal/metric
 mutation happen on the main loop thread.
@@ -40,8 +51,11 @@ mutation happen on the main loop thread.
 from __future__ import annotations
 
 import socket as socketlib
+import struct
 import sys
 import threading
+
+import numpy as np
 
 from ..analysis.recompile import compile_count
 from ..io.pipeline import PendingWindow
@@ -49,10 +63,13 @@ from ..obs.events import log_line, publish
 from ..obs.metrics import gauge as obs_gauge
 from ..obs.spans import span
 from ..resilience.drain import DrainInterrupt, drain_requested
+from ..resilience.faults import InjectedFatalFaultError
+from ..resilience.faults import scheduled as _fault_scheduled
+from ..utils.constants import BUF_SIZE_SEQ2
 from ..utils.platform import env_float, env_int
-from .batcher import DEFAULT_BLOCK_ROWS, plan_blocks
+from .batcher import DEFAULT_BLOCK_ROWS, SuperBlock, plan_blocks
 from .clock import ServeClock
-from .queue import ADMIT_CLOSED, ADMIT_FULL, RequestQueue
+from .queue import ADMIT_CLOSED, ADMIT_FULL, ADMIT_OVERLOADED, RequestQueue
 from .session import (
     RequestError,
     Responder,
@@ -61,6 +78,7 @@ from .session import (
     load_drained,
     parse_raw,
 )
+from .slo import SHED_DRAIN, AdmissionController
 
 #: Upper bound on one queue wait: the drain flag is re-checked at least
 #: this often even if no request ever arrives.
@@ -101,15 +119,23 @@ class ServeLoop:
             if max_pop is not None
             else env_int("SEQALIGN_SERVE_MAX_POP", 0)
         )
+        self.controller = AdmissionController(
+            budget_s=env_float("SEQALIGN_SERVE_COST_BUDGET_S", 4.0),
+            shed_wait_s=env_float("SEQALIGN_SERVE_SHED_WAIT_S", 30.0),
+        )
         self.queue = RequestQueue(
             max_depth
             if max_depth is not None
             else env_int("SEQALIGN_SERVE_MAX_QUEUE", 256),
             self.clock,
+            controller=self.controller,
         )
         self.window = PendingWindow(
             max(1, env_int("TPU_SEQALIGN_STREAM_DEPTH", 4)), self._finish
         )
+        # The pipeline's circuit breaker (None without --degrade): the
+        # loop ticks it so open/half-open transitions stay deterministic.
+        self.breaker = getattr(pipeline, "breaker", None)
         self._steady_base: int | None = None
 
     # -- ingest (reader threads and the main-thread stdin loop) -----------
@@ -140,6 +166,14 @@ class ServeLoop:
                     "queued); resubmit later",
                 }
             )
+        elif verdict == ADMIT_OVERLOADED:
+            responder.send(
+                {
+                    "id": raw.get("id"),
+                    "error": "overloaded",
+                    "retry_after_s": self.controller.retry_after_s(),
+                }
+            )
         elif verdict == ADMIT_CLOSED:
             responder.send(
                 {
@@ -153,11 +187,18 @@ class ServeLoop:
     def _dispatch(self, block) -> None:
         """Async-dispatch one superblock under its own shared retry
         budget (the per-superblock watchdog deadline rides inside the
-        scorer, unchanged from batch mode)."""
+        scorer, unchanged from batch mode).  A failure that escapes the
+        whole retry/degrade ladder quarantines instead of killing the
+        loop."""
         budget = self.policy.new_budget()
-        promise = self.pipeline.dispatch(
-            block.seq1_codes, block.codes, block.weights, budget
-        )
+        try:
+            self._check_poison(block)
+            promise = self.pipeline.dispatch(
+                block.seq1_codes, block.codes, block.weights, budget
+            )
+        except Exception as e:
+            self._block_failed(block, e)
+            return
         publish(
             "serve.batch.dispatch",
             rows=block.real_rows,
@@ -169,9 +210,16 @@ class ServeLoop:
     def _finish(self, promise, block, budget) -> None:
         """Materialise one superblock and demux rows to sessions by tag
         (pad rows carry a ``None`` tag and are dropped)."""
-        rows = self.pipeline.materialise(
-            promise, block.seq1_codes, block.codes, block.weights, budget
-        )
+        try:
+            rows = self.pipeline.materialise(
+                promise, block.seq1_codes, block.codes, block.weights, budget
+            )
+        except Exception as e:
+            self._block_failed(block, e)
+            return
+        self._demux(rows, block)
+
+    def _demux(self, rows, block) -> None:
         with span("serve.request.emit"):
             for row, tag in zip(rows, block.tags):
                 if tag is not None:
@@ -184,6 +232,106 @@ class ServeLoop:
             # already pinned the baseline at tick 0.
             self._steady_base = compile_count()
 
+    # -- poison-request quarantine ----------------------------------------
+
+    def _check_poison(self, block) -> None:
+        """Chaos marker: a poisoned session makes every superblock that
+        contains it fail FATALLY (ValueError — skips retry and degrade),
+        so the quarantine bisection below is what has to save its
+        co-batched victims."""
+        for tag in block.tags:
+            if tag is not None and getattr(tag[0], "poisoned", False):
+                raise InjectedFatalFaultError(
+                    f"poisoned session {tag[0].id!r} co-batched in this "
+                    "superblock"
+                )
+
+    def _block_failed(self, block, err) -> None:
+        """Quarantine stage 1: a superblock failed past its whole
+        retry/degrade ladder.  One synchronous whole-block retry under a
+        fresh budget (transient wedges clear); a block that fails twice
+        is bisected by session so the poison is isolated and its
+        co-batched victims are re-planned onto clean blocks."""
+        publish("serve.block.failed", rows=block.real_rows, error=str(err))
+        log_line(
+            f"mpi_openmp_cuda_tpu: serve: superblock failed ({err}); "
+            "retrying the whole block before bisection"
+        )
+        try:
+            self._score_block_sync(block)
+        except Exception as e:
+            self._bisect(block, e)
+
+    def _score_block_sync(self, block) -> None:
+        """Score one superblock synchronously under a fresh budget and
+        demux — the quarantine path's unit of work."""
+        self._check_poison(block)
+        budget = self.policy.new_budget()
+        promise = self.pipeline.dispatch(
+            block.seq1_codes, block.codes, block.weights, budget
+        )
+        rows = self.pipeline.materialise(
+            promise, block.seq1_codes, block.codes, block.weights, budget
+        )
+        self._demux(rows, block)
+
+    def _bisect(self, block, err) -> None:
+        """Quarantine stage 2: split the failed block's sessions in half
+        and score each half on its own padded block, recursing on
+        failure.  A block that fails twice with ONE session left holds
+        the poison: that session is answered with a typed error and the
+        recursion ends — every other session was already re-planned onto
+        a block that scored clean."""
+        groups: list[tuple] = []  # (session, [(j, codes), ...]) in order
+        index: dict[int, tuple] = {}
+        for tag, codes in zip(block.tags, block.codes):
+            if tag is None:
+                continue
+            sess, j = tag
+            if sess.closed:
+                continue
+            g = index.get(id(sess))
+            if g is None:
+                g = index[id(sess)] = (sess, [])
+                groups.append(g)
+            g[1].append((j, codes))
+        if not groups:
+            return
+        if len(groups) == 1:
+            sess = groups[0][0]
+            publish("serve.request.poisoned", id=sess.id)
+            log_line(
+                f"mpi_openmp_cuda_tpu: serve: quarantined poison request "
+                f"{sess.id!r} ({err})"
+            )
+            sess.fail(f"poison: superblock failed twice in isolation ({err})")
+            return
+        mid = (len(groups) + 1) // 2
+        for half in (groups[:mid], groups[mid:]):
+            sub = self._subblock(block, half)
+            try:
+                self._score_block_sync(sub)
+            except Exception as e:
+                self._bisect(sub, e)
+
+    def _subblock(self, block, groups) -> SuperBlock:
+        """Re-plan a subset of a failed block's sessions into a fresh
+        block of the SAME fixed shape (rows_per_block x the parent's
+        bucket), so quarantine dispatches stay on warm jit caches."""
+        members = [
+            (sess, j, codes) for sess, rows in groups for (j, codes) in rows
+        ]
+        pad_len = min(max(c.size for (_, _, c) in members), BUF_SIZE_SEQ2)
+        pad = np.ones(pad_len, dtype=np.int8)
+        n_pad = max(0, self.rows_per_block - len(members))
+        return SuperBlock(
+            weights=block.weights,
+            seq1_codes=block.seq1_codes,
+            codes=[c for (_, _, c) in members] + [pad] * n_pad,
+            tags=[(s, j) for (s, j, _) in members] + [None] * n_pad,
+            real_rows=len(members),
+        )
+
     def baseline_steady(self) -> None:
         """Pin the steady-compile baseline NOW — called after a prewarm,
         BEFORE the first tick, so the very first block is already held
@@ -193,23 +341,69 @@ class ServeLoop:
         self._steady_base = compile_count()
         obs_gauge("serve_prewarmed", 1)
 
+    def _release_session(self, sess) -> None:
+        """Session ``on_close``: return its admission-bucket tokens (the
+        token bucket refills on completions, keeping admission
+        deterministic)."""
+        self.controller.release(sess.cost_s)
+
+    def _admit_sessions(self, sessions, now: float) -> list:
+        """Deadline/abandonment checkpoint at batch planning: a session
+        already past its deadline — or whose modelled wall cannot fit
+        the remaining budget — is answered with the typed ``deadline``
+        error instead of occupying superblock rows; a session whose
+        client vanished is retired silently (its queue cost releases
+        either way)."""
+        live = []
+        for sess in sessions:
+            if sess.closed:
+                continue
+            if sess.abandoned:
+                sess.abandon()
+                continue
+            if sess.deadline_t is not None:
+                remaining = sess.deadline_t - now
+                if remaining <= 0.0 or sess.cost_s > remaining:
+                    sess.fail(
+                        "deadline", estimated_s=round(sess.cost_s, 6)
+                    )
+                    continue
+            live.append(sess)
+        return live
+
     def tick(self) -> bool:
         """One loop iteration; returns False once idle with no sources
         left (the stdin/file mode's termination condition)."""
         if drain_requested():
             self._drain(())
+        window_s = (
+            0.0 if self.controller.state == SHED_DRAIN else self.window_s
+        )
         items = self.queue.pop_ready(
-            _TICK_S, self.window_s, self.max_pop, wake=drain_requested
+            _TICK_S, window_s, self.max_pop, wake=drain_requested
         )
         if drain_requested():
             # Popped-but-unstarted requests at the drain boundary are
             # "queued" for journal purposes: nothing was dispatched yet.
             self._drain(items)
+        if self.breaker is not None:
+            self.breaker.tick()
+        now = self.clock.now()
+        if items:
+            for item in items:
+                wait = max(0.0, now - item.admitted_t)
+                self.controller.observe_wait(wait)
+                publish("serve.queue.wait", wait_s=round(wait, 6))
+        elif self.queue.depth() == 0:
+            self.controller.note_idle()
+        self.controller.update_state()
         sessions = []
         for item in items:
             try:
                 with span("serve.request.parse"):
-                    sess = build_session(item, self.clock)
+                    sess = build_session(
+                        item, self.clock, on_close=self._release_session
+                    )
             except RequestError as e:
                 publish(
                     "serve.request.rejected",
@@ -219,16 +413,22 @@ class ServeLoop:
                 item.responder.send(
                     {"id": item.raw.get("id"), "error": str(e)}
                 )
+                self.controller.release(item.cost_s)
                 continue
+            if _fault_scheduled("poison-session"):
+                # Chaos marker: superblocks containing this session fail
+                # fatally until quarantine isolates it.
+                sess.poisoned = True
             sessions.append(sess)
-        if sessions:
-            for block in plan_blocks(sessions, self.rows_per_block):
+        live = self._admit_sessions(sessions, now)
+        if live:
+            for block in plan_blocks(live, self.rows_per_block):
                 self._dispatch(block)
             self.window.flush()
-            for sess in sessions:
-                # Emits the done record for empty (n == 0) requests; a
-                # no-op for sessions already completed through demux.
-                sess.advance()
+        for sess in sessions:
+            # Emits the done record for empty (n == 0) requests; a
+            # no-op for sessions already completed or failed.
+            sess.advance()
         obs_gauge("queue_depth", self.queue.depth())
         return bool(items) or not self.queue.idle()
 
@@ -272,15 +472,49 @@ def _serve_connection(loop: ServeLoop, conn) -> None:
     """One client connection's reader thread: lines in, queue in; the
     responder (writer side) is driven from the main loop thread.  The
     connection stays open after client EOF so pending results flow; a
-    client that disconnects hard just deadens its responder."""
+    client that disconnects hard just deadens its responder.
+
+    Slow-client armor: a send timeout (SO_SNDTIMEO — NOT
+    ``conn.settimeout``, which would also time out this thread's
+    blocking reads) bounds how long a full client socket buffer can
+    stall the main loop's emit path; a timed-out write raises OSError
+    in ``Responder.send`` and the client is classified dead.
+
+    Each connection holds ONE queue source while its reader lives or
+    its responder is healthy; whichever dies first releases it exactly
+    once, so a vanished client cannot pin the queue's source refcount
+    (or, through it, the gather window) until drain.
+    """
+    timeout_s = env_float("SEQALIGN_SERVE_WRITE_TIMEOUT_S", 5.0)
+    if timeout_s and timeout_s > 0:
+        tv = struct.pack(
+            "ll", int(timeout_s), int((timeout_s % 1.0) * 1e6)
+        )
+        try:
+            conn.setsockopt(socketlib.SOL_SOCKET, socketlib.SO_SNDTIMEO, tv)
+        except (OSError, ValueError):  # pragma: no cover - platform quirk
+            pass
     rfile = conn.makefile("r", encoding="utf-8", newline="\n")
     wfile = conn.makefile("w", encoding="utf-8", newline="\n")
-    responder = Responder(wfile)
+    state = {"released": False}
+    release_lock = threading.Lock()
+
+    def _release() -> None:
+        with release_lock:
+            if state["released"]:
+                return
+            state["released"] = True
+        loop.queue.close_source()
+
+    responder = Responder(wfile, on_dead=_release)
+    loop.queue.open_source()
     try:
         for line in rfile:
             loop.ingest(line, responder)
     except (OSError, ValueError):
         pass
+    finally:
+        _release()
 
 
 def _accept_loop(loop: ServeLoop, sock) -> None:
@@ -312,8 +546,21 @@ def run_serve(args, timer, policy, deg, out_stream=None, prewarmed=False) -> int
     from ..io.pipeline import ChunkPipeline
     from ..io.parse import open_input
 
+    breaker = None
+    if deg is not None and deg.enabled:
+        from ..resilience.breaker import STATE_CLOSED, CircuitBreaker
+
+        breaker = CircuitBreaker(
+            deg,
+            threshold=env_int("SEQALIGN_BREAKER_THRESHOLD", 3),
+            window_ticks=env_int("SEQALIGN_BREAKER_WINDOW", 16),
+            cooldown_ticks=env_int("SEQALIGN_BREAKER_COOLDOWN", 8),
+        )
+        obs_gauge("breaker_state", STATE_CLOSED)
     loop = ServeLoop(
-        ChunkPipeline(policy, deg), policy, journal_path=args.journal
+        ChunkPipeline(policy, deg, breaker=breaker),
+        policy,
+        journal_path=args.journal,
     )
     if prewarmed:
         loop.baseline_steady()

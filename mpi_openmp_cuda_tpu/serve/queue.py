@@ -3,12 +3,15 @@
 Reader threads (socket connections, the stdin ingest) call
 :meth:`RequestQueue.submit`; the main serve loop calls
 :meth:`RequestQueue.pop_ready`.  Admission is **deterministic**: the
-only decision input is the current queue depth against ``max_depth`` —
-never a clock, never a rate estimate — so the same submission sequence
-always admits and rejects identically (this file is on seqlint
-SEQ005's deterministic-path list, like ``resilience/``).  The admit
-*timestamp* is recorded (for the request-latency histogram) but never
-decides anything.
+decision inputs are the current queue depth against ``max_depth`` and
+the admission controller's token bucket of modelled superblock-wall
+cost (:mod:`.slo` — pure host arithmetic over the request's lengths,
+refilled by completions, never a clock or a measured rate) — so the
+same submission sequence with the same completion order admits and
+rejects identically (this file is on seqlint SEQ005's
+deterministic-path list, like ``resilience/``).  The admit *timestamp*
+is recorded (for the latency histogram and the shed-state wait
+percentiles) but never decides a single admission.
 
 Requests are held as RAW parsed dicts: full validation (weights range,
 sequence alphabet, buffer caps) happens on the main loop thread in
@@ -35,19 +38,22 @@ from ..obs.events import publish
 ADMIT_OK = "ok"
 ADMIT_FULL = "full"
 ADMIT_CLOSED = "closed"
+ADMIT_OVERLOADED = "overloaded"
 
 
 @dataclasses.dataclass
 class QueuedRequest:
     """One admitted raw request awaiting the loop: the unvalidated dict,
     the responder that owns its result lines, the admit time (histogram
-    input only), and a process-unique sequence number (the default
-    request id)."""
+    input only), a process-unique sequence number (the default request
+    id), and the modelled wall charged against the admission bucket
+    (released when the session retires)."""
 
     raw: dict
     responder: object
     admitted_t: float
     seq: int
+    cost_s: float = 0.0
 
 
 class RequestQueue:
@@ -59,11 +65,14 @@ class RequestQueue:
     the drain; ``drain_pending()`` hands the leftovers to the journal.
     """
 
-    def __init__(self, max_depth: int, clock):
+    def __init__(self, max_depth: int, clock, controller=None):
         if max_depth < 1:
             raise ValueError(f"max_depth must be >= 1, got {max_depth}")
         self.max_depth = int(max_depth)
         self._clock = clock
+        # Optional slo.AdmissionController; lock order is strictly
+        # queue -> controller (the controller never calls back here).
+        self._controller = controller
         self._cond = threading.Condition()
         self._items: list[QueuedRequest] = []
         self._closed = False
@@ -96,7 +105,20 @@ class RequestQueue:
                     depth=len(self._items),
                 )
                 return ADMIT_CLOSED
+            cost = 0.0
+            if self._controller is not None:
+                rejection, cost = self._controller.admit(raw)
+                if rejection is not None:
+                    publish(
+                        "serve.request.shed",
+                        reason=rejection,
+                        depth=len(self._items),
+                    )
+                    return ADMIT_OVERLOADED
             if len(self._items) >= self.max_depth:
+                if self._controller is not None:
+                    # The bucket admitted it; the depth backstop did not.
+                    self._controller.release(cost)
                 publish(
                     "serve.request.rejected",
                     reason="full",
@@ -105,7 +127,9 @@ class RequestQueue:
                 return ADMIT_FULL
             self._seq += 1
             self._items.append(
-                QueuedRequest(raw, responder, self._clock.now(), self._seq)
+                QueuedRequest(
+                    raw, responder, self._clock.now(), self._seq, cost
+                )
             )
             publish("serve.request.admitted", depth=len(self._items))
             self._cond.notify_all()

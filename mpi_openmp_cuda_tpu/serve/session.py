@@ -46,7 +46,9 @@ from ..io.parse import _parse_header_tokens
 from ..io.printer import format_result
 from ..models.encoding import encode_normalized
 from ..obs.events import publish
+from ..resilience.faults import scheduled as _fault_scheduled
 from ..utils.constants import BUF_SIZE_SEQ1, BUF_SIZE_SEQ2
+from ..utils.platform import env_float
 
 
 class RequestError(ValueError):
@@ -62,12 +64,42 @@ class Responder:
     it must not take the loop (or other clients) down with it.
     """
 
-    def __init__(self, out):
+    def __init__(self, out, on_dead=None):
         self._out = out
         self._lock = threading.Lock()
         self._dead = False
+        self._on_dead = on_dead
+
+    @property
+    def dead(self) -> bool:
+        return self._dead
+
+    def mark_dead(self) -> None:
+        """Classify this client dead (failed/timed-out write, chaos
+        marker).  The ``on_dead`` callback fires exactly once, outside
+        the lock — it re-enters the serve queue's source refcount."""
+        notify = False
+        with self._lock:
+            if not self._dead:
+                self._dead = True
+                notify = True
+        if notify and self._on_dead is not None:
+            self._on_dead()
 
     def send(self, obj: dict) -> None:
+        if _fault_scheduled("dead-socket-midstream"):
+            # Chaos marker: the client vanished between records.
+            publish("serve.client.lost", how="dead-socket")
+            self.mark_dead()
+            return
+        if _fault_scheduled("slow-client"):
+            # Chaos marker: a stalled reader whose socket buffer never
+            # drains — the SO_SNDTIMEO armor's classification, without
+            # holding the loop for the real timeout.
+            publish("serve.client.lost", how="slow-client")
+            self.mark_dead()
+            return
+        died = False
         with self._lock:
             if self._dead:
                 return
@@ -75,7 +107,15 @@ class Responder:
                 self._out.write(json.dumps(obj) + "\n")
                 self._out.flush()
             except (OSError, ValueError):
+                # socket.timeout is an OSError: a write that cannot make
+                # progress within SEQALIGN_SERVE_WRITE_TIMEOUT_S lands
+                # here too.
                 self._dead = True
+                died = True
+        if died:
+            publish("serve.client.lost", how="write-failed")
+            if self._on_dead is not None:
+                self._on_dead()
 
 
 def parse_raw(line: str) -> dict:
@@ -97,7 +137,7 @@ class Session:
 
     def __init__(
         self, req_id, weights, seq1, seq1_codes, seq2_codes, responder,
-        admitted_t, clock,
+        admitted_t, clock, deadline_t=None, cost_s=0.0, on_close=None,
     ):
         self.id = req_id
         self.weights = weights
@@ -107,6 +147,11 @@ class Session:
         self.responder = responder
         self._admitted_t = admitted_t
         self._clock = clock
+        self.deadline_t = deadline_t  # absolute clock time, None = no SLO
+        self.cost_s = cost_s  # modelled wall charged at admission
+        self.poisoned = False  # chaos marker: superblocks with me fail
+        self.failed = None  # typed terminal error, if any
+        self._on_close = on_close
         n = len(seq2_codes)
         self.rows = np.zeros((n, 3), dtype=np.int64)
         self._have = [False] * n
@@ -117,9 +162,56 @@ class Session:
     def count(self) -> int:
         return len(self.seq2_codes)
 
+    @property
+    def closed(self) -> bool:
+        """Terminal (done record sent, typed failure, or abandoned):
+        this session may not occupy superblock rows any more — the
+        batcher skips it when (re-)planning."""
+        return self._done
+
+    @property
+    def abandoned(self) -> bool:
+        """The client is gone (dead responder): nobody reads the rows."""
+        return bool(getattr(self.responder, "dead", False))
+
+    def expired(self, now: float) -> bool:
+        return self.deadline_t is not None and now > self.deadline_t
+
+    def _close(self) -> None:
+        cb, self._on_close = self._on_close, None
+        if cb is not None:
+            cb(self)
+
+    def fail(self, error: str, **fields) -> None:
+        """Answer the whole request with ONE typed error record and
+        retire it (deadline misses, quarantined poison)."""
+        if self._done:
+            return
+        self._done = True
+        self.failed = error
+        self.responder.send({"id": self.id, "error": error, **fields})
+        publish("serve.request.failed", id=self.id, error=error)
+        self._close()
+
+    def abandon(self) -> None:
+        """Retire a session whose client vanished: no records (nobody is
+        listening), planned rows released, admission cost returned."""
+        if self._done:
+            return
+        self._done = True
+        self.failed = "abandoned"
+        publish("serve.request.abandoned", id=self.id)
+        self._close()
+
     def fill(self, j: int, row) -> None:
         """Record sequence ``j``'s (score, n, k) row and emit whatever
         prefix became consecutive."""
+        if self._done:
+            return
+        if self.deadline_t is not None and self._clock.now() > self.deadline_t:
+            # Demux-stage deadline checkpoint: the rows landed too late.
+            self.fail("deadline")
+            return
         self.rows[j] = row
         self._have[j] = True
         self.advance()
@@ -127,6 +219,8 @@ class Session:
     def advance(self) -> None:
         """Emit the longest consecutively-filled prefix; on completion,
         emit the done record and publish the latency event."""
+        if self._done:
+            return
         n = self.count
         while self._emitted < n and self._have[self._emitted]:
             j = self._emitted
@@ -151,9 +245,10 @@ class Session:
                 n=n,
                 latency_s=self._clock.now() - self._admitted_t,
             )
+            self._close()
 
 
-def build_session(item, clock) -> Session:
+def build_session(item, clock, on_close=None) -> Session:
     """Validate one queued raw request into a :class:`Session`.
 
     Reuses the batch parser's header validation (same weight-range
@@ -165,6 +260,22 @@ def build_session(item, clock) -> Session:
     raw = item.raw
     rid = raw.get("id")
     rid = f"req-{item.seq}" if rid is None else str(rid)
+    deadline_s = raw.get("deadline_s")
+    if deadline_s is None:
+        deadline_s = env_float("SEQALIGN_SERVE_DEADLINE_S")
+    deadline_t = None
+    if deadline_s is not None:
+        if (
+            isinstance(deadline_s, bool)
+            or not isinstance(deadline_s, (int, float))
+            or deadline_s <= 0
+        ):
+            raise RequestError(
+                f"request {rid!r}: 'deadline_s' must be a positive number"
+            )
+        # The deadline budget starts at ADMISSION, not at validation:
+        # queue wait counts against the SLO.
+        deadline_t = item.admitted_t + float(deadline_s)
     weights = raw.get("weights")
     if not isinstance(weights, (list, tuple)) or len(weights) != 4:
         raise RequestError(
@@ -210,6 +321,9 @@ def build_session(item, clock) -> Session:
     return Session(
         rid, w, s1, seq1_codes, seq2_codes, item.responder,
         item.admitted_t, clock,
+        deadline_t=deadline_t,
+        cost_s=getattr(item, "cost_s", 0.0),
+        on_close=on_close,
     )
 
 

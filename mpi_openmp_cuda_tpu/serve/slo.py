@@ -1,0 +1,244 @@
+"""Serve-plane SLO primitives: modelled request cost, token-bucket
+admission, and the load-shedding state machine.
+
+The paper's exhaustive ``(len1-len2) x len2`` search makes per-request
+cost wildly variable by length, so a binary queue-depth cap either
+starves short requests behind long ones or admits an hour of work into
+a one-second budget.  Admission here is COST-AWARE: every request is
+priced in modelled superblock-wall seconds (``analysis/costmodel``'s
+calibrated per-config wall — the same sheet the schedule auditor
+prices with), and a token bucket bounds the modelled wall of everything
+admitted-but-unfinished.
+
+Determinism contract (seqlint SEQ005, role ``deterministic``): pricing
+is pure host arithmetic over the request's lengths; the bucket refills
+on *completions*, not on a wall-clock rate, so the same submission
+sequence admits and rejects identically on every run.  The only
+time-derived inputs are the queue-wait observations the serve loop
+hands to :meth:`AdmissionController.observe_wait` (computed from the
+injectable ServeClock it already owns) — the controller itself never
+reads a clock.
+
+Shedding is a three-state machine, escalating one state per serve-loop
+tick on the p90 of recent queue waits and de-escalating with
+hysteresis::
+
+    accept ----(p90 >= shed_wait_s)----> shed-new ---(p90 >= 4x)---> drain-only
+    accept <---(p90 < shed_wait_s/2)---- shed-new <--(p90 < .../2)--
+
+``shed-new`` and ``drain-only`` both reject new admissions with a typed
+``overloaded`` error (``retry_after_s`` = the modelled wall of the
+outstanding work — an honest back-off hint); ``drain-only``
+additionally tells the loop to stop gathering (window 0) so the queue
+drains at full tilt.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+
+from ..obs.events import publish
+from ..resilience.faults import scheduled as _fault_scheduled
+from ..utils.constants import BUF_SIZE_SEQ1, BUF_SIZE_SEQ2
+
+_BLK = 128
+
+# Shed states, escalation order (the tuple index is the severity).
+SHED_ACCEPT = "accept"
+SHED_NEW = "shed-new"
+SHED_DRAIN = "drain-only"
+_SHED_ORDER = (SHED_ACCEPT, SHED_NEW, SHED_DRAIN)
+
+# Queue-wait observations the shed percentile is computed over.
+DEFAULT_WAIT_WINDOW = 32
+
+# The percentile driving shed transitions: one slow straggler must not
+# shed, a slow tail must.
+_WAIT_PCTL = 0.9
+
+
+def _ceil_div(a: int, b: int) -> int:
+    return -(-a // b)
+
+
+def _percentile(values, q: float) -> float:
+    if not values:
+        return 0.0
+    ordered = sorted(values)
+    return ordered[min(len(ordered) - 1, int(q * len(ordered)))]
+
+
+def _best_pair_wall_s(nbn: int, nbi: int) -> float:
+    from ..analysis.costmodel import config_cost
+    from ..analysis import CostModelError
+    from ..ops.pallas_scorer import emittable_superblocks
+
+    best = 0.0
+    for sb in emittable_superblocks(nbn, nbi, "i8"):
+        try:
+            wall = config_cost(nbn, nbi, "i8", sb).model_wall_s
+        except CostModelError:
+            continue
+        if best == 0.0 or wall < best:
+            best = wall
+    return best
+
+
+class RequestCostModel:
+    """Modelled superblock-wall pricing for admission decisions.
+
+    Per ``(nbn, nbi)`` block-count pair the price is the BEST emittable
+    config's modelled wall for one fully-live pair at the i8 feed (the
+    serving feed's floor) — a deliberate lower bound: admission must
+    never reject work the hardware could actually make in time, so it
+    prices optimistically and lets the deadline checkpoints catch the
+    rest.  Prices are memoised per block-count pair (the whole space is
+    ~24x16 entries), so steady-state pricing is a dict lookup.
+    """
+
+    def __init__(self):
+        self._pair_wall: dict[tuple[int, int], float] = {}
+
+    def pair_wall_s(self, len1: int, len2: int) -> float:
+        nbn = max(1, _ceil_div(min(int(len1), BUF_SIZE_SEQ1), _BLK))
+        nbi = max(1, _ceil_div(min(int(len2), BUF_SIZE_SEQ2), _BLK))
+        key = (nbn, nbi)
+        wall = self._pair_wall.get(key)
+        if wall is None:
+            wall = self._pair_wall[key] = _best_pair_wall_s(nbn, nbi)
+        return wall
+
+    def request_cost_s(self, raw: dict) -> float:
+        """Modelled wall of one raw (still unvalidated) request.
+        Defensively prices anything malformed at 0.0 — validation
+        rejects it with a typed error on the main thread later; pricing
+        runs on reader threads and must never raise."""
+        try:
+            seq1 = raw.get("seq1")
+            seq2 = raw.get("seq2")
+            if not isinstance(seq1, str) or not isinstance(seq2, list):
+                return 0.0
+            total = 0.0
+            for s in seq2:
+                if isinstance(s, str) and s:
+                    total += self.pair_wall_s(len(seq1), len(s))
+            return total
+        except Exception:
+            return 0.0
+
+
+class AdmissionController:
+    """Token-bucket admission + the accept/shed-new/drain-only machine.
+
+    Thread contract: :meth:`admit` runs on reader threads (under the
+    queue's condition, which never re-enters here), :meth:`release` on
+    whichever thread retires a session, and :meth:`update_state` on the
+    serve loop's main thread once per tick; every mutation is guarded
+    by the controller's own lock (seqlint SEQ008), and the controller
+    never calls back into the queue, so the queue->controller lock
+    order is acyclic.
+    """
+
+    def __init__(
+        self,
+        *,
+        budget_s: float,
+        shed_wait_s: float,
+        cost_model: RequestCostModel | None = None,
+        wait_window: int = DEFAULT_WAIT_WINDOW,
+    ):
+        if budget_s <= 0:
+            raise ValueError(f"admission budget_s must be > 0, got {budget_s}")
+        if shed_wait_s <= 0:
+            raise ValueError(
+                f"shed_wait_s threshold must be > 0, got {shed_wait_s}"
+            )
+        self.budget_s = float(budget_s)
+        self.shed_wait_s = float(shed_wait_s)
+        self.cost_model = cost_model or RequestCostModel()
+        self._lock = threading.Lock()
+        self._outstanding_s = 0.0
+        self._state = SHED_ACCEPT
+        self._waits: collections.deque[float] = collections.deque(
+            maxlen=max(1, int(wait_window))
+        )
+
+    @property
+    def state(self) -> str:
+        return self._state
+
+    def outstanding_s(self) -> float:
+        return self._outstanding_s
+
+    def retry_after_s(self) -> float:
+        """Client back-off hint: the modelled wall of everything already
+        admitted (what must drain before new work fits), floored so a
+        zero-cost rejection still backs off."""
+        return round(max(0.05, self._outstanding_s), 3)
+
+    def admit(self, raw: dict) -> tuple[str | None, float]:
+        """Price one raw request and charge the bucket.  Returns
+        ``(rejection, cost_s)``; rejection is None when admitted (the
+        cost is charged and the caller owes exactly one
+        :meth:`release`), else the shed reason."""
+        cost = self.cost_model.request_cost_s(raw)
+        if _fault_scheduled("overload-burst"):
+            # Chaos marker: this request arrives as part of a modelled
+            # burst that exhausts the bucket on its own.
+            cost = cost + self.budget_s + 1.0
+        with self._lock:
+            if self._state != SHED_ACCEPT:
+                return self._state, cost
+            if (
+                self._outstanding_s > 0.0
+                and self._outstanding_s + cost > self.budget_s
+            ):
+                # An over-budget request against an EMPTY bucket is
+                # still admitted: no completion could ever make it fit,
+                # so rejecting would reject it forever — the deadline
+                # checkpoints are what catch impossible requests.
+                return "overloaded", cost
+            self._outstanding_s += cost
+            return None, cost
+
+    def release(self, cost_s: float) -> None:
+        """Return one admitted request's tokens (request done, failed,
+        abandoned, or rejected at validation)."""
+        with self._lock:
+            self._outstanding_s = max(0.0, self._outstanding_s - cost_s)
+
+    def observe_wait(self, wait_s: float) -> None:
+        """One popped request's queue wait (admission to pop)."""
+        with self._lock:
+            self._waits.append(float(wait_s))
+
+    def note_idle(self) -> None:
+        """Serve-loop signal: the queue is empty this tick, so the next
+        arrival would wait ~nothing — feed a zero observation so the
+        percentile decays and shed states can step back down."""
+        with self._lock:
+            self._waits.append(0.0)
+
+    def update_state(self) -> str:
+        """One tick's shed transition (main loop thread only): move at
+        most one state toward where the wait percentile points."""
+        with self._lock:
+            p = _percentile(self._waits, _WAIT_PCTL)
+            cur = _SHED_ORDER.index(self._state)
+            if p >= 4.0 * self.shed_wait_s:
+                target = 2
+            elif p >= self.shed_wait_s:
+                target = max(cur, 1)
+            elif p < 0.5 * self.shed_wait_s:
+                target = 0
+            else:
+                # Hysteresis band: hold the current state.
+                target = cur
+            if target == cur:
+                return self._state
+            nxt = cur + (1 if target > cur else -1)
+            self._state = _SHED_ORDER[nxt]
+            state = self._state
+        publish("serve.shed.state", state=state, p90=round(p, 6))
+        return state

@@ -185,6 +185,62 @@ ENV_VARS: tuple[EnvVar, ...] = (
         "tick's latency under backlog",
     ),
     EnvVar(
+        "SEQALIGN_SERVE_DEADLINE_S",
+        "float",
+        None,
+        "default per-request deadline (seconds) for serve requests that "
+        "carry no 'deadline_s' field; past-deadline requests are "
+        "answered with a typed 'deadline' error instead of occupying "
+        "superblock rows",
+    ),
+    EnvVar(
+        "SEQALIGN_SERVE_COST_BUDGET_S",
+        "float",
+        4.0,
+        "admission token bucket: max modelled superblock-wall seconds "
+        "(analysis/costmodel) of admitted-but-unfinished serve work; "
+        "over-budget requests get a typed 'overloaded' rejection with "
+        "retry_after_s",
+    ),
+    EnvVar(
+        "SEQALIGN_SERVE_SHED_WAIT_S",
+        "float",
+        30.0,
+        "load-shedding threshold: when the p90 of recent queue waits "
+        "reaches this many seconds the serve loop escalates "
+        "accept -> shed-new -> drain-only (de-escalates below half)",
+    ),
+    EnvVar(
+        "SEQALIGN_SERVE_WRITE_TIMEOUT_S",
+        "float",
+        5.0,
+        "per-connection socket send timeout (seconds): a client whose "
+        "socket buffer stays full this long is classified dead and its "
+        "sessions abandoned (0 disables)",
+    ),
+    EnvVar(
+        "SEQALIGN_BREAKER_THRESHOLD",
+        "int",
+        3,
+        "circuit breaker: transient primary-dispatch failures within "
+        "the window that open the breaker (pinning the degraded "
+        "backend; requires --degrade)",
+    ),
+    EnvVar(
+        "SEQALIGN_BREAKER_WINDOW",
+        "int",
+        16,
+        "circuit breaker failure-memory window, in serve-loop ticks "
+        "(deterministic — never wall clock)",
+    ),
+    EnvVar(
+        "SEQALIGN_BREAKER_COOLDOWN",
+        "int",
+        8,
+        "serve-loop ticks an open breaker waits before probing the "
+        "primary backend half-open",
+    ),
+    EnvVar(
         "JAX_COORDINATOR_ADDRESS",
         "str",
         None,

@@ -1,0 +1,330 @@
+"""Serve chaos tier (``make serve-chaos``): SLO armor under injected faults.
+
+Five deterministic pipe-mode scenarios plus the usage gate, each a real
+``--serve`` subprocess with counted fault schedules
+(``resilience/faults.py``), gated on what the SLO armor promises:
+
+* **breaker**: transient primary-dispatch failures open the circuit
+  breaker, dispatch rides the pinned degraded backend while open, the
+  cooldown probes half-open, and a healthy probe closes it — the full
+  open → half-open → close cycle observable in ONE run report;
+* **poison**: a poisoned session fails every superblock containing it;
+  bisection isolates it with a typed error while its co-batched victim
+  scores byte-correct lines and meets its deadline;
+* **overload**: a modelled burst exhausts the admission bucket; every
+  shed request gets the typed ``overloaded`` error with a
+  ``retry_after_s`` hint, and the admitted one completes;
+* **client-loss**: a client that dies mid-stream (dead socket / stalled
+  reader) forfeits its results; the server absorbs it and exits clean;
+* **drain-golden**: a pre-armed drain (``SEQALIGN_DRAIN=1``) journals
+  every queued request and exits 75 — and the journal bytes are
+  IDENTICAL across a rerun (the resume token is deterministic);
+* **usage**: an unknown ``--faults`` site is a hard exit 64 listing
+  every known site.
+
+The server must never crash: every scenario also gates "no Traceback on
+stderr" and "every request answered with a result or a typed error".
+Exit 0 on success, 1 with every problem listed — the same
+all-problems-at-once reporting style as seqlint and serve_smoke.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from mpi_openmp_cuda_tpu.obs.metrics import validate_report  # noqa: E402
+
+WEIGHTS = [1, -3, -5, -2]
+SEQ1 = "ACGTACGTACGTACGT"
+
+
+def _req(rid: str, seq2: list[str], **extra) -> dict:
+    return {"id": rid, "weights": WEIGHTS, "seq1": SEQ1, "seq2": seq2, **extra}
+
+
+def _run_serve(
+    out_dir: str,
+    name: str,
+    requests: list[dict],
+    *,
+    faults: str | None = None,
+    env_extra: dict | None = None,
+    argv_extra: tuple = (),
+    journal: str | None = None,
+):
+    """One pipe-mode --serve subprocess; returns (rc, records, report,
+    stderr).  ``report`` is None when unreadable (gated by the caller)."""
+    reqfile = os.path.join(out_dir, f"{name}.ndjson")
+    with open(reqfile, "w", encoding="utf-8") as fh:
+        for raw in requests:
+            fh.write(json.dumps(raw) + "\n")
+    report_path = os.path.join(out_dir, f"{name}.report.json")
+    argv = [
+        sys.executable, "-m", "mpi_openmp_cuda_tpu",
+        "--serve", "--input", reqfile, "--metrics-out", report_path,
+    ]
+    if faults:
+        argv += ["--faults", faults]
+    if journal:
+        argv += ["--journal", journal]
+    argv += list(argv_extra)
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    env.setdefault("SEQALIGN_BACKOFF_BASE", "0.01")
+    env.update(env_extra or {})
+    proc = subprocess.run(
+        argv, cwd=REPO, env=env, capture_output=True, text=True, timeout=300
+    )
+    records = [
+        json.loads(line)
+        for line in proc.stdout.splitlines()
+        if line.strip()
+    ]
+    report = None
+    try:
+        with open(report_path, encoding="utf-8") as fh:
+            report = json.load(fh)
+    except (OSError, json.JSONDecodeError):
+        pass
+    return proc.returncode, records, report, proc.stderr
+
+
+def _answered(records: list[dict]) -> set:
+    """Request ids that got a terminal answer (done OR typed error)."""
+    return {
+        r.get("id") for r in records if r.get("done") or "error" in r
+    }
+
+
+def _base_gates(name, rc, records, report, stderr, problems, *, want_rc=0):
+    if rc != want_rc:
+        problems.append(f"{name}: exit code: want {want_rc}, got {rc}")
+        sys.stderr.write(stderr)
+    if "Traceback" in stderr:
+        problems.append(f"{name}: server crashed (Traceback on stderr)")
+    if report is None:
+        problems.append(f"{name}: no readable run report")
+    else:
+        try:
+            validate_report(report)
+        except ValueError as e:
+            problems.append(f"{name}: {e}")
+
+
+def scenario_breaker(out_dir, problems):
+    """Open on repeated transient failures, serve degraded while open,
+    probe half-open after the cooldown, close on the healthy probe."""
+    name = "breaker"
+    reqs = [_req(f"b{i}", ["ACGT", "GATTACA"]) for i in range(4)]
+    rc, records, report, stderr = _run_serve(
+        out_dir, name, reqs,
+        faults="chunk_dispatch:fail=2",
+        argv_extra=("--degrade", "--retries", "3"),
+        env_extra={
+            # One request per tick so the breaker's tick-counted cooldown
+            # is driven by a known schedule: open during b0's retries,
+            # b1 dispatches on the pinned degraded backend, the tick
+            # after the 2-tick cooldown probes half-open, b2's primary
+            # success closes.
+            "SEQALIGN_SERVE_MAX_POP": "1",
+            "SEQALIGN_BREAKER_THRESHOLD": "2",
+            "SEQALIGN_BREAKER_COOLDOWN": "2",
+            "SEQALIGN_BREAKER_WINDOW": "16",
+        },
+    )
+    _base_gates(name, rc, records, report, stderr, problems)
+    done = {r["id"] for r in records if r.get("done")}
+    if done != {f"b{i}" for i in range(4)}:
+        problems.append(f"{name}: every request must score; done={sorted(done)}")
+    if report:
+        c = report["counters"]
+        for counter in ("breaker_opens", "breaker_half_opens", "breaker_closes"):
+            if c.get(counter) != 1:
+                problems.append(
+                    f"{name}: counters.{counter}: want 1, got {c.get(counter)}"
+                )
+        state = report["gauges"].get("breaker_state")
+        if state != "closed":
+            problems.append(
+                f"{name}: gauges.breaker_state: want 'closed' after the "
+                f"probe, got {state!r}"
+            )
+        if not c.get("degrade_transitions"):
+            problems.append(
+                f"{name}: the open breaker never pinned the degraded "
+                "backend (no degrade_transitions)"
+            )
+
+
+def scenario_poison(out_dir, problems):
+    """Bisection isolates the poison; the co-batched victim scores and
+    meets its deadline."""
+    name = "poison"
+    seq2 = ["ACGT", "GATTACA"]
+    rc, records, report, stderr = _run_serve(
+        out_dir, name,
+        [
+            _req("poison", seq2),
+            _req("victim", seq2, deadline_s=300.0),
+        ],
+        faults="poison-session:fail=1",
+    )
+    _base_gates(name, rc, records, report, stderr, problems)
+    errors = {r["id"]: r["error"] for r in records if "error" in r}
+    if set(errors) != {"poison"} or "poison" not in errors.get("poison", ""):
+        problems.append(
+            f"{name}: want exactly one typed poison error, got {errors}"
+        )
+    victim_done = [r for r in records if r.get("done") and r["id"] == "victim"]
+    if not victim_done:
+        problems.append(
+            f"{name}: the co-batched victim must score ON TIME (no "
+            "deadline error), got no done record"
+        )
+    if report and report["counters"].get("serve_poisoned") != 1:
+        problems.append(
+            f"{name}: counters.serve_poisoned: want 1, got "
+            f"{report['counters'].get('serve_poisoned')}"
+        )
+
+
+def scenario_overload(out_dir, problems):
+    """The modelled burst sheds typed ``overloaded`` + retry_after_s."""
+    name = "overload"
+    rc, records, report, stderr = _run_serve(
+        out_dir, name,
+        [_req(f"o{i}", ["ACGT"]) for i in range(3)],
+        faults="overload-burst:fail=2",
+    )
+    _base_gates(name, rc, records, report, stderr, problems)
+    if _answered(records) != {"o0", "o1", "o2"}:
+        problems.append(
+            f"{name}: every request must be answered, got "
+            f"{sorted(_answered(records))}"
+        )
+    shed = [r for r in records if r.get("error") == "overloaded"]
+    if {r["id"] for r in shed} != {"o1", "o2"}:
+        problems.append(
+            f"{name}: want o1+o2 shed as 'overloaded', got "
+            f"{[r.get('id') for r in shed]}"
+        )
+    for r in shed:
+        if not isinstance(r.get("retry_after_s"), (int, float)):
+            problems.append(f"{name}: shed record lacks retry_after_s: {r}")
+    if not any(r.get("done") and r["id"] == "o0" for r in records):
+        problems.append(f"{name}: the admitted request must complete")
+
+
+def scenario_client_loss(out_dir, problems):
+    """A client dead mid-stream is absorbed, never crashes the loop."""
+    name = "client-loss"
+    rc, records, report, stderr = _run_serve(
+        out_dir, name,
+        [_req("gone", ["ACGT"]), _req("also", ["TTTT"])],
+        faults="dead-socket-midstream:fail=1",
+    )
+    _base_gates(name, rc, records, report, stderr, problems)
+    if report and report["counters"].get("serve_clients_lost") != 1:
+        problems.append(
+            f"{name}: counters.serve_clients_lost: want 1, got "
+            f"{report['counters'].get('serve_clients_lost')}"
+        )
+
+
+def scenario_drain_golden(out_dir, problems):
+    """Pre-armed drain journals everything, exits 75 — byte-identically
+    across a rerun."""
+    name = "drain"
+    reqs = [_req(f"d{i}", ["ACGT", "GATTACA"]) for i in range(3)]
+    journals = []
+    for attempt in ("a", "b"):
+        journal = os.path.join(out_dir, f"drain-{attempt}.jsonl")
+        rc, records, report, stderr = _run_serve(
+            out_dir, f"{name}-{attempt}", reqs,
+            env_extra={"SEQALIGN_DRAIN": "1"},
+            journal=journal,
+        )
+        _base_gates(
+            f"{name}-{attempt}", rc, records, report, stderr, problems,
+            want_rc=75,
+        )
+        # The pre-armed flag stops ingest after the FIRST line (the
+        # drain check sits at the read loop's line boundary), so exactly
+        # d0 is admitted-then-journaled — deterministically.
+        drained = {r.get("id") for r in records if r.get("drained")}
+        if drained != {"d0"}:
+            problems.append(
+                f"{name}-{attempt}: every admitted request gets a drained "
+                f"notice, want exactly d0, got {sorted(drained)}"
+            )
+        try:
+            with open(journal, "rb") as fh:
+                journals.append(fh.read())
+        except OSError as e:
+            problems.append(f"{name}-{attempt}: no journal: {e}")
+            journals.append(b"")
+    if journals[0] != journals[1]:
+        problems.append(
+            f"{name}: drained-journal goldens differ across rerun "
+            "(the resume token must be deterministic)"
+        )
+    if b'"request"' not in journals[0]:
+        problems.append(f"{name}: journal holds no request records")
+
+
+def scenario_usage(out_dir, problems):
+    """Unknown --faults site: hard exit 64 with the known-site list."""
+    name = "usage"
+    proc = subprocess.run(
+        [
+            sys.executable, "-m", "mpi_openmp_cuda_tpu",
+            "--serve", "--input", "/dev/null",
+            "--faults", "warp-core:fail=1",
+        ],
+        cwd=REPO, env=dict(os.environ, JAX_PLATFORMS="cpu"),
+        capture_output=True, text=True, timeout=120,
+    )
+    if proc.returncode != 64:
+        problems.append(
+            f"{name}: unknown fault site: want exit 64, got "
+            f"{proc.returncode}"
+        )
+    if "known sites" not in proc.stderr:
+        problems.append(
+            f"{name}: stderr must list the known sites, got: "
+            f"{proc.stderr.strip()[:200]}"
+        )
+
+
+def main() -> int:
+    out_dir = tempfile.mkdtemp(prefix="serve_chaos_")
+    problems: list[str] = []
+    for scenario in (
+        scenario_breaker,
+        scenario_poison,
+        scenario_overload,
+        scenario_client_loss,
+        scenario_drain_golden,
+        scenario_usage,
+    ):
+        scenario(out_dir, problems)
+    if problems:
+        for p in problems:
+            print(f"serve-chaos: FAIL: {p}")
+        return 1
+    print(
+        "serve-chaos: OK (breaker cycle, poison quarantine, overload "
+        f"shed, client loss, drain golden, usage gate; artifacts={out_dir})"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -342,11 +342,13 @@ def test_injected_fatal_fault_skips_retries(capsys):
 
 
 def test_malformed_faults_spec_fails_fast(capsys):
+    # A bad site name is a usage error like any other bad flag value:
+    # exit 64 (not 65), listing every known site, before any phase runs.
     _, err = run_inproc(
         "--input", fixture_path("tiny"),
         "--faults", "warp_core:fail=1",
         capsys=capsys,
-        rc_want=65,
+        rc_want=64,
     )
     assert "error:" in err and "known sites" in err
 
