@@ -18,7 +18,7 @@ PY_CFLAGS  := $(shell $(PYCONFIG) --includes)
 PY_LDFLAGS := $(shell $(PYCONFIG) --ldflags --embed)
 INPUT      ?= /root/reference/input5.txt
 
-.PHONY: build run run2 runOn2 test chaos chaos-kill analyze schedule-audit metrics-smoke serve-smoke serve-chaos aot-smoke bench bench-table bench-gather check clean
+.PHONY: build run run2 runOn2 test chaos chaos-kill analyze schedule-audit metrics-smoke serve-smoke serve-chaos aot-smoke trace-smoke bench bench-table bench-gather check clean
 
 build: final
 
@@ -129,6 +129,16 @@ serve-smoke:
 # CPU-only, seconds.
 serve-chaos:
 	JAX_PLATFORMS=cpu $(PYTHON) scripts/serve_chaos.py
+
+# Tracing-tier smoke gate (docs/ARCHITECTURE.md §10): boot --serve
+# --port 0 --telemetry-port 0 --trace-out, run 2 coalescing clients,
+# scrape the LIVE registry (HTTP /metrics + in-band {"cmd": ...} verbs)
+# mid-run and gate it against the exit-time run report, validate the
+# kind="trace" artifact (every launch linked to requests, finite gap
+# rows), then gate the watchdog-expiry flight-recorder dump from an
+# injected dispatch hang.  CPU-only, seconds.
+trace-smoke:
+	JAX_PLATFORMS=cpu $(PYTHON) scripts/trace_smoke.py
 
 # AOT warm-plane smoke gate (docs/ARCHITECTURE.md §13): cross-check the
 # warm set against the committed hot-config ranking, populate a

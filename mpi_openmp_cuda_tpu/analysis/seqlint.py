@@ -110,6 +110,12 @@ _MODULE_CLASSES: dict[str, tuple[str, ...]] = {
     # are tick-counted, never wall-clock — both stay under SEQ005.
     "serve/slo.py": (ROLE_SERVE, ROLE_DETERMINISTIC),
     "resilience/breaker.py": (ROLE_DETERMINISTIC, ROLE_INSTRUMENTED),
+    # The trace recorder and flight recorder are written to from reader
+    # threads, the main loop, AND the watchdog monitor (watchdog.expiry
+    # is published off-thread), so they carry the serve-plane lock
+    # discipline (SEQ008) even though they live under obs/.
+    "obs/trace.py": (ROLE_SERVE,),
+    "obs/flightrec.py": (ROLE_SERVE,),
     # -- directory defaults ------------------------------------------------
     # The AOT warm plane is host-side orchestration whose diagnostics
     # ride the event bus; its timers (compile walls) are measurements,

@@ -10,10 +10,13 @@ contract without breaking it (SURVEY §5 config tier).
 from __future__ import annotations
 
 import argparse
+import signal
 import sys
 
 from ..obs import arm_observability, disarm_observability
 from ..obs import export as obs_export
+from ..obs import flightrec as obs_flightrec
+from ..obs import trace as obs_trace
 from ..obs.metrics import gauge as obs_gauge
 from ..ops.dispatch import AlignmentScorer
 from ..resilience.degrade import (
@@ -68,6 +71,13 @@ EX_OK = 0
 EX_USAGE = 64
 EX_FATAL = 65
 EX_TEMPFAIL = 75
+
+
+def _sigusr2_dump(signum, frame) -> None:
+    """SIGUSR2 → dump the flight recorder NOW: live triage of a stuck
+    process without killing it (no-op when the recorder is not armed).
+    Registered only while the observability plane is armed."""
+    obs_flightrec.dump_active("sigusr2")
 
 
 def build_arg_parser() -> argparse.ArgumentParser:
@@ -127,6 +137,17 @@ def build_arg_parser() -> argparse.ArgumentParser:
         metavar="DIR",
         help="capture a jax.profiler device trace of the scoring phase "
         "into DIR (view with TensorBoard / xprof)",
+    )
+    p.add_argument(
+        "--trace-out",
+        default=None,
+        metavar="PATH",
+        help="write a request-scoped Perfetto/Chrome-trace JSON timeline "
+        "to PATH when the run exits (every exit code, like "
+        "--metrics-out): host spans, bus events, per-request tracks, and "
+        "per-launch measured-vs-cost-model rows with a gap_attribution "
+        "summary (SEQALIGN_TRACE; implies --metrics; distinct from "
+        "--trace, the jax.profiler device trace)",
     )
     p.add_argument(
         "--selfcheck",
@@ -264,6 +285,18 @@ def build_arg_parser() -> argparse.ArgumentParser:
         "--input/stdin and exits when the pipe drains",
     )
     p.add_argument(
+        "--telemetry-port",
+        type=_nonnegative_int,
+        default=None,
+        metavar="PORT",
+        help="with --serve: also serve a read-only plain-HTTP telemetry "
+        "endpoint on 127.0.0.1:PORT (0 = OS-assigned; announced on "
+        "stderr): GET /metrics is a live Prometheus scrape of the armed "
+        "registry, /healthz and /trace answer JSON; the same data rides "
+        'the serve socket itself as {"cmd": "metrics"|"healthz"|"trace"} '
+        "verbs (SEQALIGN_TELEMETRY_PORT)",
+    )
+    p.add_argument(
         "--check",
         action="store_true",
         help="validate every concrete dispatch decision against the "
@@ -343,15 +376,17 @@ def _build_policy(args) -> tuple[RetryPolicy, str | None]:
     return RetryPolicy(retries=retries), fault_spec
 
 
-def _build_obs(args) -> tuple[bool, str | None, float | None]:
+def _build_obs(args) -> tuple[bool, str | None, float | None, str | None]:
     """Resolve the observability plane's configuration.
 
     Mirrors :func:`_build_policy`: each flag falls back to its declared
     env var.  Any of ``--metrics`` / ``--metrics-out`` / ``--heartbeat``
-    arms the plane — asking for the report (or the heartbeat that reads
-    it) IS asking for the counters.
+    / ``--trace-out`` arms the plane — asking for the report, the
+    heartbeat that reads it, or the trace timeline IS asking for the
+    counters.
     """
     metrics_out = args.metrics_out or env_str("SEQALIGN_METRICS_OUT")
+    trace_out = args.trace_out or env_str("SEQALIGN_TRACE")
     heartbeat_s = (
         args.heartbeat
         if args.heartbeat is not None
@@ -362,8 +397,9 @@ def _build_obs(args) -> tuple[bool, str | None, float | None]:
         or env_flag("SEQALIGN_METRICS")
         or metrics_out
         or heartbeat_s
+        or trace_out
     )
-    return enabled, metrics_out or None, heartbeat_s
+    return enabled, metrics_out or None, heartbeat_s, trace_out or None
 
 
 def _make_degrader(args, scorer) -> BackendDegrader:
@@ -851,6 +887,14 @@ def run(argv: list[str] | None = None) -> int:
             file=sys.stderr,
         )
         return EX_USAGE
+    if args.telemetry_port is not None and not args.serve:
+        print(
+            "mpi_openmp_cuda_tpu: error: --telemetry-port requires "
+            "--serve (live telemetry scrapes a running serve loop; a "
+            "batch run's report is --metrics-out)",
+            file=sys.stderr,
+        )
+        return EX_USAGE
     if args.resume and not args.journal:
         print(
             "mpi_openmp_cuda_tpu: error: --resume requires --journal PATH "
@@ -887,14 +931,32 @@ def run(argv: list[str] | None = None) -> int:
     _drain = None
     registry = recorder = None
     metrics_out = None
+    trace_out = None
+    prev_usr2 = None
     rc: int | None = None
     try:
         # The observability plane arms before anything that can publish
         # into it (faults, watchdog, scoring); the finally below flushes
         # the run report on EVERY exit path, 65 and 75 included.
-        obs_on, metrics_out, heartbeat_s = _build_obs(args)
-        if obs_on:
-            registry, recorder = arm_observability()
+        # --serve arms it unconditionally: the flight recorder must be
+        # taping before the first request so a later wedge has history.
+        obs_on, metrics_out, heartbeat_s, trace_out = _build_obs(args)
+        if obs_on or args.serve:
+            registry, recorder = arm_observability(
+                with_trace=bool(trace_out),
+                flightrec_depth=(
+                    env_int("SEQALIGN_FLIGHTREC_DEPTH", 256)
+                    if (args.serve or obs_on)
+                    else 0
+                ),
+            )
+            try:
+                # Live triage: SIGUSR2 dumps the flight recorder without
+                # disturbing the run (restored in the finally below).
+                prev_usr2 = signal.signal(signal.SIGUSR2, _sigusr2_dump)
+            except (ValueError, AttributeError, OSError):
+                # Non-main thread, or a platform without SIGUSR2.
+                prev_usr2 = None
         # The --profile timer shares the armed span recorder, so profile
         # phases and the run report's span section are one measurement.
         timer = PhaseTimer(enabled=args.profile, recorder=recorder)
@@ -1142,9 +1204,31 @@ def run(argv: list[str] | None = None) -> int:
         # the retries and degradations did.  A flush failure warns on
         # stderr; it must never mask the run's own verdict.
         if registry is not None:
+            # A fatal exit is a dump trigger like watchdog expiry or a
+            # breaker open: the last N bus events are often the only
+            # context a crashed serve replica leaves behind.
+            if rc == EX_FATAL:
+                obs_flightrec.dump_active("fatal-exit")
+            tracer = obs_trace.active_trace()
+            try:
+                obs_export.flush_trace(tracer, trace_out, exit_code=rc)
+            except Exception as flush_err:  # pragma: no cover - FS-dependent
+                print(
+                    "mpi_openmp_cuda_tpu: warning: trace not written "
+                    f"({flush_err})",
+                    file=sys.stderr,
+                )
             try:
                 obs_export.flush_run_report(
-                    registry, recorder, metrics_out, exit_code=rc
+                    registry,
+                    recorder,
+                    metrics_out,
+                    exit_code=rc,
+                    extra=(
+                        {"gap_attribution": tracer.gap_attribution()}
+                        if tracer is not None
+                        else None
+                    ),
                 )
             except Exception as flush_err:  # pragma: no cover - FS-dependent
                 print(
@@ -1152,6 +1236,11 @@ def run(argv: list[str] | None = None) -> int:
                     f"({flush_err})",
                     file=sys.stderr,
                 )
+            if prev_usr2 is not None:
+                try:
+                    signal.signal(signal.SIGUSR2, prev_usr2)
+                except (ValueError, OSError):  # pragma: no cover
+                    pass
             disarm_observability()
         # Error paths: restore fd 1 without letting a secondary flush
         # failure mask the original exception.  Faults/watchdog/drain are

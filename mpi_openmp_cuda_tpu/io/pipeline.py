@@ -23,6 +23,7 @@ from __future__ import annotations
 
 import collections
 
+from ..obs.trace import trace_launch_begin, trace_launch_end
 from ..resilience.degrade import (
     MaterialisedRows,
     run_degrading,
@@ -79,11 +80,13 @@ class ChunkPipeline:
             seq1_codes, codes, weights, rows
         )
 
-    def dispatch(self, seq1_codes, codes, weights, budget):
+    def dispatch(self, seq1_codes, codes, weights, budget, links=None):
         """Async-dispatch a chunk under the shared budget; on budget
         exhaustion with --degrade, fall down the backend chain with a
         synchronous rescore — MaterialisedRows keeps the promise
-        contract for :meth:`materialise`."""
+        contract for :meth:`materialise`.  ``links`` is the list of
+        request ids riding this launch (serve mode; None in batch/
+        stream), recorded on the trace plane's launch span."""
         deg = self.degrader
         if self.breaker is not None and self.breaker.bypass_primary():
             # Breaker open: straight to the pinned degraded backend.
@@ -97,21 +100,32 @@ class ChunkPipeline:
             if deg.enabled and not deg.verified:
                 verify_rows_against_oracle(seq1_codes, codes, weights, rows)
                 deg.verified = True
-            return MaterialisedRows(rows)
-        return run_degrading(
-            self.policy,
-            deg,
-            self._guard(
-                lambda: deg.scorer.score_codes_async(
-                    seq1_codes, codes, weights
-                )
-            ),
-            lambda sc: sc.score_codes(seq1_codes, codes, weights),
-            "chunk dispatch",
-            budget=budget,
-            verify=self._verify(seq1_codes, codes, weights),
-            wrap=MaterialisedRows,
+            promise = MaterialisedRows(rows)
+        else:
+            promise = run_degrading(
+                self.policy,
+                deg,
+                self._guard(
+                    lambda: deg.scorer.score_codes_async(
+                        seq1_codes, codes, weights
+                    )
+                ),
+                lambda sc: sc.score_codes(seq1_codes, codes, weights),
+                "chunk dispatch",
+                budget=budget,
+                verify=self._verify(seq1_codes, codes, weights),
+                wrap=MaterialisedRows,
+            )
+        # Keyed by the promise's identity: materialise closes the same
+        # key, and the entry is popped there, so id reuse after
+        # retirement cannot collide.
+        trace_launch_begin(
+            id(promise),
+            links=links or (),
+            len1=seq1_codes.size,
+            lens=[c.size for c in codes],
         )
+        return promise
 
     def materialise(self, promise, seq1_codes, codes, weights, budget):
         """Materialise under the chunk's shared budget (first attempt
@@ -125,7 +139,7 @@ class ChunkPipeline:
                 return first.pop().result()
             return deg.scorer.score_codes(seq1_codes, codes, weights)
 
-        return run_degrading(
+        rows = run_degrading(
             self.policy,
             deg,
             self._guard(attempt),
@@ -134,6 +148,10 @@ class ChunkPipeline:
             budget=budget,
             verify=self._verify(seq1_codes, codes, weights),
         )
+        # Host rows in hand: the measured launch wall is dispatch ->
+        # here (retries and degradation included — honest accounting).
+        trace_launch_end(id(promise))
+        return rows
 
 
 class PendingWindow:

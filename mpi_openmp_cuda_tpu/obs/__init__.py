@@ -14,19 +14,38 @@ attribute check and allocates nothing.
 
 from __future__ import annotations
 
-from . import events, export, metrics, spans  # noqa: F401  (re-exports)
+from . import (  # noqa: F401  (re-exports)
+    events,
+    export,
+    flightrec,
+    metrics,
+    spans,
+    trace,
+)
 
 
-def arm_observability(clock=None, span_clock=None):
+def arm_observability(
+    clock=None, span_clock=None, *, with_trace=False, flightrec_depth=0
+):
     """Arm the full plane for one run: a fresh registry subscribed to a
     fresh bus, plus a fresh span recorder.  Returns ``(registry,
-    recorder)``.  Also registers the backend-compile listener so
-    recompiles land on the bus (best-effort: a jax-less install still
-    gets counters and spans)."""
+    recorder)``.  ``with_trace`` additionally arms the Chrome-trace
+    recorder (bus + span-close subscriber); ``flightrec_depth > 0``
+    arms the flight recorder's ring at that depth.  Also registers the
+    backend-compile listener so recompiles land on the bus
+    (best-effort: a jax-less install still gets counters and spans)."""
     registry = metrics.activate_metrics(clock)
     bus = events.activate_bus()
     bus.subscribe(registry.record_event)
     recorder = spans.activate_spans(span_clock)
+    if with_trace:
+        tracer = trace.activate_trace(span_clock)
+        bus.subscribe(tracer.record_event)
+        recorder.listeners.append(tracer.span_closed)
+    if flightrec_depth and flightrec_depth > 0:
+        frec = flightrec.activate_flightrec(flightrec_depth, clock)
+        bus.subscribe(frec.record_event)
+        recorder.listeners.append(frec.span_closed)
     try:
         from ..analysis.recompile import compile_count
 
@@ -38,6 +57,8 @@ def arm_observability(clock=None, span_clock=None):
 
 def disarm_observability() -> None:
     """Tear the plane down (the CLI's finally; idempotent)."""
+    flightrec.deactivate_flightrec()
+    trace.deactivate_trace()
     spans.deactivate_spans()
     events.deactivate_bus()
     metrics.deactivate_metrics()

@@ -34,9 +34,12 @@ def flush_run_report(
     *,
     exit_code: int | None = None,
     meta: dict | None = None,
+    extra: dict | None = None,
 ) -> dict | None:
     """Write the run report (and ``.prom`` sidecar) for one finished
     run; no-op without a path or registry.  Returns the report dict.
+    ``extra`` merges additional top-level body sections (the trace
+    plane's ``gap_attribution``) into the report.
 
     Writes are tmp-file + rename so a preemption mid-flush leaves the
     previous report intact, never a torn JSON document (the journal's
@@ -44,7 +47,7 @@ def flush_run_report(
     if registry is None or path is None:
         return None
     rec = _metrics.run_report(
-        registry, spans=spans, exit_code=exit_code, meta=meta
+        registry, spans=spans, exit_code=exit_code, meta=meta, extra=extra
     )
     _atomic_write(path, json.dumps(rec, indent=2, sort_keys=True) + "\n")
     _atomic_write(path + ".prom", _metrics.to_prometheus(registry.snapshot()))
@@ -56,6 +59,25 @@ def _atomic_write(path: str, text: str) -> None:
     with open(tmp, "w") as f:
         f.write(text)
     os.replace(tmp, path)
+
+
+def flush_trace(
+    tracer,
+    path: str | None,
+    *,
+    exit_code: int | None = None,
+    meta: dict | None = None,
+) -> dict | None:
+    """Write the Perfetto/Chrome-trace envelope for one finished run
+    (``--trace-out`` / ``SEQALIGN_TRACE``); no-op without a path or an
+    armed tracer.  Same atomic-write stance as the run report — and the
+    same every-exit-path contract: a crashed run's trace is often the
+    only timeline of what wedged."""
+    if tracer is None or path is None:
+        return None
+    rec = tracer.export(exit_code=exit_code, meta=meta)
+    _atomic_write(path, json.dumps(rec, indent=2, sort_keys=True) + "\n")
+    return rec
 
 
 # -- heartbeat -------------------------------------------------------------
@@ -76,6 +98,10 @@ def heartbeat_line(snapshot: dict) -> str:
         # Serve mode only (the gauge exists only there): the batch-mode
         # heartbeat golden stays byte-identical.
         line += f" queue={g['queue_depth']}"
+    if "shed_state" in g:
+        line += f" shed={g['shed_state']}"
+    if "breaker_state" in g:
+        line += f" breaker={g['breaker_state']}"
     return line
 
 

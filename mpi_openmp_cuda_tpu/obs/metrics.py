@@ -23,6 +23,8 @@ registry is armed — the hot path pays nothing with metrics off.
 
 from __future__ import annotations
 
+import collections
+import math
 import time
 
 #: The one report envelope (run reports AND bench blobs).
@@ -43,13 +45,56 @@ _EVENT_COUNTERS = {
 }
 
 
+def percentile(values, q: float) -> float:
+    """Nearest-rank percentile over any sized collection (0.0 when
+    empty).  THE one percentile in the package: the SLO shed machine's
+    internal p90 (``serve/slo.py``) and every histogram's p50/p90/p99
+    summary field are this exact function, so report numbers and
+    shedding decisions can never disagree on rank arithmetic."""
+    if not values:
+        return 0.0
+    ordered = sorted(values)
+    return ordered[min(len(ordered) - 1, int(q * len(ordered)))]
+
+
+#: Explicit bucket boundaries (seconds) for the latency-shaped
+#: histograms.  A histogram created with bounds additionally maintains
+#: cumulative ``buckets`` counts and p50/p90/p99 summary fields — the
+#: run-report envelope and the Prometheus rendering both follow.
+HISTOGRAM_BUCKETS: dict[str, tuple[float, ...]] = {
+    "queue_wait_s": (0.001, 0.005, 0.02, 0.1, 0.5, 2.0, 10.0, 60.0),
+    "request_latency_s": (0.005, 0.02, 0.1, 0.5, 2.0, 10.0, 60.0, 300.0),
+    "backoff_delay_s": (0.01, 0.05, 0.25, 1.0, 5.0, 30.0),
+}
+
+#: Recent-observation window the percentile summary fields are computed
+#: over (bounded: a serve process observes forever).
+_SAMPLE_WINDOW = 512
+
+
+def _bucket_label(bound: float) -> str:
+    return f"{bound:g}"
+
+
 class Histogram(dict):
     """One count/sum/min/max summary, generalised out of the registry so
     any caller (serve latency, backoff delays) shares the exact shape
     :func:`validate_report` checks.  Subclassing ``dict`` keeps snapshots
-    and report serialisation plain-JSON for free."""
+    and report serialisation plain-JSON for free.
 
-    __slots__ = ()
+    With explicit ``bounds`` the histogram additionally keeps cumulative
+    per-bucket counts (Prometheus ``le`` semantics, ``+Inf`` included)
+    and p50/p90/p99 fields over a bounded window of recent observations.
+    """
+
+    __slots__ = ("_bounds", "_samples")
+
+    def __init__(self, bounds=None):
+        super().__init__()
+        self._bounds = tuple(float(b) for b in bounds) if bounds else ()
+        self._samples = (
+            collections.deque(maxlen=_SAMPLE_WINDOW) if self._bounds else None
+        )
 
     def observe(self, value: float) -> None:
         if not self:
@@ -57,11 +102,34 @@ class Histogram(dict):
             self["sum"] = value
             self["min"] = value
             self["max"] = value
-            return
-        self["count"] += 1
-        self["sum"] += value
-        self["min"] = min(self["min"], value)
-        self["max"] = max(self["max"], value)
+        else:
+            self["count"] += 1
+            self["sum"] += value
+            self["min"] = min(self["min"], value)
+            self["max"] = max(self["max"], value)
+        if self._bounds:
+            buckets = self.get("buckets")
+            if buckets is None:
+                buckets = self["buckets"] = {
+                    _bucket_label(b): 0 for b in self._bounds
+                }
+                buckets["+Inf"] = 0
+            for b in self._bounds:
+                if value <= b:
+                    buckets[_bucket_label(b)] += 1
+            buckets["+Inf"] += 1
+            self._samples.append(value)
+            self["p50"] = percentile(self._samples, 0.50)
+            self["p90"] = percentile(self._samples, 0.90)
+            self["p99"] = percentile(self._samples, 0.99)
+
+    def snapshot(self) -> dict:
+        """A detached plain-dict copy (nested buckets included) — live
+        telemetry scrapes must not alias the mutating registry."""
+        out = dict(self)
+        if "buckets" in out:
+            out["buckets"] = dict(out["buckets"])
+        return out
 
 
 class MetricsRegistry:
@@ -92,7 +160,9 @@ class MetricsRegistry:
     def observe(self, name: str, value: float) -> None:
         h = self.histograms.get(name)
         if h is None:
-            h = self.histograms[name] = Histogram()
+            h = self.histograms[name] = Histogram(
+                HISTOGRAM_BUCKETS.get(name)
+            )
         h.observe(value)
 
     def uptime_s(self) -> float:
@@ -178,7 +248,10 @@ class MetricsRegistry:
             "uptime_s": round(self.uptime_s(), 6),
             "counters": dict(self.counters),
             "gauges": dict(self.gauges),
-            "histograms": {k: dict(v) for k, v in self.histograms.items()},
+            "histograms": {
+                k: v.snapshot() if isinstance(v, Histogram) else dict(v)
+                for k, v in self.histograms.items()
+            },
         }
 
 
@@ -252,9 +325,14 @@ def run_report(
     spans=None,
     exit_code: int | None = None,
     meta: dict | None = None,
+    extra: dict | None = None,
 ) -> dict:
-    """The ``--metrics-out`` JSON document for one finished run."""
+    """The ``--metrics-out`` JSON document for one finished run.
+    ``extra`` merges additional top-level sections (the trace plane's
+    ``gap_attribution``) into the body."""
     body = registry.snapshot()
+    if extra:
+        body.update(extra)
     if spans is not None:
         body["spans"] = {
             "phases": [[name, round(dur, 6)] for name, dur in spans.phases()],
@@ -268,6 +346,47 @@ def run_report(
     if registry.fleet:
         body["hosts"] = dict(registry.fleet)
     return wrap_report("run", body, meta=meta)
+
+
+_HISTOGRAM_REQUIRED = ("count", "sum", "min", "max")
+_HISTOGRAM_OPTIONAL = ("buckets", "p50", "p90", "p99")
+
+
+def _is_num(v) -> bool:
+    return isinstance(v, (int, float)) and not isinstance(v, bool)
+
+
+def _is_finite_num(v) -> bool:
+    return _is_num(v) and math.isfinite(v)
+
+
+def _histogram_problems(name: str, h) -> list[str]:
+    if (
+        not isinstance(h, dict)
+        or not set(_HISTOGRAM_REQUIRED) <= set(h)
+        or not set(h) <= set(_HISTOGRAM_REQUIRED + _HISTOGRAM_OPTIONAL)
+    ):
+        return [
+            f"histograms[{name!r}]: want count/sum/min/max "
+            f"(+ optional buckets/p50/p90/p99), got {h!r}"
+        ]
+    out = []
+    for k in ("count", "sum", "min", "max", "p50", "p90", "p99"):
+        if k in h and not _is_num(h[k]):
+            out.append(
+                f"histograms[{name!r}].{k}: want a number, got {h[k]!r}"
+            )
+    buckets = h.get("buckets")
+    if buckets is not None and (
+        not isinstance(buckets, dict)
+        or "+Inf" not in buckets
+        or not all(isinstance(n, int) for n in buckets.values())
+    ):
+        out.append(
+            f"histograms[{name!r}].buckets: want cumulative int counts "
+            f"ending in +Inf, got {buckets!r}"
+        )
+    return out
 
 
 def validate_report(rec) -> None:
@@ -293,10 +412,7 @@ def validate_report(rec) -> None:
             if not isinstance(v, (int, float)) or isinstance(v, bool):
                 problems.append(f"counters[{name!r}]: want a number, got {v!r}")
         for name, h in (rec.get("histograms") or {}).items():
-            if not isinstance(h, dict) or set(h) != {"count", "sum", "min", "max"}:
-                problems.append(
-                    f"histograms[{name!r}]: want count/sum/min/max, got {h!r}"
-                )
+            problems.extend(_histogram_problems(name, h))
         if not isinstance(rec.get("uptime_s"), (int, float)):
             problems.append(f"uptime_s: want a number, got {rec.get('uptime_s')!r}")
         if "exit_code" in rec and not isinstance(rec["exit_code"], int):
@@ -354,6 +470,67 @@ def validate_report(rec) -> None:
             problems.append(
                 f"entry_points: want a list, got {rec.get('entry_points')!r}"
             )
+    elif kind == "trace":
+        # obs/trace.py's Chrome-trace/Perfetto export + gap attribution.
+        tev = rec.get("traceEvents")
+        if not isinstance(tev, list):
+            problems.append(f"traceEvents: want a list, got {tev!r}")
+        else:
+            for i, ev in enumerate(tev):
+                if not isinstance(ev, dict) or "ph" not in ev or "name" not in ev:
+                    problems.append(
+                        f"traceEvents[{i}]: want objects with ph/name, got {ev!r}"
+                    )
+                    break
+        gap = rec.get("gap_attribution")
+        if not isinstance(gap, dict) or not isinstance(
+            gap.get("launches"), list
+        ):
+            problems.append(
+                f"gap_attribution: want an object with a launches list, got {gap!r}"
+            )
+        else:
+            for i, row in enumerate(gap["launches"]):
+                if (
+                    not isinstance(row, dict)
+                    or not isinstance(row.get("request_ids"), list)
+                    or not _is_finite_num(row.get("measured_s"))
+                    or not _is_finite_num(row.get("modelled_s"))
+                    or not _is_finite_num(row.get("gap_s"))
+                ):
+                    problems.append(
+                        f"gap_attribution.launches[{i}]: want request_ids "
+                        f"plus finite measured_s/modelled_s/gap_s, got {row!r}"
+                    )
+            for k in ("total_measured_s", "total_modelled_s", "total_gap_s"):
+                if not _is_finite_num(gap.get(k)):
+                    problems.append(
+                        f"gap_attribution.{k}: want a finite number, "
+                        f"got {gap.get(k)!r}"
+                    )
+    elif kind == "flightrec":
+        # obs/flightrec.py's incident dump.
+        if not isinstance(rec.get("reason"), str) or not rec.get("reason"):
+            problems.append(
+                f"reason: want a nonempty string, got {rec.get('reason')!r}"
+            )
+        if not isinstance(rec.get("depth"), int):
+            problems.append(f"depth: want an int, got {rec.get('depth')!r}")
+        evs = rec.get("events")
+        if not isinstance(evs, list):
+            problems.append(f"events: want a list, got {evs!r}")
+        else:
+            for i, e in enumerate(evs):
+                if (
+                    not isinstance(e, dict)
+                    or e.get("kind") not in ("event", "span")
+                    or "name" not in e
+                ):
+                    problems.append(
+                        f"events[{i}]: want event/span entries with a name, "
+                        f"got {e!r}"
+                    )
+                    break
     elif kind == "aot-manifest":
         # aot/manifest.py's warm-set manifest.
         fp = rec.get("fingerprint")
@@ -396,36 +573,77 @@ def _fmt_num(v) -> str:
     return repr(v) if isinstance(v, float) else str(v)
 
 
+#: HELP text for the metrics worth explaining; everything else gets a
+#: mechanical fallback so every family still carries a HELP line.
+_METRIC_HELP = {
+    "queue_wait_s": "Seconds a request waited in the admission queue",
+    "request_latency_s": "Admission-to-done latency of one served request",
+    "backoff_delay_s": "Scheduled retry backoff delay",
+    "queue_depth": "Requests currently queued for batching",
+    "shed_state": "Admission shed state (accept/shed-new/drain-only)",
+    "breaker_state": "Circuit breaker state (closed/open/half_open)",
+    "batch_fill_ratio": "Real-row fraction of the last dispatched superblock",
+    "uptime_seconds": "Seconds since the metrics registry was armed",
+}
+
+
+def _help_line(m: str, name: str, fallback: str) -> str:
+    return f"# HELP {m} {_METRIC_HELP.get(name, fallback)}"
+
+
 def to_prometheus(snapshot: dict, *, prefix: str = "seqalign") -> str:
     """Prometheus text exposition of one registry snapshot: counters as
     ``_total``, numeric gauges verbatim, string gauges as ``_info``
-    labels, histograms as summaries with min/max gauges."""
+    labels, bucketed histograms as native ``histogram`` families
+    (cumulative ``le`` buckets), summary-only histograms as summaries;
+    min/max/percentile fields ride as gauges.  Every family carries
+    HELP and TYPE lines."""
     lines: list[str] = []
     for name in sorted(snapshot.get("counters", ())):
         m = f"{prefix}_{name.replace('.', '_')}_total"
+        lines.append(_help_line(m, name, f"Total {name.replace('_', ' ')}"))
         lines.append(f"# TYPE {m} counter")
         lines.append(f"{m} {_fmt_num(snapshot['counters'][name])}")
     for name in sorted(snapshot.get("gauges", ())):
         v = snapshot["gauges"][name]
         m = f"{prefix}_{name.replace('.', '_')}"
         if isinstance(v, (int, float)) and not isinstance(v, bool):
+            lines.append(
+                _help_line(m, name, f"Current {name.replace('_', ' ')}")
+            )
             lines.append(f"# TYPE {m} gauge")
             lines.append(f"{m} {_fmt_num(v)}")
         else:
+            lines.append(
+                _help_line(
+                    f"{m}_info", name, f"Current {name.replace('_', ' ')}"
+                )
+            )
             lines.append(f"# TYPE {m}_info gauge")
             lines.append(f'{m}_info{{value="{v}"}} 1')
     for name in sorted(snapshot.get("histograms", ())):
         h = snapshot["histograms"][name]
         m = f"{prefix}_{name.replace('.', '_')}"
-        lines.append(f"# TYPE {m} summary")
+        buckets = h.get("buckets")
+        lines.append(
+            _help_line(m, name, f"Distribution of {name.replace('_', ' ')}")
+        )
+        if buckets:
+            lines.append(f"# TYPE {m} histogram")
+            for label, n in buckets.items():
+                lines.append(f'{m}_bucket{{le="{label}"}} {_fmt_num(n)}')
+        else:
+            lines.append(f"# TYPE {m} summary")
         lines.append(f"{m}_count {_fmt_num(h['count'])}")
         lines.append(f"{m}_sum {_fmt_num(h['sum'])}")
-        lines.append(f"# TYPE {m}_min gauge")
-        lines.append(f"{m}_min {_fmt_num(h['min'])}")
-        lines.append(f"# TYPE {m}_max gauge")
-        lines.append(f"{m}_max {_fmt_num(h['max'])}")
+        for field in ("min", "max", "p50", "p90", "p99"):
+            if field in h:
+                lines.append(f"# TYPE {m}_{field} gauge")
+                lines.append(f"{m}_{field} {_fmt_num(h[field])}")
     up = snapshot.get("uptime_s")
     if up is not None:
-        lines.append(f"# TYPE {prefix}_uptime_seconds gauge")
-        lines.append(f"{prefix}_uptime_seconds {_fmt_num(up)}")
+        m = f"{prefix}_uptime_seconds"
+        lines.append(_help_line(m, "uptime_seconds", "Uptime in seconds"))
+        lines.append(f"# TYPE {m} gauge")
+        lines.append(f"{m} {_fmt_num(up)}")
     return "\n".join(lines) + "\n"

@@ -41,6 +41,11 @@ class SpanRecorder:
         self._clock = clock
         self.spans: list[tuple[str, float]] = []
         self._stack: list[str] = []
+        # Close listeners: ``fn(path, start, dur)`` per finished span,
+        # in the recorder's own clock domain.  The trace and flight-
+        # recorder tiers subscribe here; ``spans`` keeps its shape, so
+        # phases()/totals()/report() are untouched.
+        self.listeners: list = []
 
     @contextlib.contextmanager
     def span(self, name: str):
@@ -53,6 +58,12 @@ class SpanRecorder:
             dur = self._clock() - start
             self._stack.pop()
             self.spans.append((path, dur))
+            for fn in self.listeners:
+                try:
+                    fn(path, start, dur)
+                except Exception:
+                    # A broken observer must never fail the timed work.
+                    pass
 
     def phases(self) -> list[tuple[str, float]]:
         """Top-level spans in completion order — exactly the old

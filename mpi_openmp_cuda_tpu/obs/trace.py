@@ -1,0 +1,352 @@
+"""Request-scoped tracing: the third obs tier (counters < spans < traces).
+
+The metrics registry (PR 5) says *how much*; the span recorder says
+*where the wall went by phase*; neither can say which REQUEST paid for a
+given launch once the batcher coalesces sessions into shared
+superblocks.  This module closes that gap:
+
+* trace ids are minted at admission (``serve/queue.py``, from the
+  queue's own deterministic sequence counter — no clock, SEQ005) and
+  ride the bus fields of every per-request event;
+* each pipeline dispatch is recorded as a *launch* carrying the full
+  list of linked request ids (many-to-one: one ``pallas_call`` serves
+  rows from several concurrent requests);
+* every finished launch is priced with the static cost model
+  (``analysis/costmodel`` via ``ops/pallas_scorer``), producing a
+  parallel *modelled* track and a ``measured - modelled`` gap row — the
+  launch-by-launch attribution of the MFU gap the roofline sheet only
+  reports in aggregate.
+
+Export is Chrome-trace / Perfetto JSON (``traceEvents``) wrapped in the
+versioned run-report envelope as ``kind="trace"``; Perfetto ignores the
+extra envelope keys, so the report file loads directly in the UI.
+
+Thread contract: ``record_event`` runs on whatever thread publishes
+(reader threads, the watchdog monitor), ``span_closed`` and the launch
+hooks on the main loop thread, ``export`` on exit or a telemetry
+thread — every mutation crosses the recorder's own lock (SEQ008; the
+module is classified serve-plane for exactly that rule).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from .metrics import wrap_report
+
+#: Hard cap on buffered trace events: a long-lived server must not grow
+#: its trace without bound.  Beyond the cap new events are counted in
+#: ``dropped_events`` instead of buffered.
+MAX_EVENTS = 200_000
+
+# Perfetto track layout.  Two synthetic "processes": the host plane
+# (spans / per-request rows / raw bus events) and the launch plane
+# (measured dispatch walls with the cost model's modelled walls as the
+# parallel track directly beneath them).
+_PID_HOST = 1
+_PID_LAUNCH = 2
+_TID_SPANS = 1
+_TID_REQUESTS = 2
+_TID_EVENTS = 3
+_TID_MEASURED = 1
+_TID_MODELLED = 2
+
+#: Perfetto metadata events naming the tracks (prepended at export).
+_METADATA = (
+    {"ph": "M", "pid": _PID_HOST, "tid": 0, "name": "process_name",
+     "args": {"name": "seqalign-host"}},
+    {"ph": "M", "pid": _PID_HOST, "tid": _TID_SPANS, "name": "thread_name",
+     "args": {"name": "spans"}},
+    {"ph": "M", "pid": _PID_HOST, "tid": _TID_REQUESTS,
+     "name": "thread_name", "args": {"name": "requests"}},
+    {"ph": "M", "pid": _PID_HOST, "tid": _TID_EVENTS, "name": "thread_name",
+     "args": {"name": "events"}},
+    {"ph": "M", "pid": _PID_LAUNCH, "tid": 0, "name": "process_name",
+     "args": {"name": "seqalign-launches"}},
+    {"ph": "M", "pid": _PID_LAUNCH, "tid": _TID_MEASURED,
+     "name": "thread_name", "args": {"name": "measured"}},
+    {"ph": "M", "pid": _PID_LAUNCH, "tid": _TID_MODELLED,
+     "name": "thread_name", "args": {"name": "modelled (cost model)"}},
+)
+
+#: Bus events that open / close one request's row on the requests track.
+_REQUEST_OPEN = "serve.request.admitted"
+_REQUEST_CLOSE = {
+    "serve.request.done": "done",
+    "serve.request.failed": "failed",
+    "serve.request.abandoned": "abandoned",
+}
+
+_BLK = 128
+
+
+def modelled_launch_wall_s(len1: int, lens) -> float:
+    """Static-cost-model wall for ONE dispatch of ``len(lens)`` rows.
+
+    Prices the launch exactly the way the schedule auditor prices a
+    bucket: build the real Seq2-length histogram (rounded up to lane
+    multiples), take the BEST emittable superblock config at the i8
+    feed (the serving feed's floor — the same deliberate-lower-bound
+    stance as ``serve/slo.py`` admission pricing), and add the fixed
+    per-launch overhead.  Returns 0.0 on ANY failure: the gap row must
+    stay finite on CPU CI where the calibration sheet may not cover
+    every shape, and tracing must never be able to fail a dispatch.
+    """
+    try:
+        from ..analysis.costmodel import LAUNCH_OVERHEAD_S
+        from ..ops.pallas_scorer import (
+            emittable_superblocks,
+            model_constants,
+            superblock_model_cost,
+        )
+        from ..utils.constants import BUF_SIZE_SEQ1, BUF_SIZE_SEQ2
+
+        nbn = max(1, -(-min(int(len1), BUF_SIZE_SEQ1) // _BLK))
+        hist: dict[int, int] = {}
+        nbi = 1
+        for l2 in lens:
+            l2 = min(int(l2), BUF_SIZE_SEQ2)
+            if l2 <= 0:
+                continue
+            l2r = -(-l2 // _BLK) * _BLK
+            hist[l2r] = hist.get(l2r, 0) + 1
+            nbi = max(nbi, l2r // _BLK)
+        if not hist:
+            return 0.0
+        base, per_sb, rate = model_constants("i8")
+        lens_hist = tuple(sorted(hist.items()))
+        best = 0.0
+        for sb in emittable_superblocks(nbn, nbi, "i8"):
+            wall = superblock_model_cost(
+                nbn, nbi, int(len1), lens_hist, sb,
+                base=base, per_sb=per_sb, rate=rate,
+            )
+            if wall > 0.0 and (best == 0.0 or wall < best):
+                best = wall
+        return best + LAUNCH_OVERHEAD_S if best > 0.0 else 0.0
+    except Exception:
+        return 0.0
+
+
+class TraceRecorder:
+    """Bounded in-memory Chrome-trace builder for one run.
+
+    Subscribes to the event bus (instant events + request rows), to the
+    span recorder's close listener (host spans), and to the pipeline's
+    launch hooks (measured/modelled launch tracks + gap rows).
+    """
+
+    def __init__(self, clock=time.perf_counter):
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._t0 = clock()
+        self._events: list[dict] = []
+        self._gaps: list[dict] = []
+        self._launches: dict = {}
+        self._open_requests: dict = {}
+        self._dropped = 0
+
+    def _us(self, t: float) -> float:
+        return round((t - self._t0) * 1e6, 3)
+
+    # -- bus subscriber ----------------------------------------------------
+
+    def record_event(self, event: str, fields: dict) -> None:
+        """Every bus event becomes an instant; admitted→done/failed/
+        abandoned pairs (matched by trace id) additionally close one
+        complete row on the requests track."""
+        t = self._clock()
+        ev = {
+            "name": event,
+            "cat": "bus",
+            "ph": "i",
+            "ts": self._us(t),
+            "pid": _PID_HOST,
+            "tid": _TID_EVENTS,
+            "s": "t",
+            "args": dict(fields),
+        }
+        trace = fields.get("trace")
+        outcome = _REQUEST_CLOSE.get(event) if trace is not None else None
+        with self._lock:
+            if len(self._events) >= MAX_EVENTS:
+                self._dropped += 1
+                return
+            self._events.append(ev)
+            if event == _REQUEST_OPEN and trace is not None:
+                self._open_requests[trace] = (
+                    str(fields.get("id", trace)), t,
+                )
+            elif outcome is not None:
+                opened = self._open_requests.pop(trace, None)
+                if opened is not None:
+                    rid, t_open = opened
+                    self._events.append({
+                        "name": rid,
+                        "cat": "request",
+                        "ph": "X",
+                        "ts": self._us(t_open),
+                        "dur": round((t - t_open) * 1e6, 3),
+                        "pid": _PID_HOST,
+                        "tid": _TID_REQUESTS,
+                        "args": {"trace": trace, "outcome": outcome},
+                    })
+
+    # -- span-recorder listener --------------------------------------------
+
+    def span_closed(self, path: str, start: float, dur: float) -> None:
+        ev = {
+            "name": path,
+            "cat": "span",
+            "ph": "X",
+            "ts": self._us(start),
+            "dur": round(dur * 1e6, 3),
+            "pid": _PID_HOST,
+            "tid": _TID_SPANS,
+            "args": {},
+        }
+        with self._lock:
+            if len(self._events) >= MAX_EVENTS:
+                self._dropped += 1
+                return
+            self._events.append(ev)
+
+    # -- launch hooks (io/pipeline.py) -------------------------------------
+
+    def launch_begin(self, key, *, links=(), len1=0, lens=()) -> None:
+        """Arm one dispatch.  ``key`` is any hashable unique while the
+        launch is in flight (the pipeline uses ``id(promise)``; the
+        entry is popped at ``launch_end``, so id reuse after retirement
+        is harmless).  ``links`` is the list of request ids whose rows
+        ride this launch."""
+        entry = (
+            tuple(links),
+            int(len1),
+            tuple(int(x) for x in lens),
+            self._clock(),
+        )
+        with self._lock:
+            self._launches[key] = entry
+
+    def launch_end(self, key) -> None:
+        """Close one dispatch: measured wall (dispatch → host rows,
+        device-fenced by materialisation itself), modelled wall from
+        the cost model, and the gap row.  Unknown keys are ignored —
+        a launch that failed mid-flight stays counted as unfinished."""
+        t = self._clock()
+        with self._lock:
+            entry = self._launches.pop(key, None)
+        if entry is None:
+            return
+        links, len1, lens, t_begin = entry
+        measured = t - t_begin
+        modelled = modelled_launch_wall_s(len1, lens)
+        request_ids = list(links)
+        measured_ev = {
+            "name": "dispatch",
+            "cat": "launch",
+            "ph": "X",
+            "ts": self._us(t_begin),
+            "dur": round(measured * 1e6, 3),
+            "pid": _PID_LAUNCH,
+            "tid": _TID_MEASURED,
+            "args": {
+                "request_ids": request_ids,
+                "rows": len(lens),
+                "len1": len1,
+            },
+        }
+        modelled_ev = {
+            "name": "modelled",
+            "cat": "model",
+            "ph": "X",
+            "ts": self._us(t_begin),
+            "dur": round(modelled * 1e6, 3),
+            "pid": _PID_LAUNCH,
+            "tid": _TID_MODELLED,
+            "args": {"request_ids": request_ids},
+        }
+        row = {
+            "request_ids": request_ids,
+            "rows": len(lens),
+            "len1": len1,
+            "measured_s": round(measured, 9),
+            "modelled_s": round(modelled, 9),
+            "gap_s": round(measured - modelled, 9),
+        }
+        with self._lock:
+            if len(self._events) + 2 > MAX_EVENTS:
+                self._dropped += 2
+            else:
+                self._events.append(measured_ev)
+                self._events.append(modelled_ev)
+            self._gaps.append(row)
+
+    # -- export ------------------------------------------------------------
+
+    def gap_attribution(self) -> dict:
+        """The per-launch ``measured - modelled`` table plus its totals
+        (the run report's ``gap_attribution`` section)."""
+        with self._lock:
+            launches = [dict(g) for g in self._gaps]
+            unfinished = len(self._launches)
+        total_measured = sum(g["measured_s"] for g in launches)
+        total_modelled = sum(g["modelled_s"] for g in launches)
+        return {
+            "launches": launches,
+            "launch_count": len(launches),
+            "unfinished_launches": unfinished,
+            "total_measured_s": round(total_measured, 9),
+            "total_modelled_s": round(total_modelled, 9),
+            "total_gap_s": round(total_measured - total_modelled, 9),
+        }
+
+    def export(self, *, exit_code=None, meta=None) -> dict:
+        """The full ``kind="trace"`` envelope.  ``traceEvents`` is the
+        Chrome-trace payload (Perfetto ignores the sibling keys)."""
+        with self._lock:
+            events = list(self._events)
+            dropped = self._dropped
+        body = {
+            "traceEvents": list(_METADATA) + events,
+            "displayTimeUnit": "ms",
+            "gap_attribution": self.gap_attribution(),
+            "dropped_events": dropped,
+        }
+        if exit_code is not None:
+            body["exit_code"] = int(exit_code)
+        return wrap_report("trace", body, meta=meta)
+
+
+# -- module plane (mirrors obs.metrics / obs.events arming) ----------------
+
+_active: TraceRecorder | None = None
+
+
+def activate_trace(clock=None) -> TraceRecorder:
+    global _active
+    _active = TraceRecorder(clock or time.perf_counter)
+    return _active
+
+
+def deactivate_trace() -> None:
+    global _active
+    _active = None
+
+
+def active_trace() -> TraceRecorder | None:
+    return _active
+
+
+def trace_launch_begin(key, *, links=(), len1=0, lens=()) -> None:
+    """No-op unless the trace plane is armed (one attribute check)."""
+    rec = _active
+    if rec is not None:
+        rec.launch_begin(key, links=links, len1=len1, lens=lens)
+
+
+def trace_launch_end(key) -> None:
+    rec = _active
+    if rec is not None:
+        rec.launch_end(key)

@@ -55,6 +55,35 @@ class SuperBlock:
     def fill_ratio(self) -> float:
         return self.real_rows / max(1, len(self.codes))
 
+    def link_ids(self) -> list[str]:
+        """Request ids whose rows ride this block, first-row order,
+        deduplicated — the many-to-one trace links a shared-superblock
+        dispatch span carries (obs/trace.py)."""
+        out: list[str] = []
+        seen: set[str] = set()
+        for tag in self.tags:
+            if tag is None:
+                continue
+            rid = str(tag[0].id)
+            if rid not in seen:
+                seen.add(rid)
+                out.append(rid)
+        return out
+
+    def link_traces(self) -> list[str]:
+        """Trace ids for the same rows (empty strings dropped: batch-
+        and stream-mode callers have no admission-minted trace ids)."""
+        out: list[str] = []
+        seen: set[str] = set()
+        for tag in self.tags:
+            if tag is None:
+                continue
+            tid = str(getattr(tag[0], "trace_id", "") or "")
+            if tid and tid not in seen:
+                seen.add(tid)
+                out.append(tid)
+        return out
+
 
 def plan_blocks(sessions, rows_per_block: int) -> list[SuperBlock]:
     """Plan the tick's superblocks from every popped session's rows."""

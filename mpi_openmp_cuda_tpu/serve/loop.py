@@ -157,6 +157,13 @@ class ServeLoop:
             )
             responder.send({"id": None, "error": str(e)})
             return
+        cmd = raw.get("cmd")
+        if cmd is not None:
+            # Read-only telemetry verbs ({"cmd": "metrics"|"healthz"|
+            # "trace"}) answer inline from the live plane — never queued,
+            # never priced against the admission bucket.
+            self._telemetry(str(cmd), responder)
+            return
         verdict = self.queue.submit(raw, responder)
         if verdict == ADMIT_FULL:
             responder.send(
@@ -182,6 +189,23 @@ class ServeLoop:
                 }
             )
 
+    # -- telemetry (read-only, shared with the HTTP scrape) ----------------
+
+    def status(self) -> dict:
+        """Live health snapshot: the ``healthz`` verb and the HTTP
+        ``/healthz`` endpoint both render exactly this dict."""
+        return {
+            "ok": True,
+            "queue_depth": self.queue.depth(),
+            "shed_state": self.controller.state,
+            "breaker_state": getattr(self.breaker, "state", None),
+        }
+
+    def _telemetry(self, cmd: str, responder) -> None:
+        from ..obs.telemetry import answer_cmd
+
+        responder.send(answer_cmd(cmd, status=self.status()))
+
     # -- the scoring side --------------------------------------------------
 
     def _dispatch(self, block) -> None:
@@ -191,10 +215,12 @@ class ServeLoop:
         whole retry/degrade ladder quarantines instead of killing the
         loop."""
         budget = self.policy.new_budget()
+        links = block.link_ids()
         try:
             self._check_poison(block)
             promise = self.pipeline.dispatch(
-                block.seq1_codes, block.codes, block.weights, budget
+                block.seq1_codes, block.codes, block.weights, budget,
+                links=links,
             )
         except Exception as e:
             self._block_failed(block, e)
@@ -204,6 +230,7 @@ class ServeLoop:
             rows=block.real_rows,
             fill=round(block.fill_ratio, 4),
             depth=self.queue.depth(),
+            links=links,
         )
         self.window.push(promise, block, budget)
 
@@ -268,7 +295,8 @@ class ServeLoop:
         self._check_poison(block)
         budget = self.policy.new_budget()
         promise = self.pipeline.dispatch(
-            block.seq1_codes, block.codes, block.weights, budget
+            block.seq1_codes, block.codes, block.weights, budget,
+            links=block.link_ids(),
         )
         rows = self.pipeline.materialise(
             promise, block.seq1_codes, block.codes, block.weights, budget
@@ -393,7 +421,11 @@ class ServeLoop:
             for item in items:
                 wait = max(0.0, now - item.admitted_t)
                 self.controller.observe_wait(wait)
-                publish("serve.queue.wait", wait_s=round(wait, 6))
+                publish(
+                    "serve.queue.wait",
+                    wait_s=round(wait, 6),
+                    trace=item.trace_id,
+                )
         elif self.queue.depth() == 0:
             self.controller.note_idle()
         self.controller.update_state()
@@ -430,6 +462,7 @@ class ServeLoop:
             # no-op for sessions already completed or failed.
             sess.advance()
         obs_gauge("queue_depth", self.queue.depth())
+        obs_gauge("shed_state", self.controller.state)
         return bool(items) or not self.queue.idle()
 
     # -- drain -------------------------------------------------------------
@@ -577,8 +610,20 @@ def run_serve(args, timer, policy, deg, out_stream=None, prewarmed=False) -> int
 
     port = args.port if args.port is not None else env_int("SEQALIGN_SERVE_PORT")
     persistent = port is not None
+    telemetry_port = getattr(args, "telemetry_port", None)
+    if telemetry_port is None:
+        telemetry_port = env_int("SEQALIGN_TELEMETRY_PORT")
     sock = None
+    telem = None
     try:
+        if telemetry_port is not None:
+            from ..obs.telemetry import TelemetryServer
+
+            telem = TelemetryServer(int(telemetry_port), status=loop.status)
+            log_line(
+                "mpi_openmp_cuda_tpu: telemetry on "
+                f"127.0.0.1:{telem.start()}"
+            )
         if persistent:
             sock = socketlib.create_server(("127.0.0.1", int(port)))
             bound = sock.getsockname()[1]
@@ -610,6 +655,8 @@ def run_serve(args, timer, policy, deg, out_stream=None, prewarmed=False) -> int
         return 0
     finally:
         loop.record_steady_gauge()
+        if telem is not None:
+            telem.close()
         if sock is not None:
             try:
                 sock.close()

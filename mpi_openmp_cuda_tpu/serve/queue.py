@@ -54,6 +54,10 @@ class QueuedRequest:
     admitted_t: float
     seq: int
     cost_s: float = 0.0
+    # Per-request trace id, minted at admission from the queue's own
+    # sequence counter (deterministic — no clock, SEQ005) and carried
+    # on every bus event this request causes (obs/trace.py).
+    trace_id: str = ""
 
 
 class RequestQueue:
@@ -126,12 +130,24 @@ class RequestQueue:
                 )
                 return ADMIT_FULL
             self._seq += 1
+            trace_id = f"t{self._seq}"
+            rid = raw.get("id")
             self._items.append(
                 QueuedRequest(
-                    raw, responder, self._clock.now(), self._seq, cost
+                    raw,
+                    responder,
+                    self._clock.now(),
+                    self._seq,
+                    cost,
+                    trace_id,
                 )
             )
-            publish("serve.request.admitted", depth=len(self._items))
+            publish(
+                "serve.request.admitted",
+                depth=len(self._items),
+                id=f"req-{self._seq}" if rid is None else str(rid),
+                trace=trace_id,
+            )
             self._cond.notify_all()
             return ADMIT_OK
 

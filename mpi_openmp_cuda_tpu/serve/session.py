@@ -138,8 +138,10 @@ class Session:
     def __init__(
         self, req_id, weights, seq1, seq1_codes, seq2_codes, responder,
         admitted_t, clock, deadline_t=None, cost_s=0.0, on_close=None,
+        trace_id="",
     ):
         self.id = req_id
+        self.trace_id = trace_id  # minted at admission (obs/trace.py)
         self.weights = weights
         self.seq1 = seq1
         self.seq1_codes = seq1_codes
@@ -190,7 +192,12 @@ class Session:
         self._done = True
         self.failed = error
         self.responder.send({"id": self.id, "error": error, **fields})
-        publish("serve.request.failed", id=self.id, error=error)
+        publish(
+            "serve.request.failed",
+            id=self.id,
+            error=error,
+            trace=self.trace_id,
+        )
         self._close()
 
     def abandon(self) -> None:
@@ -200,7 +207,7 @@ class Session:
             return
         self._done = True
         self.failed = "abandoned"
-        publish("serve.request.abandoned", id=self.id)
+        publish("serve.request.abandoned", id=self.id, trace=self.trace_id)
         self._close()
 
     def fill(self, j: int, row) -> None:
@@ -244,6 +251,7 @@ class Session:
                 id=self.id,
                 n=n,
                 latency_s=self._clock.now() - self._admitted_t,
+                trace=self.trace_id,
             )
             self._close()
 
@@ -324,6 +332,7 @@ def build_session(item, clock, on_close=None) -> Session:
         deadline_t=deadline_t,
         cost_s=getattr(item, "cost_s", 0.0),
         on_close=on_close,
+        trace_id=getattr(item, "trace_id", ""),
     )
 
 

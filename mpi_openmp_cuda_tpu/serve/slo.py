@@ -39,6 +39,7 @@ import collections
 import threading
 
 from ..obs.events import publish
+from ..obs.metrics import percentile as _percentile
 from ..resilience.faults import scheduled as _fault_scheduled
 from ..utils.constants import BUF_SIZE_SEQ1, BUF_SIZE_SEQ2
 
@@ -62,11 +63,10 @@ def _ceil_div(a: int, b: int) -> int:
     return -(-a // b)
 
 
-def _percentile(values, q: float) -> float:
-    if not values:
-        return 0.0
-    ordered = sorted(values)
-    return ordered[min(len(ordered) - 1, int(q * len(ordered)))]
+# The shed machine's p90 and the report histograms' p50/p90/p99 are the
+# SAME rank arithmetic: obs.metrics.percentile is the one implementation
+# (imported above as _percentile), so a threshold tuned against report
+# percentiles transfers to shedding exactly.
 
 
 def _best_pair_wall_s(nbn: int, nbi: int) -> float:

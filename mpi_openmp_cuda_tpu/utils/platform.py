@@ -219,6 +219,31 @@ ENV_VARS: tuple[EnvVar, ...] = (
         "sessions abandoned (0 disables)",
     ),
     EnvVar(
+        "SEQALIGN_TRACE",
+        "str",
+        None,
+        "write the request-scoped Perfetto/Chrome-trace JSON timeline "
+        "here when the run exits (same as --trace-out; implies "
+        "--metrics; distinct from --trace, the jax.profiler device "
+        "trace directory)",
+    ),
+    EnvVar(
+        "SEQALIGN_TELEMETRY_PORT",
+        "int",
+        None,
+        "loopback port for the --serve plain-HTTP telemetry endpoint "
+        "(same as --telemetry-port; 0 = OS-assigned, announced on "
+        "stderr): GET /metrics | /healthz | /trace",
+    ),
+    EnvVar(
+        "SEQALIGN_FLIGHTREC_DEPTH",
+        "int",
+        256,
+        "flight recorder ring depth (bus events + span closures taped "
+        "whenever --serve or --metrics is armed; dumped on watchdog "
+        "expiry, breaker open, fatal exit, SIGUSR2; 0 disables)",
+    ),
+    EnvVar(
         "SEQALIGN_BREAKER_THRESHOLD",
         "int",
         3,

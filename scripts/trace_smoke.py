@@ -1,0 +1,346 @@
+"""End-to-end smoke gate for the tracing tier (``make trace-smoke``).
+
+Boots ``--serve --port 0 --telemetry-port 0 --trace-out`` as a real
+subprocess, fires two concurrent loopback clients sharing one problem
+key (so their rows coalesce into one launch), and — while the server is
+still alive — scrapes the live plane over BOTH transports (the HTTP
+``/metrics`` endpoint and the in-band ``{"cmd": ...}`` socket verbs).
+Then SIGTERMs the server and gates what the tracing tier promises:
+
+* the live scrape and the exit-time run report agree on the request
+  counters (one registry, two views);
+* the trace artifact is a valid ``kind="trace"`` envelope, EVERY launch
+  event carries at least one linked request id, every gap row is
+  finite, and the totals match the per-launch sums;
+* the run report carries the same ``gap_attribution`` section;
+* a second run with an injected dispatch hang under a deadline leaves
+  a schema-valid ``watchdog-expiry`` flight-recorder dump behind.
+
+Exit 0 on success, 1 with every problem listed on failure — same
+all-problems-at-once reporting style as seqlint and serve_smoke.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import math
+import os
+import re
+import signal
+import socket
+import subprocess
+import sys
+import tempfile
+import threading
+import urllib.request
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from mpi_openmp_cuda_tpu.obs.metrics import validate_report  # noqa: E402
+
+N_CLIENTS = 2
+WEIGHTS = [1, -3, -5, -2]
+SEQ1 = "ACGTACGTACGTACGT"
+PORT_RE = re.compile(r"serving on 127\.0\.0\.1:(\d+)")
+TELEM_RE = re.compile(r"telemetry on 127\.0\.0\.1:(\d+)")
+
+
+def _client(port: int, rid: str, seq2: list[str], results: dict, errors: list):
+    try:
+        with socket.create_connection(("127.0.0.1", port), timeout=60) as conn:
+            req = {"id": rid, "weights": WEIGHTS, "seq1": SEQ1, "seq2": seq2}
+            conn.sendall((json.dumps(req) + "\n").encode())
+            conn.settimeout(120)
+            buf = b""
+            while b'"done"' not in buf:
+                chunk = conn.recv(65536)
+                if not chunk:
+                    break
+                buf += chunk
+        results[rid] = [json.loads(l) for l in buf.decode().splitlines() if l]
+    except Exception as e:
+        errors.append(f"client {rid}: {e}")
+
+
+def _verb(conn: socket.socket, cmd: str) -> dict:
+    """One in-band telemetry verb -> one JSON record off the socket."""
+    conn.sendall((json.dumps({"cmd": cmd}) + "\n").encode())
+    buf = b""
+    while b"\n" not in buf:
+        chunk = conn.recv(65536)
+        if not chunk:
+            break
+        buf += chunk
+    return json.loads(buf.decode().splitlines()[0])
+
+
+def _serve_run(out_dir: str, problems: list[str]) -> None:
+    report_path = os.path.join(out_dir, "run.json")
+    trace_path = os.path.join(out_dir, "trace.json")
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    # Widen the gather window so both "concurrent" clients land in one
+    # pop even on a loaded 1-core box — the shared launch we gate on.
+    env.setdefault("SEQALIGN_SERVE_WINDOW_S", "0.5")
+    proc = subprocess.Popen(
+        [
+            sys.executable, "-m", "mpi_openmp_cuda_tpu",
+            "--serve", "--port", "0",
+            "--telemetry-port", "0",
+            "--metrics-out", report_path,
+            "--trace-out", trace_path,
+        ],
+        stdin=subprocess.DEVNULL,
+        stdout=subprocess.DEVNULL,
+        stderr=subprocess.PIPE,
+        cwd=REPO,
+        env=env,
+        text=True,
+    )
+    live = {}
+    try:
+        port = telem_port = None
+        stderr_lines: list[str] = []
+        # The telemetry announcement comes first, the serve socket's
+        # second — read until the latter.
+        for line in proc.stderr:
+            stderr_lines.append(line)
+            m = TELEM_RE.search(line)
+            if m:
+                telem_port = int(m.group(1))
+            m = PORT_RE.search(line)
+            if m:
+                port = int(m.group(1))
+                break
+        if port is None or telem_port is None:
+            problems.append(
+                f"server announcements missing (serve={port}, "
+                f"telemetry={telem_port})"
+            )
+            sys.stderr.write("".join(stderr_lines))
+            return
+        drain = threading.Thread(
+            target=lambda: stderr_lines.extend(proc.stderr), daemon=True
+        )
+        drain.start()
+
+        results: dict[str, list[dict]] = {}
+        errors: list[str] = []
+        threads = []
+        for i, seq2 in enumerate((["ACGT", "TTTT"], ["GGGG", "GATTACA"])):
+            t = threading.Thread(
+                target=_client,
+                args=(port, f"c{i}", seq2, results, errors),
+                daemon=True,
+            )
+            t.start()
+            threads.append(t)
+        for t in threads:
+            t.join(300)
+        problems.extend(errors)
+
+        # Mid-run, server still alive: scrape the LIVE plane both ways.
+        try:
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{telem_port}/metrics", timeout=30
+            ) as resp:
+                live["prom"] = resp.read().decode("utf-8")
+        except Exception as e:
+            problems.append(f"live /metrics scrape: {e}")
+        try:
+            with socket.create_connection(
+                ("127.0.0.1", port), timeout=30
+            ) as conn:
+                conn.settimeout(60)
+                live["metrics"] = _verb(conn, "metrics")
+                live["healthz"] = _verb(conn, "healthz")
+                live["trace"] = _verb(conn, "trace")
+        except Exception as e:
+            problems.append(f"socket telemetry verbs: {e}")
+
+        proc.send_signal(signal.SIGTERM)
+        rc = proc.wait(timeout=120)
+        drain.join(10)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=30)
+
+    if rc != 75:
+        problems.append(f"exit code: want 75 (drained), got {rc}")
+    for rid, recs in sorted(results.items()):
+        if not any(r.get("done") for r in recs):
+            problems.append(f"{rid}: no done record")
+        if sum(1 for r in recs if "line" in r) != 2:
+            problems.append(f"{rid}: want 2 result lines, got {recs}")
+
+    # -- live plane gates ---------------------------------------------------
+    live_counters = {}
+    if "metrics" in live:
+        live_counters = live["metrics"].get("metrics", {}).get("counters", {})
+        if live_counters.get("serve_requests") != N_CLIENTS:
+            problems.append(
+                f"live verb counters.serve_requests: want {N_CLIENTS}, got "
+                f"{live_counters.get('serve_requests')}"
+            )
+    if "healthz" in live and live["healthz"].get("status", {}).get("ok") is not True:
+        problems.append(f"live healthz: want ok=true, got {live['healthz']}")
+    if "trace" in live:
+        try:
+            validate_report(live["trace"]["trace"])
+        except (KeyError, ValueError) as e:
+            problems.append(f"live trace verb: {e}")
+    if "prom" in live:
+        if "# HELP seqalign_serve_requests_total" not in live["prom"]:
+            problems.append("live /metrics: HELP line for serve_requests missing")
+        if f"seqalign_serve_requests_total {N_CLIENTS}" not in live["prom"]:
+            problems.append(
+                f"live /metrics: seqalign_serve_requests_total {N_CLIENTS} "
+                "not found"
+            )
+
+    # -- exit artifacts -----------------------------------------------------
+    report = _load_report(report_path, problems)
+    if report is not None:
+        counters = report["counters"]
+        for key in ("serve_requests", "chunks_dispatched"):
+            if live_counters and counters.get(key) != live_counters.get(key):
+                problems.append(
+                    f"live vs final counters.{key}: scrape said "
+                    f"{live_counters.get(key)}, report says {counters.get(key)}"
+                )
+        if "gap_attribution" not in report:
+            problems.append("run report: gap_attribution section missing")
+
+    trace = _load_report(trace_path, problems)
+    if trace is not None:
+        if trace.get("kind") != "trace":
+            problems.append(f"trace kind: want 'trace', got {trace.get('kind')}")
+        launches = [
+            e for e in trace.get("traceEvents", ())
+            if e.get("cat") == "launch"
+        ]
+        if not launches:
+            problems.append("trace: no launch events recorded")
+        for ev in launches:
+            if not ev.get("args", {}).get("request_ids"):
+                problems.append(f"trace: launch without linked requests: {ev}")
+        ga = trace.get("gap_attribution", {})
+        rows = ga.get("launches", ())
+        if len(rows) != len(launches):
+            problems.append(
+                f"gap rows: want one per launch ({len(launches)}), got "
+                f"{len(rows)}"
+            )
+        for row in rows:
+            for field in ("measured_s", "modelled_s", "gap_s"):
+                v = row.get(field)
+                if not isinstance(v, (int, float)) or not math.isfinite(v):
+                    problems.append(f"gap row {field}: not finite: {row}")
+        for total, field in (
+            ("total_measured_s", "measured_s"),
+            ("total_modelled_s", "modelled_s"),
+            ("total_gap_s", "gap_s"),
+        ):
+            want = sum(row.get(field, 0.0) for row in rows)
+            if abs(ga.get(total, 0.0) - want) > 1e-6:
+                problems.append(
+                    f"gap totals: {total}={ga.get(total)} != "
+                    f"sum of rows {want}"
+                )
+        if report is not None and report.get("gap_attribution") != ga:
+            problems.append(
+                "run report gap_attribution != trace gap_attribution"
+            )
+
+
+def _load_report(path: str, problems: list[str]):
+    try:
+        with open(path, encoding="utf-8") as fh:
+            rec = json.load(fh)
+    except (OSError, json.JSONDecodeError) as e:
+        problems.append(f"no readable report at {path}: {e}")
+        return None
+    try:
+        validate_report(rec)
+    except ValueError as e:
+        problems.append(str(e))
+        return None
+    return rec
+
+
+def _flightrec_run(out_dir: str, problems: list[str]) -> None:
+    """Injected dispatch hang under a deadline: the run still succeeds
+    (retried), and the flight recorder leaves a watchdog-expiry dump."""
+    cache_dir = os.path.join(out_dir, "cache")
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    env["SEQALIGN_CACHE_DIR"] = cache_dir
+    env.pop("TPU_SEQALIGN_COMPILE_CACHE", None)
+    env["SEQALIGN_BACKOFF_BASE"] = "0"
+    proc = subprocess.run(
+        [
+            sys.executable, "-m", "mpi_openmp_cuda_tpu",
+            "--input", os.path.join(REPO, "tests", "fixtures", "tiny.txt"),
+            "--retries", "2",
+            "--deadline", "0.05",
+            "--faults", "hang:dispatch:fail=1",
+            "--metrics",
+        ],
+        stdout=subprocess.DEVNULL,
+        stderr=subprocess.PIPE,
+        cwd=REPO,
+        env=env,
+        text=True,
+        timeout=600,
+    )
+    if proc.returncode != 0:
+        problems.append(
+            f"flightrec run: want rc 0 (hang retried), got {proc.returncode}:\n"
+            f"{proc.stderr[-2000:]}"
+        )
+        return
+    dumps = sorted(
+        glob.glob(
+            os.path.join(
+                cache_dir, "flightrec", "flightrec-*-watchdog-expiry.json"
+            )
+        )
+    )
+    if not dumps:
+        problems.append(f"no watchdog-expiry dump under {cache_dir}/flightrec")
+        return
+    dump = _load_report(dumps[0], problems)
+    if dump is None:
+        return
+    if dump.get("reason") != "watchdog-expiry":
+        problems.append(
+            f"dump reason: want 'watchdog-expiry', got {dump.get('reason')}"
+        )
+    if not any(
+        e.get("name") == "watchdog.expiry" for e in dump.get("events", ())
+    ):
+        problems.append("dump tape: watchdog.expiry event missing")
+
+
+def main() -> int:
+    out_dir = tempfile.mkdtemp(prefix="trace_smoke_")
+    problems: list[str] = []
+    _serve_run(out_dir, problems)
+    _flightrec_run(out_dir, problems)
+    if problems:
+        for p in problems:
+            print(f"trace-smoke: FAIL: {p}")
+        return 1
+    print(
+        f"trace-smoke: OK (requests={N_CLIENTS}, live scrape == report, "
+        f"linked launches + finite gap rows, flightrec dump; "
+        f"artifacts={out_dir})"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

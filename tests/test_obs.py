@@ -82,7 +82,16 @@ def test_registry_snapshot_golden():
         "counters": {"retry_attempts": 2},
         "gauges": {"backend": "xla"},
         "histograms": {
-            "backoff_delay_s": {"count": 2, "sum": 2.0, "min": 0.5, "max": 1.5}
+            # backoff_delay_s is one of the bucketed latency histograms:
+            # cumulative le-counts plus nearest-rank percentile fields.
+            "backoff_delay_s": {
+                "count": 2, "sum": 2.0, "min": 0.5, "max": 1.5,
+                "buckets": {
+                    "0.01": 0, "0.05": 0, "0.25": 0,
+                    "1": 1, "5": 2, "30": 2, "+Inf": 2,
+                },
+                "p50": 1.5, "p90": 1.5, "p99": 1.5,
+            }
         },
     }
 
@@ -123,6 +132,11 @@ def test_record_event_counter_catalogue():
     }
     assert reg.histograms["backoff_delay_s"] == {
         "count": 1, "sum": 0.5, "min": 0.5, "max": 0.5,
+        "buckets": {
+            "0.01": 0, "0.05": 0, "0.25": 0,
+            "1": 1, "5": 1, "30": 1, "+Inf": 1,
+        },
+        "p50": 0.5, "p90": 0.5, "p99": 0.5,
     }
 
 
@@ -277,12 +291,16 @@ def test_prometheus_textfile_golden():
         },
     }
     assert to_prometheus(snapshot) == (
+        "# HELP seqalign_retry_attempts_total Total retry attempts\n"
         "# TYPE seqalign_retry_attempts_total counter\n"
         "seqalign_retry_attempts_total 2\n"
+        "# HELP seqalign_backend_info Current backend\n"
         "# TYPE seqalign_backend_info gauge\n"
         'seqalign_backend_info{value="xla"} 1\n'
+        "# HELP seqalign_chunks_total Current chunks total\n"
         "# TYPE seqalign_chunks_total gauge\n"
         "seqalign_chunks_total 5\n"
+        "# HELP seqalign_backoff_delay_s Scheduled retry backoff delay\n"
         "# TYPE seqalign_backoff_delay_s summary\n"
         "seqalign_backoff_delay_s_count 2\n"
         "seqalign_backoff_delay_s_sum 2.0\n"
@@ -290,9 +308,62 @@ def test_prometheus_textfile_golden():
         "seqalign_backoff_delay_s_min 0.5\n"
         "# TYPE seqalign_backoff_delay_s_max gauge\n"
         "seqalign_backoff_delay_s_max 1.5\n"
+        "# HELP seqalign_uptime_seconds Seconds since the metrics "
+        "registry was armed\n"
         "# TYPE seqalign_uptime_seconds gauge\n"
         "seqalign_uptime_seconds 2.0\n"
     )
+
+
+def test_prometheus_bucketed_histogram_golden():
+    # A bucketed histogram renders as a native Prometheus histogram
+    # family: HELP + TYPE, cumulative le buckets ending at +Inf, then
+    # count/sum and the percentile summary gauges.
+    reg = MetricsRegistry(FakeClock())
+    reg.observe("queue_wait_s", 0.003)
+    reg.observe("queue_wait_s", 0.3)
+    text = to_prometheus(
+        {"histograms": reg.snapshot()["histograms"]}
+    )
+    assert text == (
+        "# HELP seqalign_queue_wait_s Seconds a request waited in the "
+        "admission queue\n"
+        "# TYPE seqalign_queue_wait_s histogram\n"
+        'seqalign_queue_wait_s_bucket{le="0.001"} 0\n'
+        'seqalign_queue_wait_s_bucket{le="0.005"} 1\n'
+        'seqalign_queue_wait_s_bucket{le="0.02"} 1\n'
+        'seqalign_queue_wait_s_bucket{le="0.1"} 1\n'
+        'seqalign_queue_wait_s_bucket{le="0.5"} 2\n'
+        'seqalign_queue_wait_s_bucket{le="2"} 2\n'
+        'seqalign_queue_wait_s_bucket{le="10"} 2\n'
+        'seqalign_queue_wait_s_bucket{le="60"} 2\n'
+        'seqalign_queue_wait_s_bucket{le="+Inf"} 2\n'
+        "seqalign_queue_wait_s_count 2\n"
+        "seqalign_queue_wait_s_sum 0.303\n"
+        "# TYPE seqalign_queue_wait_s_min gauge\n"
+        "seqalign_queue_wait_s_min 0.003\n"
+        "# TYPE seqalign_queue_wait_s_max gauge\n"
+        "seqalign_queue_wait_s_max 0.3\n"
+        "# TYPE seqalign_queue_wait_s_p50 gauge\n"
+        "seqalign_queue_wait_s_p50 0.3\n"
+        "# TYPE seqalign_queue_wait_s_p90 gauge\n"
+        "seqalign_queue_wait_s_p90 0.3\n"
+        "# TYPE seqalign_queue_wait_s_p99 gauge\n"
+        "seqalign_queue_wait_s_p99 0.3\n"
+    )
+
+
+def test_percentile_is_shared_with_slo():
+    # ONE rank arithmetic package-wide: the shed machine's internal p90
+    # is literally obs.metrics.percentile (satellite contract).
+    from mpi_openmp_cuda_tpu.obs.metrics import percentile
+    from mpi_openmp_cuda_tpu.serve import slo
+
+    assert slo._percentile is percentile
+    assert percentile([], 0.9) == 0.0
+    assert percentile([3.0], 0.9) == 3.0
+    assert percentile([1.0, 2.0, 10.0, 4.0], 0.5) == 4.0
+    assert percentile([1.0, 2.0, 10.0, 4.0], 0.9) == 10.0
 
 
 def test_flush_run_report_writes_json_and_prom(tmp_path):

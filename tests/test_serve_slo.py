@@ -482,6 +482,11 @@ class TestSloMetrics:
         assert reg.gauges["shed_state"] == "shed-new"
         assert reg.histograms["queue_wait_s"] == {
             "count": 2, "sum": 1.0, "min": 0.25, "max": 0.75,
+            "buckets": {
+                "0.001": 0, "0.005": 0, "0.02": 0, "0.1": 0,
+                "0.5": 1, "2": 2, "10": 2, "60": 2, "+Inf": 2,
+            },
+            "p50": 0.75, "p90": 0.75, "p99": 0.75,
         }
 
     def test_breaker_events_drive_counters_and_state_gauge(self):
@@ -520,7 +525,7 @@ class TestSloMetrics:
         assert rep["counters"]["serve_deadline_rejections"] == 1
         assert rep["gauges"]["breaker_state"] == "open"
         assert set(rep["histograms"]["queue_wait_s"]) == {
-            "count", "sum", "min", "max",
+            "count", "sum", "min", "max", "buckets", "p50", "p90", "p99",
         }
 
 

@@ -1,0 +1,510 @@
+"""ISSUE 10 tests: request-scoped tracing, live telemetry, flight recorder.
+
+The load-bearing claims, each pinned here:
+
+* every dispatch recorded by the tracer carries the list of linked
+  request ids and a finite ``measured - modelled`` gap row whose totals
+  the run report's ``gap_attribution`` section reproduces;
+* the Chrome-trace export is a valid ``kind="trace"`` envelope whose
+  stable projection (names/tracks/links, timestamps dropped) is golden
+  for the canned 2-request coalesced serve run;
+* telemetry verbs answer inline from the live plane — never queued,
+  never failing the connection on an unknown verb — and the HTTP
+  scrape renders the same registry as the exit-time textfile;
+* the flight recorder's ring is bounded, dumps a schema-valid
+  ``kind="flightrec"`` artifact on watchdog expiry and breaker open,
+  and ``SEQALIGN_FLIGHTREC_DEPTH=0`` disables it entirely.
+
+Unit layers run on a fake clock; the e2e tests reuse the survival
+suite's ``hang:dispatch`` + ``--deadline`` idiom.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import pathlib
+import tempfile
+import urllib.error
+import urllib.request
+
+import pytest
+
+from conftest import run_cli_inproc as run_inproc
+from test_fixtures import fixture_path
+
+from mpi_openmp_cuda_tpu.obs import (
+    arm_observability,
+    disarm_observability,
+    events,
+    flightrec,
+    trace as obs_trace,
+)
+from mpi_openmp_cuda_tpu.obs.export import heartbeat_line
+from mpi_openmp_cuda_tpu.obs.metrics import validate_report, wrap_report
+from mpi_openmp_cuda_tpu.obs.telemetry import TelemetryServer, answer_cmd
+from mpi_openmp_cuda_tpu.obs.trace import (
+    _METADATA,
+    TraceRecorder,
+    modelled_launch_wall_s,
+)
+from mpi_openmp_cuda_tpu.serve.loop import ServeLoop
+
+GOLDEN_TRACE = pathlib.Path(__file__).parent / "golden" / "serve_trace.json"
+
+WEIGHTS = [1, -3, -5, -2]
+
+
+class FakeClock:
+    """Deterministic perf_counter stand-in for byte-stable trace rows."""
+
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+class Sink:
+    """Responder stand-in collecting every sent record."""
+
+    def __init__(self):
+        self.records = []
+
+    def send(self, obj):
+        self.records.append(obj)
+
+
+def _request(rid, seq1="ACGTACGT", seq2=("ACGT", "TTTT")):
+    return {"id": rid, "weights": WEIGHTS, "seq1": seq1, "seq2": list(seq2)}
+
+
+@pytest.fixture(autouse=True)
+def _clean_plane(monkeypatch):
+    # No ambient obs/trace/flightrec config may leak in; retries must
+    # not sleep through real backoff; the plane is disarmed on the way
+    # out so an assertion failure cannot poison later tests.
+    monkeypatch.setenv("SEQALIGN_BACKOFF_BASE", "0")
+    for var in (
+        "SEQALIGN_METRICS",
+        "SEQALIGN_METRICS_OUT",
+        "SEQALIGN_HEARTBEAT_S",
+        "SEQALIGN_TRACE",
+        "SEQALIGN_TELEMETRY_PORT",
+        "SEQALIGN_FLIGHTREC_DEPTH",
+    ):
+        monkeypatch.delenv(var, raising=False)
+    yield
+    disarm_observability()
+
+
+# -- trace recorder units (fake clock) --------------------------------------
+
+
+def test_trace_request_row_pairing():
+    clock = FakeClock()
+    rec = TraceRecorder(clock)
+    rec.record_event("serve.request.admitted", {"id": "a", "trace": "t1"})
+    clock.advance(0.5)
+    rec.record_event("serve.request.done", {"id": "a", "trace": "t1", "n": 2})
+    evs = rec.export()["traceEvents"]
+    assert evs[: len(_METADATA)] == list(_METADATA)
+    instants = [e for e in evs if e.get("cat") == "bus"]
+    assert [e["name"] for e in instants] == [
+        "serve.request.admitted",
+        "serve.request.done",
+    ]
+    rows = [e for e in evs if e.get("cat") == "request"]
+    assert rows == [
+        {
+            "name": "a",
+            "cat": "request",
+            "ph": "X",
+            "ts": 0.0,
+            "dur": 500000.0,
+            "pid": 1,
+            "tid": 2,
+            "args": {"trace": "t1", "outcome": "done"},
+        }
+    ]
+
+
+def test_trace_request_row_outcomes():
+    # failed / abandoned close the row with their own outcome; a close
+    # with no matching open (or no trace id at all) is just an instant.
+    clock = FakeClock()
+    rec = TraceRecorder(clock)
+    rec.record_event("serve.request.admitted", {"id": "a", "trace": "t1"})
+    rec.record_event("serve.request.failed", {"id": "a", "trace": "t1"})
+    rec.record_event("serve.request.done", {"id": "x", "trace": "t9"})
+    rec.record_event("serve.request.done", {"id": "y"})
+    rows = [e for e in rec.export()["traceEvents"] if e.get("cat") == "request"]
+    assert [(e["name"], e["args"]["outcome"]) for e in rows] == [("a", "failed")]
+
+
+def test_trace_launch_gap_rows(monkeypatch):
+    # launch_end looks the cost model up through the module global, so
+    # a deterministic stub prices every launch at a fixed 0.25 s.
+    monkeypatch.setattr(
+        obs_trace, "modelled_launch_wall_s", lambda len1, lens: 0.25
+    )
+    clock = FakeClock()
+    rec = TraceRecorder(clock)
+    rec.launch_begin("k1", links=["a", "b"], len1=8, lens=[4, 4, 4])
+    clock.advance(1.0)
+    rec.launch_end("k1")
+    rec.launch_end("unknown-key")  # ignored, not a crash
+    rec.launch_begin("k2", links=["c"], len1=8, lens=[4])  # never finishes
+    assert rec.gap_attribution() == {
+        "launches": [
+            {
+                "request_ids": ["a", "b"],
+                "rows": 3,
+                "len1": 8,
+                "measured_s": 1.0,
+                "modelled_s": 0.25,
+                "gap_s": 0.75,
+            }
+        ],
+        "launch_count": 1,
+        "unfinished_launches": 1,
+        "total_measured_s": 1.0,
+        "total_modelled_s": 0.25,
+        "total_gap_s": 0.75,
+    }
+    evs = rec.export()["traceEvents"]
+    measured = [e for e in evs if e.get("cat") == "launch"]
+    modelled = [e for e in evs if e.get("cat") == "model"]
+    assert measured[0]["dur"] == 1000000.0
+    assert measured[0]["args"] == {
+        "request_ids": ["a", "b"], "rows": 3, "len1": 8,
+    }
+    assert measured[0]["pid"] == 2 and measured[0]["tid"] == 1
+    assert modelled[0]["dur"] == 250000.0
+    assert modelled[0]["pid"] == 2 and modelled[0]["tid"] == 2
+
+
+def test_trace_export_validates_and_bounds(monkeypatch):
+    rec = TraceRecorder(FakeClock())
+    rec.record_event("serve.request.admitted", {"id": "a", "trace": "t1"})
+    rep = rec.export(exit_code=0)
+    validate_report(rep)
+    assert rep["kind"] == "trace"
+    assert rep["exit_code"] == 0
+    assert rep["dropped_events"] == 0
+    # Beyond the cap new events are counted, not buffered.
+    monkeypatch.setattr(obs_trace, "MAX_EVENTS", 1)
+    rec.record_event("overflow.one", {})
+    rec.span_closed("late.span", 0.0, 1.0)
+    rep = rec.export()
+    assert rep["dropped_events"] == 2
+    assert len(rep["traceEvents"]) == len(_METADATA) + 1
+
+
+def test_modelled_launch_wall_is_finite():
+    wall = modelled_launch_wall_s(8, [4, 4, 4])
+    assert isinstance(wall, float)
+    assert math.isfinite(wall) and wall >= 0.0
+    assert modelled_launch_wall_s(8, []) == 0.0
+    assert modelled_launch_wall_s(8, [0, -3]) == 0.0
+
+
+# -- envelope schema gates ---------------------------------------------------
+
+
+def test_validate_report_rejects_bad_trace():
+    bad = wrap_report(
+        "trace",
+        {"traceEvents": "nope", "gap_attribution": {}, "dropped_events": 0},
+    )
+    with pytest.raises(ValueError, match="traceEvents"):
+        validate_report(bad)
+
+
+def test_validate_report_rejects_bad_flightrec():
+    bad = wrap_report(
+        "flightrec", {"reason": "", "depth": 4, "dropped": 0, "events": []}
+    )
+    with pytest.raises(ValueError, match="reason"):
+        validate_report(bad)
+
+
+# -- heartbeat suffixes ------------------------------------------------------
+
+
+def test_heartbeat_shed_breaker_suffixes():
+    snap = {
+        "counters": {},
+        "gauges": {
+            "queue_depth": 2, "shed_state": "accept", "breaker_state": "open",
+        },
+    }
+    assert heartbeat_line(snap) == (
+        "[obs] chunk 0/? retries=0 degraded=no "
+        "queue=2 shed=accept breaker=open"
+    )
+    # Batch mode has none of the serve gauges: byte-identical to before.
+    assert heartbeat_line({"counters": {}, "gauges": {}}) == (
+        "[obs] chunk 0/? retries=0 degraded=no"
+    )
+
+
+# -- telemetry verbs ---------------------------------------------------------
+
+
+def test_answer_cmd_disarmed_planes():
+    assert answer_cmd("metrics") == {"telemetry": "metrics", "metrics": {}}
+    assert answer_cmd("healthz") == {"telemetry": "healthz", "status": {"ok": True}}
+    assert answer_cmd("healthz", status={"ok": True, "queue_depth": 3}) == {
+        "telemetry": "healthz",
+        "status": {"ok": True, "queue_depth": 3},
+    }
+    assert "not armed" in answer_cmd("trace")["error"]
+    bad = answer_cmd("bogus")
+    assert bad["telemetry"] == "bogus"
+    assert "unknown telemetry cmd" in bad["error"]
+
+
+def test_answer_cmd_trace_armed():
+    arm_observability(with_trace=True)
+    events.publish("serve.request.admitted", id="a", trace="t1")
+    rec = answer_cmd("trace")
+    validate_report(rec["trace"])
+    names = [e.get("name") for e in rec["trace"]["traceEvents"]]
+    assert "serve.request.admitted" in names
+
+
+def test_serve_ingest_telemetry_verb_not_queued():
+    loop = ServeLoop(None, None)
+    sink = Sink()
+    loop.ingest('{"cmd": "healthz"}\n', sink)
+    assert loop.queue.depth() == 0  # never admitted, never priced
+    assert sink.records == [
+        {
+            "telemetry": "healthz",
+            "status": {
+                "ok": True,
+                "queue_depth": 0,
+                "shed_state": "accept",
+                "breaker_state": None,
+            },
+        }
+    ]
+    loop.ingest('{"cmd": "nonsense"}\n', sink)
+    assert "unknown telemetry cmd" in sink.records[-1]["error"]
+
+
+def test_telemetry_http_endpoints():
+    reg, _ = arm_observability(with_trace=True)
+    reg.inc("retry_attempts")
+    srv = TelemetryServer(0, status=lambda: {"ok": True, "queue_depth": 0})
+    port = srv.start()
+    base = f"http://127.0.0.1:{port}"
+    try:
+        with urllib.request.urlopen(f"{base}/metrics", timeout=10) as resp:
+            assert resp.headers["Content-Type"].startswith("text/plain")
+            body = resp.read().decode("utf-8")
+        assert "# HELP seqalign_retry_attempts_total Total retry attempts" in body
+        assert "seqalign_retry_attempts_total 1" in body
+        with urllib.request.urlopen(f"{base}/healthz", timeout=10) as resp:
+            health = json.loads(resp.read())
+        assert health == {
+            "telemetry": "healthz",
+            "status": {"ok": True, "queue_depth": 0},
+        }
+        with urllib.request.urlopen(f"{base}/trace", timeout=10) as resp:
+            tr = json.loads(resp.read())
+        assert tr["telemetry"] == "trace"
+        validate_report(tr["trace"])
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(f"{base}/nope", timeout=10)
+        assert exc.value.code == 404
+        assert "unknown path" in json.loads(exc.value.read())["error"]
+    finally:
+        srv.close()
+        srv.close()  # idempotent
+
+
+# -- flight recorder ---------------------------------------------------------
+
+
+def _redirect_flightrec_dumps(monkeypatch, tmp_path):
+    """Route dumps into this test's tmpdir.  The suite keeps the cache
+    plane OFF (conftest), so the recorder's fallback is the system
+    tempdir — point THAT at tmp_path rather than re-enabling the
+    compile cache just for a dump location."""
+    monkeypatch.setattr(tempfile, "tempdir", str(tmp_path))
+    return tmp_path / "mpi_openmp_cuda_tpu" / "flightrec"
+
+
+def test_flightrec_ring_is_bounded(monkeypatch, tmp_path):
+    dump_dir = _redirect_flightrec_dumps(monkeypatch, tmp_path)
+    rec = flightrec.FlightRecorder(depth=3, clock=FakeClock())
+    for i in range(5):
+        rec.record_event(f"e{i}", {"i": i})
+    rec.span_closed("chunk", 0.0, 0.125)  # evicts e2
+    path = rec.dump("unit-test")
+    assert path is not None and os.path.dirname(path) == str(dump_dir)
+    data = json.loads(pathlib.Path(path).read_text())
+    validate_report(data)
+    assert data["kind"] == "flightrec"
+    assert data["reason"] == "unit-test"
+    assert data["depth"] == 3
+    assert data["dropped"] == 3
+    assert [e["name"] for e in data["events"]] == ["e3", "e4", "chunk"]
+    assert [e["seq"] for e in data["events"]] == [4, 5, 6]
+    assert data["events"][-1] == {
+        "kind": "span", "seq": 6, "t": 0.0, "name": "chunk", "dur_s": 0.125,
+    }
+
+
+def test_flightrec_breaker_open_triggers_dump(monkeypatch, tmp_path):
+    _redirect_flightrec_dumps(monkeypatch, tmp_path)
+    arm_observability(flightrec_depth=8)
+    events.publish("serve.request.admitted", id="a", trace="t1")
+    events.publish("breaker.open", failures=3)
+    rec = flightrec.active_flightrec()
+    assert rec is not None
+    assert len(rec.dump_paths) == 1
+    name = os.path.basename(rec.dump_paths[0])
+    assert name.startswith("flightrec-") and name.endswith("-breaker-open.json")
+    data = json.loads(pathlib.Path(rec.dump_paths[0]).read_text())
+    validate_report(data)
+    assert data["reason"] == "breaker-open"
+    # The trigger event itself is the last thing on the tape.
+    assert [e["name"] for e in data["events"]] == [
+        "serve.request.admitted",
+        "breaker.open",
+    ]
+
+
+def test_dump_active_disarmed_is_noop():
+    assert flightrec.active_flightrec() is None
+    assert flightrec.dump_active("sigusr2") is None
+
+
+def test_watchdog_expiry_dumps_flightrec(monkeypatch, tmp_path, capsys):
+    dump_dir = _redirect_flightrec_dumps(monkeypatch, tmp_path)
+    _, err = run_inproc(
+        "--input", fixture_path("tiny"),
+        "--retries", "2",
+        "--deadline", "0.05",
+        "--faults", "hang:dispatch:fail=1",
+        "--metrics",
+        capsys=capsys,
+    )
+    dumps = sorted(dump_dir.glob("flightrec-*-watchdog-expiry.json"))
+    assert dumps, f"no watchdog-expiry dump under {dump_dir}"
+    data = json.loads(dumps[0].read_text())
+    validate_report(data)
+    assert data["reason"] == "watchdog-expiry"
+    assert any(e["name"] == "watchdog.expiry" for e in data["events"])
+    assert "flight recorder dumped" in err
+
+
+def test_flightrec_depth_zero_disables(monkeypatch, tmp_path, capsys):
+    dump_dir = _redirect_flightrec_dumps(monkeypatch, tmp_path)
+    monkeypatch.setenv("SEQALIGN_FLIGHTREC_DEPTH", "0")
+    run_inproc(
+        "--input", fixture_path("tiny"),
+        "--retries", "2",
+        "--deadline", "0.05",
+        "--faults", "hang:dispatch:fail=1",
+        "--metrics",
+        capsys=capsys,
+    )
+    assert not dump_dir.exists()
+
+
+# -- golden Perfetto projection (canned coalesced serve run) -----------------
+
+#: The projection keeps only run-order-stable content: tracks, names,
+#: request/launch linkage.  Timestamps/durations go (wall clock), and
+#: so do non-serve spans and bus events (jit-cache state differs
+#: between a lone run and a full in-process suite run).
+_KEEP_ARGS = ("id", "trace", "outcome", "links", "request_ids", "rows", "len1")
+
+
+def _project(rec: dict) -> list[dict]:
+    kept = []
+    for ev in rec["traceEvents"]:
+        if ev.get("ph") == "M":
+            kept.append(ev)
+            continue
+        cat, name = ev.get("cat"), ev.get("name", "")
+        if cat in ("request", "launch", "model"):
+            pass
+        elif cat in ("bus", "span") and name.startswith("serve."):
+            pass
+        else:
+            continue
+        args = ev.get("args", {})
+        kept.append({
+            "ph": ev["ph"],
+            "pid": ev["pid"],
+            "tid": ev["tid"],
+            "cat": cat,
+            "name": name,
+            "args": {k: args[k] for k in _KEEP_ARGS if k in args},
+        })
+    return kept
+
+
+@pytest.mark.no_chaos  # exact event sequence; ambient faults add retries
+def test_serve_trace_golden(tmp_path, capsys):
+    # The canonical coalescing scenario (test_serve.py): two requests
+    # sharing a problem key land in ONE superblock / ONE launch.
+    reqfile = tmp_path / "requests.ndjson"
+    reqfile.write_text(
+        json.dumps(_request("a")) + "\n"
+        + json.dumps(_request("b", seq2=["GGGG"])) + "\n"
+    )
+    trace_out = tmp_path / "trace.json"
+    report = tmp_path / "run.json"
+    run_inproc(
+        "--serve",
+        "--input", str(reqfile),
+        "--metrics-out", str(report),
+        "--trace-out", str(trace_out),
+        capsys=capsys,
+    )
+    rec = json.loads(trace_out.read_text())
+    validate_report(rec)
+    assert rec["kind"] == "trace"
+
+    # Hard gates first (the trace-smoke contract): every launch carries
+    # at least one linked request id and a finite gap row.
+    launches = [e for e in rec["traceEvents"] if e.get("cat") == "launch"]
+    assert launches, "no launch events in the serve trace"
+    for ev in launches:
+        assert ev["args"]["request_ids"], f"unlinked launch: {ev}"
+    ga = rec["gap_attribution"]
+    assert ga["launch_count"] == 1 and ga["unfinished_launches"] == 0
+    row = ga["launches"][0]
+    assert sorted(row["request_ids"]) == ["a", "b"]
+    # The launch is priced as dispatched: the full padded superblock
+    # (64 rows), not the 3 real rows — same stance as the cost model.
+    assert row["rows"] == 64
+    for field in ("measured_s", "modelled_s", "gap_s"):
+        assert math.isfinite(row[field])
+    assert ga["total_gap_s"] == pytest.approx(
+        ga["total_measured_s"] - ga["total_modelled_s"], abs=1e-6
+    )
+
+    # The run report reproduces the same attribution table.
+    rep = json.loads(report.read_text())
+    validate_report(rep)
+    assert rep["gap_attribution"]["launch_count"] == 1
+    assert rep["gap_attribution"]["launches"] == ga["launches"]
+
+    proj = _project(rec)
+    if os.environ.get("SEQALIGN_UPDATE_GOLDEN"):
+        GOLDEN_TRACE.write_text(
+            json.dumps(proj, indent=2, sort_keys=True) + "\n"
+        )
+    want = json.loads(GOLDEN_TRACE.read_text())
+    assert proj == want
