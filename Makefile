@@ -18,7 +18,7 @@ PY_CFLAGS  := $(shell $(PYCONFIG) --includes)
 PY_LDFLAGS := $(shell $(PYCONFIG) --ldflags --embed)
 INPUT      ?= /root/reference/input5.txt
 
-.PHONY: build run run2 runOn2 test chaos chaos-kill analyze schedule-audit metrics-smoke serve-smoke serve-chaos aot-smoke trace-smoke bench bench-table bench-gather check clean
+.PHONY: build run run2 runOn2 test chaos chaos-kill analyze schedule-audit metrics-smoke serve-smoke serve-chaos fleet-chaos aot-smoke trace-smoke bench bench-table bench-gather check clean
 
 build: final
 
@@ -129,6 +129,17 @@ serve-smoke:
 # CPU-only, seconds.
 serve-chaos:
 	JAX_PLATFORMS=cpu $(PYTHON) scripts/serve_chaos.py
+
+# Fleet chaos tier (docs/ARCHITECTURE.md §8.6): a real coordinator
+# (--serve --fleet-board) plus real --fleet-worker subprocesses over a
+# shared FileBoard, under counted fault schedules — kill -9 mid-
+# superblock with dead-worker re-dispatch to a survivor, a zombie's
+# stale post fenced by epoch, a torn half-written result read as
+# missing, a stalled lease reclaimed — every scenario gated on per-id
+# records byte-identical to a clean fleetless run (exactly once, no
+# loss, no doubles).  CPU-only, under a minute.
+fleet-chaos:
+	JAX_PLATFORMS=cpu $(PYTHON) scripts/fleet_chaos.py
 
 # Tracing-tier smoke gate (docs/ARCHITECTURE.md §10): boot --serve
 # --port 0 --telemetry-port 0 --trace-out, run 2 coalescing clients,

@@ -105,6 +105,12 @@ _MODULE_CLASSES: dict[str, tuple[str, ...]] = {
     "serve/queue.py": (ROLE_SERVE, ROLE_DETERMINISTIC),
     "serve/loop.py": (ROLE_SERVE, ROLE_INSTRUMENTED),
     "serve/session.py": (ROLE_SERVE, ROLE_INSTRUMENTED),
+    # Fleet coordinator/worker: serve-plane waits (through the clock
+    # seam) + bus instrumentation.  Its membership/lease bookkeeping is
+    # the DETERMINISTIC resilience/membership.py below — tick-counted
+    # decisions, no clock reads.
+    "serve/fleet.py": (ROLE_SERVE, ROLE_INSTRUMENTED),
+    "resilience/membership.py": (ROLE_DETERMINISTIC, ROLE_INSTRUMENTED),
     # The admission controller's pricing and shed machine are clock-free
     # (waits are handed IN by the loop); the breaker's windows/cooldowns
     # are tick-counted, never wall-clock — both stay under SEQ005.

@@ -297,6 +297,31 @@ def build_arg_parser() -> argparse.ArgumentParser:
         "verbs (SEQALIGN_TELEMETRY_PORT)",
     )
     p.add_argument(
+        "--fleet-board",
+        default=None,
+        metavar="DIR",
+        help="directory for the fleet coordination board (atomic "
+        "file-backed key-value posts; no jax.distributed needed). With "
+        "--serve this loop becomes the fleet COORDINATOR: planned "
+        "superblocks are offered on the board under expiring leases "
+        "(SEQALIGN_LEASE_S), scored by --fleet-worker processes, and "
+        "results are fenced by lease epoch so a dead or zombie worker "
+        "can never lose or double-answer a request; with no live "
+        "workers every block scores locally. With --fleet-worker it "
+        "names the board to claim work from.",
+    )
+    p.add_argument(
+        "--fleet-worker",
+        action="store_true",
+        help="run as an elastic-fleet scoring worker: register on the "
+        "--fleet-board, heartbeat (SEQALIGN_WORKER_HEARTBEAT_S), claim "
+        "offered superblocks under lease epochs, score them through the "
+        "shared chunk pipeline (same retry/degrade ladder as --serve), "
+        "and post epoch-stamped results; joins mid-serve and exits when "
+        "the coordinator posts shutdown (combine with --prewarm to join "
+        "warm from the AOT manifest)",
+    )
+    p.add_argument(
         "--check",
         action="store_true",
         help="validate every concrete dispatch decision against the "
@@ -880,6 +905,31 @@ def run(argv: list[str] | None = None) -> int:
          "single-process; shard the scorer with --mesh instead"),
     )):
         return EX_USAGE
+    if args.fleet_worker and _reject_combos("--fleet-worker", (
+        ("--serve", args.serve, "a process is the fleet coordinator OR "
+         "a scoring worker, never both"),
+        ("--stream", args.stream is not None, "workers score fleet "
+         "superblocks claimed off the board, not streamed chunks"),
+        ("--distributed", args.distributed, "the fleet is its own "
+         "multi-process layer on the coordination board"),
+        ("--port", args.port is not None, "workers take work from the "
+         "board, not a socket"),
+    )):
+        return EX_USAGE
+    if args.fleet_worker and not args.fleet_board:
+        print(
+            "mpi_openmp_cuda_tpu: error: --fleet-worker requires "
+            "--fleet-board DIR (the board is where work is claimed)",
+            file=sys.stderr,
+        )
+        return EX_USAGE
+    if args.fleet_board and not (args.serve or args.fleet_worker):
+        print(
+            "mpi_openmp_cuda_tpu: error: --fleet-board requires --serve "
+            "(coordinator) or --fleet-worker (scoring worker)",
+            file=sys.stderr,
+        )
+        return EX_USAGE
     if args.port is not None and not args.serve:
         print(
             "mpi_openmp_cuda_tpu: error: --port requires --serve (the "
@@ -982,6 +1032,24 @@ def run(argv: list[str] | None = None) -> int:
         # finally below so library callers never inherit our handlers.
         _drain = drain_guard()
         _drain.__enter__()
+        if args.fleet_worker:
+
+            def _imp_fleet():
+                from ..serve import fleet as fleet_mod
+
+                return fleet_mod
+
+            fleet_mod = _feature_import(
+                "--fleet-worker scoring loop", _imp_fleet
+            )
+            with timer.phase("setup"):
+                deg = _make_degrader(args, _make_scorer(args, False))
+            obs_gauge("backend", deg.scorer.backend)
+            # A joining worker prewarms from the shipped AOT manifest so
+            # it claims its first superblock with warm jit caches.
+            _run_prewarm(args, timer, backend=deg.scorer.backend)
+            rc = fleet_mod.run_fleet_worker(args, timer, policy, deg)
+            return rc
         if args.serve:
             if args.journal:
                 _check_resume(args)

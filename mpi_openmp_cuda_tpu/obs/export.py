@@ -102,6 +102,10 @@ def heartbeat_line(snapshot: dict) -> str:
         line += f" shed={g['shed_state']}"
     if "breaker_state" in g:
         line += f" breaker={g['breaker_state']}"
+    if "fleet_workers" in g:
+        # Fleet coordinator only (the gauge exists only under
+        # --fleet-board): batch AND plain-serve heartbeats unchanged.
+        line += f" fleet={g['fleet_workers']}"
     return line
 
 

@@ -45,6 +45,7 @@ DEFAULT_DEPTH = 256
 DUMP_TRIGGERS = {
     "watchdog.expiry": "watchdog-expiry",
     "breaker.open": "breaker-open",
+    "worker.dead": "worker-dead",
 }
 
 

@@ -224,6 +224,18 @@ class MetricsRegistry:
             self.inc("serve_block_failures")
         elif event == "serve.client.lost":
             self.inc("serve_clients_lost")
+        elif event == "worker.join":
+            self.inc("fleet_joins")
+            self.gauge("fleet_workers", int(fields.get("workers", 0)))
+        elif event == "worker.dead":
+            self.inc("fleet_deaths")
+            self.gauge("fleet_workers", int(fields.get("workers", 0)))
+        elif event == "lease.expired":
+            self.inc("fleet_lease_expiries")
+        elif event == "lease.fenced":
+            self.inc("fleet_fenced_posts")
+        elif event == "fleet.redispatch":
+            self.inc("fleet_redispatches")
         elif event.startswith("breaker."):
             # breaker.open / breaker.half_open / breaker.close -> one
             # counter each, plus the current-state gauge the chaos tier

@@ -60,6 +60,13 @@ dies — instead of a raised error:
 ``kill:journal-append``   SIGKILL this process at the scheduled
                           ``journal_append`` — a deterministic mid-batch
                           preemption for the kill-resume chaos tier
+``kill:serve-tick``       SIGKILL at the scheduled serve-loop tick boundary
+                          (``serve_tick`` fire point) — the serve-mode
+                          kill-resume tier: the live journal must make the
+                          rerun's ``--resume`` lose and double nothing
+``kill:fleet-worker``     SIGKILL a fleet scoring worker at its scheduled
+                          ``fleet_score`` fire point — after the lease claim,
+                          before any result lands (mid-superblock)
 ========================  ====================================================
 
 Hang sites require an armed watchdog (``--deadline`` /
@@ -87,6 +94,22 @@ rejected for them too:
                             that exhausts the admission bucket on its own
                             (a typed ``overloaded`` rejection)
 ==========================  ==================================================
+
+Fleet marker sites (serve/fleet.py) shape worker-side failures the same
+way — probed with :func:`scheduled`, the fleet machinery does the rest:
+
+==========================  ==================================================
+``zombie:fleet-worker``     after scoring, this worker freezes its heartbeat
+                            until declared dead and its lease epoch fenced,
+                            THEN posts the stale result — which must be
+                            counted as fenced, never demuxed
+``board:torn-post``         this result post lands half-written (a writer
+                            dying mid-post on a non-atomic board); readers
+                            must treat it as missing, so the lease expires
+                            and the superblock re-dispatches
+``lease:stall``             this worker claims the offer and never scores it
+                            — the pure lease-expiry path, no death involved
+==========================  ==================================================
 """
 
 from __future__ import annotations
@@ -105,6 +128,16 @@ SERVE_SITES = frozenset(
     }
 )
 
+# Fleet marker sites (serve/fleet.py): same scheduled() contract; the
+# colon-joined names ride the same grammar re-partition as hang:/kill:.
+FLEET_SITES = frozenset(
+    {
+        "zombie:fleet-worker",
+        "board:torn-post",
+        "lease:stall",
+    }
+)
+
 KNOWN_SITES = (
     frozenset(
         {
@@ -120,9 +153,12 @@ KNOWN_SITES = (
             "hang:gather",
             "hang:broadcast",
             "kill:journal-append",
+            "kill:serve-tick",
+            "kill:fleet-worker",
         }
     )
     | SERVE_SITES
+    | FLEET_SITES
 )
 
 # Survival-site aliases: which *fire point* each hang/kill site rides.
@@ -137,7 +173,11 @@ _HANG_SITES = {
     "broadcast_index_set": "hang:broadcast",
     "broadcast_stream_meta": "hang:broadcast",
 }
-_KILL_SITES = {"journal_append": "kill:journal-append"}
+_KILL_SITES = {
+    "journal_append": "kill:journal-append",
+    "serve_tick": "kill:serve-tick",
+    "fleet_score": "kill:fleet-worker",
+}
 
 
 class InjectedFaultError(RuntimeError):
@@ -169,9 +209,10 @@ def parse_spec(spec: str) -> dict[str, SiteFaults]:
             continue
         site, sep, body = entry.partition(":")
         site = site.strip()
-        if site in ("hang", "kill"):
-            # Survival sites carry a colon in the NAME (hang:dispatch):
-            # re-partition so the first body segment joins the site.
+        if site in ("hang", "kill", "zombie", "board", "lease"):
+            # Survival/fleet sites carry a colon in the NAME
+            # (hang:dispatch, zombie:fleet-worker): re-partition so the
+            # first body segment joins the site.
             sub, sep2, rest = body.partition(":")
             site, sep, body = f"{site}:{sub.strip()}", sep2, rest
         if not sep or not body.strip():
@@ -213,7 +254,9 @@ def parse_spec(spec: str) -> dict[str, SiteFaults]:
         if "fail" not in kv:
             raise ValueError(f"--faults entry for {site!r} needs fail=N")
         if "kind" in kv and (
-            site.partition(":")[0] in ("hang", "kill") or site in SERVE_SITES
+            site.partition(":")[0] in ("hang", "kill")
+            or site in SERVE_SITES
+            or site in FLEET_SITES
         ):
             raise ValueError(
                 f"--faults site {site!r} does not take kind= (the failure "

@@ -1,0 +1,243 @@
+"""Fleet membership: worker registry, heartbeats, epoch-fenced leases.
+
+ROADMAP item 2 names the PR-4 rescue board as the membership layer for
+an *elastic* serve fleet — workers joining and leaving mid-serve.  This
+module is that layer: the pure bookkeeping the fleet coordinator
+(serve/fleet.py) drives once per board poll.  It owns three things:
+
+* the **board key schema** under ``seqalign/fleet/`` — registrations,
+  heartbeats, superblock offers, lease claims, epoch-stamped results;
+* :class:`Membership` — who is alive, decided from heartbeat *change*
+  under a tick-counted deadline;
+* :class:`LeaseTable` — which worker owns which offered superblock, at
+  which fencing epoch, and when a lease has expired.
+
+Two invariants, both inherited from the PR-4 board pattern:
+
+* **Torn posts read as missing, never as data.**  Every structured
+  record crossing the board goes through :func:`board_read_json`: a
+  post that is absent, zero-length, unparsable (a writer killed
+  mid-write on a non-atomic board, or the chaos tier's deliberately
+  torn ``board:torn-post``), or not a JSON object is indistinguishable
+  from no post at all.  The lease deadline then re-dispatches the work
+  — a torn result can delay an answer, never corrupt one.
+* **Decisions are tick-counted, never wall-clock (SEQ005).**  The
+  caller hands ``observe``/``expired`` its own monotonically increasing
+  poll-tick number.  A worker is dead when its heartbeat value has not
+  *changed* for ``deadline_ticks`` observed ticks; a lease is expired
+  ``lease_ticks`` after issue or claim.  Wall time only paces the
+  caller's polls, through the injectable serve clock, where tests
+  substitute a fake.
+
+**Epoch fencing** is how a zombie — a worker declared dead whose
+process is still running — is kept from double-answering a request:
+every re-dispatch bumps the lease epoch, claim and result keys embed
+the epoch, and :meth:`LeaseTable.admits` is the one acceptance
+predicate.  A result posted under any previous epoch lands on the
+board, is counted (``lease.fenced``), and is never demuxed.  Death is
+terminal: a worker whose heartbeat resumes after the verdict stays
+dead — its leases were already re-dispatched — and a restarted process
+registers under a new (pid-derived) worker id instead.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass
+
+from ..obs.events import publish
+
+#: Board key namespace.  One fleet per board: for FileBoard fleets the
+#: board *directory* is the run scope, so no run tag is needed here.
+_ROOT = "seqalign/fleet"
+WORKER_PREFIX = f"{_ROOT}/worker/"
+OFFER_PREFIX = f"{_ROOT}/offer/"
+
+
+def worker_key(wid: str) -> str:
+    return f"{WORKER_PREFIX}{wid}"
+
+
+def heartbeat_key(wid: str) -> str:
+    return f"{_ROOT}/hb/{wid}"
+
+
+def offer_key(bid: str) -> str:
+    return f"{OFFER_PREFIX}{bid}"
+
+
+def claim_key(bid: str, epoch: int) -> str:
+    return f"{_ROOT}/claim/{bid}/e{int(epoch)}"
+
+
+def result_key(bid: str, epoch: int) -> str:
+    return f"{_ROOT}/result/{bid}/e{int(epoch)}"
+
+
+def shutdown_key() -> str:
+    return f"{_ROOT}/shutdown"
+
+
+def board_read_json(board, key: str) -> dict | None:
+    """One JSON-object read with the torn-post guarantee: a missing,
+    zero-length, unparsable, or non-object post reads as None."""
+    raw = board.get(key)
+    if raw is None or not raw.strip():
+        return None
+    try:
+        obj = json.loads(raw)
+    except (json.JSONDecodeError, ValueError):
+        return None
+    return obj if isinstance(obj, dict) else None
+
+
+@dataclass
+class WorkerView:
+    """Coordinator-side view of one registered worker."""
+
+    wid: str
+    beat: int = -1  # last heartbeat VALUE read off the board
+    seen_tick: int = 0  # tick that value last changed
+    alive: bool = True
+
+
+class Membership:
+    """The worker registry: registrations plus heartbeat staleness.
+
+    ``observe(tick)`` is the whole protocol: scan registration keys (a
+    new one is a join), re-read each live worker's heartbeat (a changed
+    value proves liveness at this tick; a value frozen for
+    ``deadline_ticks`` ticks is a death verdict).  Publishes
+    ``worker.join`` / ``worker.dead`` and returns the joined/died ids.
+    """
+
+    def __init__(self, board, deadline_ticks: int):
+        if deadline_ticks < 1:
+            raise ValueError(
+                f"membership deadline must be >= 1 tick, got {deadline_ticks}"
+            )
+        self.board = board
+        self.deadline_ticks = int(deadline_ticks)
+        self.workers: dict[str, WorkerView] = {}
+
+    def observe(self, tick: int) -> tuple[list[str], list[str]]:
+        tick = int(tick)
+        joined: list[str] = []
+        died: list[str] = []
+        for key in self.board.keys(WORKER_PREFIX):
+            wid = key[len(WORKER_PREFIX):]
+            if not wid or wid in self.workers:
+                continue
+            if board_read_json(self.board, key) is None:
+                continue  # torn registration: not a member (yet)
+            self.workers[wid] = WorkerView(wid, seen_tick=tick)
+            joined.append(wid)
+            publish("worker.join", worker=wid, workers=self.live_count())
+        for view in self.workers.values():
+            if not view.alive:
+                continue
+            beat = self._read_beat(view.wid)
+            if beat is not None and beat != view.beat:
+                view.beat = beat
+                view.seen_tick = tick
+            elif tick - view.seen_tick >= self.deadline_ticks:
+                view.alive = False
+                died.append(view.wid)
+        for wid in died:
+            publish("worker.dead", worker=wid, workers=self.live_count())
+        return joined, died
+
+    def _read_beat(self, wid: str) -> int | None:
+        raw = self.board.get(heartbeat_key(wid))
+        if raw is None or not raw.strip():
+            return None
+        try:
+            return int(raw)
+        except ValueError:
+            return None  # torn heartbeat reads as missing
+
+    def live(self) -> list[str]:
+        return [w.wid for w in self.workers.values() if w.alive]
+
+    def live_count(self) -> int:
+        return sum(1 for w in self.workers.values() if w.alive)
+
+    def is_live(self, wid: str) -> bool:
+        view = self.workers.get(wid)
+        return view is not None and view.alive
+
+
+@dataclass
+class Lease:
+    """One superblock's lease: fencing epoch, holder, and the tick its
+    expiry clock last (re)started — at issue, claim, or bump."""
+
+    bid: str
+    epoch: int = 0
+    holder: str | None = None
+    since: int = 0
+
+
+class LeaseTable:
+    """Epoch-fenced leases with tick-counted expiry.
+
+    The epoch is the fencing token: every re-dispatch bumps it, claim
+    and result posts embed it, and :meth:`admits` — the one acceptance
+    predicate — only passes the CURRENT epoch.  A zombie holding epoch
+    N cannot double-answer after the coordinator moved to N+1.
+    """
+
+    def __init__(self, lease_ticks: int):
+        if lease_ticks < 1:
+            raise ValueError(
+                f"lease must be >= 1 tick, got {lease_ticks}"
+            )
+        self.lease_ticks = int(lease_ticks)
+        self._leases: dict[str, Lease] = {}
+
+    def issue(self, bid: str, tick: int) -> Lease:
+        if bid in self._leases:
+            raise ValueError(f"lease for block {bid!r} already issued")
+        lease = Lease(bid, since=int(tick))
+        self._leases[bid] = lease
+        return lease
+
+    def get(self, bid: str) -> Lease:
+        return self._leases[bid]
+
+    def note_claim(self, bid: str, wid: str, tick: int) -> None:
+        lease = self._leases[bid]
+        lease.holder = str(wid)
+        lease.since = int(tick)  # the expiry clock restarts at the claim
+
+    def bump(self, bid: str, tick: int) -> int:
+        """Fence + re-arm: next epoch, no holder, expiry clock reset."""
+        lease = self._leases[bid]
+        lease.epoch += 1
+        lease.holder = None
+        lease.since = int(tick)
+        return lease.epoch
+
+    def admits(self, bid: str, epoch: int) -> bool:
+        """The fencing predicate: does a result carrying ``epoch``
+        answer the CURRENT lease?  Retired/unknown blocks admit
+        nothing."""
+        lease = self._leases.get(bid)
+        return lease is not None and int(epoch) == lease.epoch
+
+    def retire(self, bid: str) -> None:
+        self._leases.pop(bid, None)
+
+    def expired(self, tick: int) -> list[Lease]:
+        tick = int(tick)
+        return [
+            lease
+            for lease in self._leases.values()
+            if tick - lease.since >= self.lease_ticks
+        ]
+
+    def held_by(self, wid: str) -> list[Lease]:
+        return [
+            lease for lease in self._leases.values()
+            if lease.holder == str(wid)
+        ]

@@ -15,7 +15,10 @@ the scatter semantics but makes the gather survivable:
   multi-host channel that still works when a *peer* is dead — a
   collective would hang); :class:`MemoryBoard` is the in-process
   equivalent for single-process runs and simulated-loss tests, where a
-  missing key IS a missed deadline (deterministic, no clock).
+  missing key IS a missed deadline (deterministic, no clock);
+  :class:`FileBoard` is the multi-process single-machine form (atomic
+  directory posts, no jax.distributed) that backs the elastic serve
+  fleet (serve/fleet.py + resilience/membership.py).
 * :func:`fetch_shard` — the per-worker gather: beacon first, rows
   second, timeout (``SEQALIGN_BEACON_S``) identifying the lost worker.
   All timing lives in the board's blocking get (the monitoring
@@ -29,6 +32,7 @@ the scatter semantics but makes the gather survivable:
 from __future__ import annotations
 
 import json
+import os
 
 import numpy as np
 
@@ -59,6 +63,14 @@ class MemoryBoard:
     a worker that never posted simply has no key, and ``get`` returns
     None immediately — absence is the deterministic analogue of a
     missed wall-clock deadline.
+
+    All boards share the torn-post guarantee: a post that did not land
+    whole (here: an empty value, the in-memory stand-in for a writer
+    killed before its bytes hit the board) reads as MISSING, never as
+    data.  The fleet tier (resilience/membership.py) leans on three
+    extra verbs every board grows here: ``claim`` (atomic post-if-absent
+    — the lease race's single-winner primitive), ``delete``, and
+    ``keys`` (prefix scan, the worker's offer discovery).
     """
 
     def __init__(self):
@@ -68,7 +80,108 @@ class MemoryBoard:
         self._kv[key] = value
 
     def get(self, key: str, timeout_s: float | None = None) -> str | None:
-        return self._kv.get(key)
+        value = self._kv.get(key)
+        return value if value else None  # zero-length post reads as missing
+
+    def claim(self, key: str, value: str) -> bool:
+        if key in self._kv:
+            return False
+        self._kv[key] = value
+        return True
+
+    def delete(self, key: str) -> None:
+        self._kv.pop(key, None)
+
+    def keys(self, prefix: str) -> list[str]:
+        return sorted(k for k in self._kv if k.startswith(prefix))
+
+
+class FileBoard:
+    """Directory-backed bulletin board for multi-process single-machine
+    fleets (serve/fleet.py) — no jax.distributed required.
+
+    Key ``a/b/c`` is the file ``root/a/b/c``.  Every ``post`` is atomic
+    (tmp file + fsync + ``os.replace``), so a reader can never observe a
+    half-written value under the final name; a writer killed mid-post
+    leaves only a ``.tmp.`` orphan, which readers and ``keys`` skip.
+    ``claim`` is ``os.link`` onto the final name: the filesystem makes
+    exactly one linker win, so two workers racing one lease resolve
+    without any coordination service.  Defensively, ``get`` still treats
+    unreadable or zero-length files as missing — the chaos tier posts
+    deliberately torn values through ``post`` to prove readers survive
+    a board that DID tear (e.g. a non-atomic network filesystem).
+    """
+
+    _TMP = ".tmp."
+
+    def __init__(self, root: str):
+        self.root = os.path.abspath(root)
+        os.makedirs(self.root, exist_ok=True)
+
+    def _path(self, key: str) -> str:
+        parts = [p for p in key.split("/") if p and p not in (".", "..")]
+        if not parts:
+            raise ValueError(f"empty board key: {key!r}")
+        return os.path.join(self.root, *parts)
+
+    def _write_tmp(self, path: str, value: str) -> str:
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        tmp = os.path.join(
+            os.path.dirname(path),
+            f"{self._TMP}{os.path.basename(path)}.{os.getpid()}",
+        )
+        with open(tmp, "w", encoding="utf-8") as fh:
+            fh.write(value)
+            fh.flush()
+            os.fsync(fh.fileno())
+        return tmp
+
+    def post(self, key: str, value: str) -> None:
+        path = self._path(key)
+        os.replace(self._write_tmp(path, value), path)
+
+    def get(self, key: str, timeout_s: float | None = None) -> str | None:
+        try:
+            with open(self._path(key), encoding="utf-8") as fh:
+                value = fh.read()
+        except OSError:
+            return None
+        return value if value else None  # zero-length post reads as missing
+
+    def claim(self, key: str, value: str) -> bool:
+        path = self._path(key)
+        tmp = self._write_tmp(path, value)
+        try:
+            os.link(tmp, path)  # atomic: exactly one claimer wins
+            return True
+        except FileExistsError:
+            return False
+        except OSError:
+            return False  # unclaimable board == lost race, caller re-polls
+        finally:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+
+    def delete(self, key: str) -> None:
+        try:
+            os.unlink(self._path(key))
+        except OSError:
+            pass
+
+    def keys(self, prefix: str) -> list[str]:
+        out = []
+        for dirpath, _dirs, files in os.walk(self.root):
+            rel = os.path.relpath(dirpath, self.root)
+            base = "" if rel == "." else rel.replace(os.sep, "/") + "/"
+            for name in files:
+                if name.startswith(self._TMP):
+                    continue  # a dead writer's orphan, not a post
+                key = base + name
+                if key.startswith(prefix):
+                    out.append(key)
+        return sorted(out)
 
 
 class CoordinationBoard:
@@ -106,11 +219,36 @@ class CoordinationBoard:
     def get(self, key: str, timeout_s: float | None = None) -> str | None:
         timeout = self.timeout_s if timeout_s is None else float(timeout_s)
         try:
-            return self._client().blocking_key_value_get(
+            value = self._client().blocking_key_value_get(
                 key, int(timeout * 1000)
             )
         except Exception:
             return None  # timeout == lost worker; the ledger names it
+        return value if value else None  # zero-length post reads as missing
+
+    def claim(self, key: str, value: str) -> bool:
+        # The coordination service rejects a duplicate key_value_set, so
+        # "set succeeded" IS the single-winner claim.  Best-effort: the
+        # fleet's tested multi-process path is FileBoard; this keeps the
+        # board verbs uniform for an eventual multi-host fleet.
+        try:
+            self._client().key_value_set(key, value)
+            return True
+        except Exception:
+            return False
+
+    def delete(self, key: str) -> None:
+        try:
+            self._client().key_value_delete(key)
+        except Exception:
+            pass  # best-effort: a stale key is fenced by epoch anyway
+
+    def keys(self, prefix: str) -> list[str]:
+        try:
+            pairs = self._client().key_value_dir_get(prefix)
+        except Exception:
+            return []
+        return sorted(k for k, _v in pairs)
 
 
 def _beacon_key(run_tag: str, pid: int) -> str:

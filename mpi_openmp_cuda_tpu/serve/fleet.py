@@ -1,0 +1,518 @@
+"""Elastic serve fleet: coordinator-side dispatch + scoring workers.
+
+The serve loop (serve/loop.py) stays the **coordinator** — admission,
+SLO armor, ``plan_blocks``, demux, journaling are unchanged — but with
+``--fleet-board DIR`` armed, planned superblocks are *offered* on a
+:class:`~..resilience.rescue.FileBoard` instead of scored in-process.
+N ``--fleet-worker`` processes register on the same board, heartbeat,
+claim offers under expiring leases, score them through the shared
+:class:`~..io.pipeline.ChunkPipeline` (same retry/degrade ladder as
+everywhere else), and post epoch-stamped results.
+
+The failure model (docs/ARCHITECTURE.md §8.6):
+
+* a worker that dies mid-superblock (SIGKILL) stops heartbeating; the
+  coordinator's membership deadline declares it dead and re-dispatches
+  its held superblocks to a survivor;
+* a worker that stalls (claims, never posts) hits the lease deadline —
+  same re-dispatch, no death verdict needed;
+* a **zombie** (declared dead but still running) may post its result
+  late: the post carries the OLD lease epoch, the coordinator fences it
+  (counted, never demuxed), so no request is ever double-answered;
+* a torn result post reads as missing (resilience/membership.py), so
+  the lease expires and the block is re-dispatched;
+* with NO live workers, every block — new or orphaned — scores locally
+  on the coordinator through the PR-1 degrade chain.  The fleet is an
+  accelerator, never an availability dependency.
+
+All membership/lease decisions are tick-counted (one coordinator board
+poll = one tick); wall time only paces polls through the injectable
+:class:`~.clock.ServeClock`, so unit tests drive everything with a fake
+clock and zero sleeps.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import os
+import threading
+
+import numpy as np
+
+from ..obs.events import log_line, publish
+from ..obs.metrics import gauge as obs_gauge
+from ..resilience.drain import drain_requested
+from ..resilience.faults import fire as _fault_fire
+from ..resilience.faults import scheduled as _fault_scheduled
+from ..resilience.membership import (
+    OFFER_PREFIX,
+    LeaseTable,
+    Membership,
+    board_read_json,
+    claim_key,
+    heartbeat_key,
+    offer_key,
+    result_key,
+    shutdown_key,
+    worker_key,
+)
+from ..utils.platform import env_float, env_int
+from .clock import ServeClock
+
+#: Coordinator board-poll cadence: one membership/lease tick per poll.
+_POLL_S = 0.05
+
+
+def _pause(clock, seconds: float, predicate=None) -> None:
+    """Bounded wait through the injectable clock (SEQ007: the ServeClock
+    is the one legal wait seam).  A fresh local Condition per wait —
+    nothing ever notifies it, the timeout is the only wake-up, which is
+    exactly what a board poll interval needs."""
+    cond = threading.Condition()
+    with cond:
+        clock.block_until(cond, predicate or (lambda: False), seconds)
+
+
+class FleetCoordinator:
+    """Coordinator-side fleet state: the membership view, the lease
+    table, offer/result board traffic, and the re-dispatch policy.
+
+    Driven entirely from the serve loop's main thread — ``offer()`` at
+    dispatch, ``pump()`` once per loop tick — so there is no shared
+    mutable state and no locking.  Every decision is tick-counted: one
+    ``pump`` that actually polls the board is one tick for membership
+    deadlines and lease expiry alike.
+    """
+
+    #: Retired blocks kept under the stale-result probe, so a zombie's
+    #: late post is still *counted* as fenced after its block finished.
+    _RETIRED_PROBE = 64
+
+    def __init__(
+        self,
+        board,
+        *,
+        local_score,
+        demux,
+        clock=None,
+        lease_s=None,
+        poll_s=_POLL_S,
+    ):
+        self.board = board
+        self.clock = clock or ServeClock()
+        self._local_score = local_score
+        self._demux = demux
+        if lease_s is None:
+            lease_s = env_float("SEQALIGN_LEASE_S", 2.0)
+        self.poll_s = float(poll_s)
+        self.lease_ticks = max(2, round(float(lease_s) / self.poll_s))
+        self.membership = Membership(board, deadline_ticks=self.lease_ticks)
+        self.leases = LeaseTable(self.lease_ticks)
+        self.expected = env_int("SEQALIGN_FLEET_WORKERS", 0)
+        self._full_logged = False
+        self.blocks: dict = {}  # bid -> SuperBlock (tags stay local)
+        self._seq = 0
+        self._tick = 0
+        self._last_poll = None
+        self._fenced_seen: set[str] = set()
+        self._retired = collections.deque(maxlen=self._RETIRED_PROBE)
+
+    # -- dispatch side -----------------------------------------------------
+
+    def accepting(self) -> bool:
+        """Offers only make sense with a live worker to claim them; the
+        serve loop scores locally otherwise."""
+        return self.membership.live_count() > 0
+
+    def outstanding(self) -> int:
+        return len(self.blocks)
+
+    def offer(self, block) -> str:
+        """Put one planned superblock on the board under a fresh lease.
+        Only the scoring payload crosses the board — session tags (live
+        object references) stay coordinator-side, keyed by block id."""
+        self._seq += 1
+        bid = f"b{self._seq}"
+        self.blocks[bid] = block
+        lease = self.leases.issue(bid, self._tick)
+        self._post_offer(bid, lease.epoch, block)
+        return bid
+
+    def _post_offer(self, bid: str, epoch: int, block) -> None:
+        self.board.post(
+            offer_key(bid),
+            json.dumps({
+                "bid": bid,
+                "epoch": int(epoch),
+                "weights": [int(w) for w in block.weights],
+                "seq1": np.asarray(block.seq1_codes).tolist(),
+                "rows": [np.asarray(c).tolist() for c in block.codes],
+            }),
+        )
+
+    # -- the per-tick pump -------------------------------------------------
+
+    def pump(self, idle: bool = False) -> None:
+        """One serve-loop tick's worth of fleet work: poll the board at
+        most once per ``poll_s`` — membership observe, stale-post
+        fencing, result collection, lease expiry → re-dispatch.  When
+        the loop is otherwise idle with blocks in flight, sleep out the
+        remainder of the poll interval instead of spinning."""
+        now = self.clock.now()
+        if self._last_poll is not None:
+            wait = self.poll_s - (now - self._last_poll)
+            if wait > 0:
+                if not (idle and self.blocks):
+                    return
+                _pause(self.clock, wait, drain_requested)
+        self._last_poll = self.clock.now()
+        self._tick += 1
+        tick = self._tick
+        joined, died = self.membership.observe(tick)
+        for wid in joined:
+            log_line(
+                f"mpi_openmp_cuda_tpu: fleet: worker {wid} joined "
+                f"({self.membership.live_count()} live)"
+            )
+        if (
+            not self._full_logged
+            and self.expected
+            and self.membership.live_count() >= self.expected
+        ):
+            self._full_logged = True
+            log_line(
+                "mpi_openmp_cuda_tpu: fleet: complete "
+                f"({self.expected} worker(s) registered)"
+            )
+        for wid in died:
+            log_line(
+                f"mpi_openmp_cuda_tpu: fleet: worker {wid} missed its "
+                "heartbeat deadline; re-dispatching its superblocks"
+            )
+            for lease in self.membership_held(wid):
+                self._redispatch(lease.bid, "worker-dead")
+        for bid in list(self.blocks):
+            self._collect(bid, tick)
+        self._probe_retired()
+        for lease in self.leases.expired(tick):
+            if lease.bid not in self.blocks:
+                continue
+            publish(
+                "lease.expired",
+                block=lease.bid,
+                epoch=lease.epoch,
+                worker=lease.holder,
+            )
+            log_line(
+                f"mpi_openmp_cuda_tpu: fleet: lease on {lease.bid} "
+                f"(epoch {lease.epoch}, holder {lease.holder}) expired; "
+                "re-dispatching"
+            )
+            self._redispatch(lease.bid, "lease-expired")
+        obs_gauge("fleet_workers", self.membership.live_count())
+
+    def membership_held(self, wid: str):
+        return [
+            lease for lease in self.leases.held_by(wid)
+            if lease.bid in self.blocks
+        ]
+
+    def _collect(self, bid: str, tick: int) -> None:
+        lease = self.leases.get(bid)
+        block = self.blocks[bid]
+        self._fence_stale(bid, lease.epoch)
+        post = board_read_json(self.board, result_key(bid, lease.epoch))
+        if post is not None:
+            rows = self._valid_rows(post, bid, len(block.codes))
+            if rows is not None:
+                self.blocks.pop(bid)
+                self.leases.retire(bid)
+                self._retired.append((bid, int(post["epoch"])))
+                self.board.delete(offer_key(bid))
+                self._demux(rows, block)
+                return
+        if lease.holder is None:
+            claim = board_read_json(
+                self.board, claim_key(bid, lease.epoch)
+            )
+            if claim is not None and claim.get("wid"):
+                self.leases.note_claim(bid, str(claim["wid"]), tick)
+
+    def _fence_stale(self, bid: str, current: int) -> None:
+        """Probe every PREVIOUS epoch's result key: a post there is a
+        zombie's late answer — observed once (event + counter), never
+        demuxed.  Exactly-once holds structurally (the demux only ever
+        reads the current-epoch key); this makes the fencing visible."""
+        for epoch in range(int(current)):
+            key = result_key(bid, epoch)
+            if key in self._fenced_seen:
+                continue
+            if self.board.get(key) is None:
+                continue
+            self._fenced_seen.add(key)
+            post = board_read_json(self.board, key) or {}
+            publish(
+                "lease.fenced",
+                block=bid,
+                epoch=epoch,
+                current=int(current),
+                worker=post.get("wid"),
+            )
+            log_line(
+                f"mpi_openmp_cuda_tpu: fleet: fenced stale epoch-{epoch} "
+                f"result for {bid} (current epoch {int(current)})"
+            )
+
+    def _probe_retired(self) -> None:
+        for bid, final_epoch in self._retired:
+            self._fence_stale(bid, final_epoch)
+
+    def _valid_rows(self, post: dict, bid: str, n_rows: int):
+        """Accept a result post only if it carries the CURRENT lease
+        epoch (the fencing predicate) and well-shaped rows.  Anything
+        else reads as missing — the lease deadline re-dispatches."""
+        try:
+            epoch = int(post.get("epoch", -1))
+        except (TypeError, ValueError):
+            return None
+        if not self.leases.admits(bid, epoch):
+            return None
+        try:
+            rows = np.asarray(post.get("rows"), dtype=np.int64)
+        except (TypeError, ValueError):
+            return None
+        if rows.shape != (int(n_rows), 3):
+            return None
+        return rows
+
+    # -- re-dispatch + local fallback --------------------------------------
+
+    def _redispatch(self, bid: str, reason: str) -> None:
+        epoch = self.leases.bump(bid, self._tick)
+        publish("fleet.redispatch", block=bid, epoch=epoch, reason=reason)
+        if self.membership.live_count() > 0:
+            self._post_offer(bid, epoch, self.blocks[bid])
+            return
+        log_line(
+            f"mpi_openmp_cuda_tpu: fleet: no live workers for {bid}; "
+            "scoring locally on the coordinator"
+        )
+        self._finish_local(bid)
+
+    def _finish_local(self, bid: str) -> None:
+        """Score one outstanding block on the coordinator through the
+        serve loop's sync path (retry → degrade → bisection — the full
+        quarantine ladder).  The lease was already bumped, so any
+        straggler's later post lands fenced."""
+        block = self.blocks.pop(bid)
+        lease = self.leases.get(bid)
+        self._retired.append((bid, lease.epoch))
+        self.leases.retire(bid)
+        self.board.delete(offer_key(bid))
+        self._local_score(block)
+
+    def finish_locally(self) -> None:
+        """Drain: fence (epoch bump) and locally score every outstanding
+        superblock, so in-flight requests finish before the drain
+        journal is written and no worker post can land after resume."""
+        for bid in list(self.blocks):
+            self.leases.bump(bid, self._tick)
+            self._finish_local(bid)
+
+    def shutdown(self) -> None:
+        """End of run: tell workers to exit.  Best-effort — a worker
+        that never sees the key still exits on its own drain signal."""
+        try:
+            self.board.post(shutdown_key(), "shutdown")
+        except OSError:
+            pass
+
+
+class FleetWorker:
+    """One scoring worker's loop state (single-threaded, no locks).
+
+    register → heartbeat → scan offers → claim → score → post, forever;
+    exits when the coordinator posts the shutdown key or this process
+    is drain-signalled.  A superblock whose scoring fails past the
+    whole retry/degrade ladder is simply never posted — the
+    coordinator's lease expiry re-dispatches it, which is the fleet's
+    failure model for sick workers too.
+    """
+
+    def __init__(self, board, pipeline, policy, clock=None):
+        self.board = board
+        self.pipeline = pipeline
+        self.policy = policy
+        self.clock = clock or ServeClock()
+        self.wid = f"w{os.getpid()}"
+        self.poll_s = env_float("SEQALIGN_WORKER_HEARTBEAT_S", 0.02)
+        self._beat = 0
+        self._done: set[tuple[str, int]] = set()
+        self._zombie = False  # chaos: freeze heartbeats, earn the verdict
+        self._zombie_done = False
+
+    def register(self) -> None:
+        self.board.post(
+            worker_key(self.wid),
+            json.dumps({"wid": self.wid, "pid": os.getpid()}),
+        )
+        log_line(
+            f"mpi_openmp_cuda_tpu: fleet: worker {self.wid} registered"
+        )
+
+    def heartbeat(self) -> None:
+        self._beat += 1
+        self.board.post(heartbeat_key(self.wid), str(self._beat))
+
+    def should_exit(self) -> bool:
+        return (
+            drain_requested()
+            or self.board.get(shutdown_key()) is not None
+        )
+
+    def _heartbeat_loop(self, stop) -> None:
+        """Daemon-thread heartbeat: liveness must not depend on scoring
+        progress — a worker busy compiling its first superblock is
+        alive; only a killed (thread dies with the process) or zombie
+        (``_zombie`` frozen deliberately) worker goes silent."""
+        while not stop.is_set():
+            if not self._zombie:
+                self.heartbeat()
+            _pause(self.clock, self.poll_s, stop.is_set)
+
+    def run(self) -> int:
+        self.register()
+        stop = threading.Event()
+        pulse = threading.Thread(
+            target=self._heartbeat_loop, args=(stop,), daemon=True
+        )
+        pulse.start()
+        try:
+            while True:
+                if self.should_exit() or self._zombie_done:
+                    log_line(
+                        "mpi_openmp_cuda_tpu: fleet: worker "
+                        f"{self.wid} exiting"
+                    )
+                    return 0
+                if not self.step():
+                    _pause(self.clock, self.poll_s, drain_requested)
+        finally:
+            stop.set()
+
+    def step(self) -> bool:
+        """Scan the offer board once; claim and score anything new.
+        Returns True if any work was attempted (the run loop only
+        pauses on an empty scan)."""
+        worked = False
+        for key in self.board.keys(OFFER_PREFIX):
+            offer = board_read_json(self.board, key)
+            if offer is None:
+                continue  # torn offer reads as missing
+            bid = str(offer.get("bid", ""))
+            epoch = offer.get("epoch")
+            if not bid or not isinstance(epoch, int):
+                continue
+            if (bid, epoch) in self._done:
+                continue
+            if self.board.get(result_key(bid, epoch)) is not None:
+                self._done.add((bid, epoch))
+                continue
+            if self.board.get(claim_key(bid, epoch)) is not None:
+                continue  # someone else holds this epoch
+            if not self.board.claim(
+                claim_key(bid, epoch),
+                json.dumps({"wid": self.wid, "epoch": epoch}),
+            ):
+                continue  # lost the race: exactly one winner per epoch
+            self._done.add((bid, epoch))
+            worked = True
+            self._score_claim(offer, bid, epoch)
+        return worked
+
+    def _score_claim(self, offer: dict, bid: str, epoch: int) -> None:
+        if _fault_scheduled("lease:stall"):
+            # Chaos: hold the claim and never score — the coordinator's
+            # lease expiry must fence this epoch and re-dispatch.
+            log_line(
+                f"mpi_openmp_cuda_tpu: fleet: worker {self.wid} stalling "
+                f"its lease on {bid} (chaos)"
+            )
+            return
+        # kill:fleet-worker rides this fire point: SIGKILL mid-superblock,
+        # after the claim and before any result lands.
+        _fault_fire("fleet_score")
+        zombie = _fault_scheduled("zombie:fleet-worker")
+        try:
+            rows = self._score_offer(offer)
+        except Exception as e:
+            log_line(
+                f"mpi_openmp_cuda_tpu: fleet: worker {self.wid}: "
+                f"superblock {bid} failed ({e}); leaving it to lease "
+                "re-dispatch"
+            )
+            return
+        if zombie:
+            self._zombie = True  # heartbeats freeze: earn the death verdict
+            self._outlive_lease(bid, epoch)
+        payload = json.dumps({
+            "bid": bid,
+            "epoch": int(epoch),
+            "wid": self.wid,
+            "rows": rows.tolist(),
+        })
+        if _fault_scheduled("board:torn-post"):
+            # Chaos: a writer dying mid-post on a non-atomic board —
+            # half the bytes land.  Every reader must treat this as
+            # MISSING; the lease expires and the block re-dispatches.
+            self.board.post(result_key(bid, epoch), payload[: len(payload) // 2])
+            return
+        self.board.post(result_key(bid, epoch), payload)
+        if zombie:
+            # The stale post landed (it MUST read as fenced); a declared-
+            # dead worker has no further business claiming fresh work.
+            self._zombie_done = True
+
+    def _score_offer(self, offer: dict):
+        seq1 = np.asarray(offer["seq1"], dtype=np.int8)
+        codes = [np.asarray(r, dtype=np.int8) for r in offer["rows"]]
+        weights = [int(w) for w in offer["weights"]]
+        budget = self.policy.new_budget()
+        promise = self.pipeline.dispatch(seq1, codes, weights, budget)
+        return np.asarray(
+            self.pipeline.materialise(promise, seq1, codes, weights, budget),
+            dtype=np.int64,
+        )
+
+    def _outlive_lease(self, bid: str, epoch: int) -> None:
+        """Chaos zombie: sit on the scored result (heartbeats stopped —
+        the frozen beat is what earns the death verdict) until the
+        coordinator has moved past this epoch, then let the caller post
+        it anyway.  The post MUST land fenced, never demuxed."""
+        log_line(
+            f"mpi_openmp_cuda_tpu: fleet: worker {self.wid} going zombie "
+            f"on {bid} epoch {epoch} (chaos)"
+        )
+        while not self.should_exit():
+            offer = board_read_json(self.board, offer_key(bid))
+            if offer is None or offer.get("epoch") != epoch:
+                return  # fenced (re-offered or finished): post stale now
+            _pause(self.clock, self.poll_s, drain_requested)
+
+
+def run_fleet_worker(args, timer, policy, deg) -> int:
+    """CLI entry for ``--fleet-worker`` (io/cli.py run(); obs, faults,
+    and the drain guard are already armed there)."""
+    from ..io.pipeline import ChunkPipeline
+    from ..resilience.rescue import FileBoard
+
+    worker = FleetWorker(
+        FileBoard(args.fleet_board),
+        ChunkPipeline(policy, deg),
+        policy,
+    )
+    with timer.phase("serve"):
+        rc = worker.run()
+    timer.report()
+    return rc

@@ -43,6 +43,21 @@ victims re-plan onto clean blocks; and the pipeline's circuit breaker
 (:mod:`..resilience.breaker`), ticked here, pins the degraded backend
 after repeated primary failures.
 
+**Fleet** (docs/ARCHITECTURE.md §8.6): with ``--fleet-board`` the loop
+is the fleet COORDINATOR — planning, admission, SLO armor, and demux
+are unchanged, but planned superblocks are offered to ``--fleet-worker``
+processes through :class:`.fleet.FleetCoordinator` under expiring
+leases, and the loop's tick pumps membership/lease/result collection.
+With no live workers every block scores locally, exactly as before.
+
+**Crash survival** (``kill:serve-tick`` chaos tier): while ``--journal``
+is armed, the journal continuously holds every admitted-but-unanswered
+raw request — queued AND in-flight — rewritten (whole-file atomic) at
+tick boundaries whenever the set changes.  A SIGKILL mid-serve loses
+nothing: the rerun's ``--resume`` re-admits exactly the unanswered
+requests, and since a request leaves the journal only after its done
+record went out, the rerun can never double-answer one.
+
 Threading: socket reader threads only ``json.loads`` + enqueue (see
 :mod:`.queue`); parsing, scoring, span recording, and ALL journal/metric
 mutation happen on the main loop thread.
@@ -50,6 +65,7 @@ mutation happen on the main loop thread.
 
 from __future__ import annotations
 
+import json
 import socket as socketlib
 import struct
 import sys
@@ -64,6 +80,7 @@ from ..obs.metrics import gauge as obs_gauge
 from ..obs.spans import span
 from ..resilience.drain import DrainInterrupt, drain_requested
 from ..resilience.faults import InjectedFatalFaultError
+from ..resilience.faults import fire as _fault_fire
 from ..resilience.faults import scheduled as _fault_scheduled
 from ..utils.constants import BUF_SIZE_SEQ2
 from ..utils.platform import env_float, env_int
@@ -137,6 +154,12 @@ class ServeLoop:
         # loop ticks it so open/half-open transitions stay deterministic.
         self.breaker = getattr(pipeline, "breaker", None)
         self._steady_base: int | None = None
+        # Fleet coordinator (run_serve attaches one under --fleet-board).
+        self.fleet = None
+        # Live-journal state: (session, raw) for every in-flight request,
+        # plus the last journal body written (skip no-op rewrites).
+        self._inflight: list[tuple] = []
+        self._journal_state: str | None = None
 
     # -- ingest (reader threads and the main-thread stdin loop) -----------
 
@@ -213,7 +236,28 @@ class ServeLoop:
         budget (the per-superblock watchdog deadline rides inside the
         scorer, unchanged from batch mode).  A failure that escapes the
         whole retry/degrade ladder quarantines instead of killing the
-        loop."""
+        loop.
+
+        With a fleet accepting (a live worker on the board), the block
+        is OFFERED instead: the payload goes out under a fresh lease and
+        the coordinator's pump collects the epoch-fenced result.  The
+        poison check stays coordinator-side either way — quarantine
+        bisection needs the session tags, which never cross the board."""
+        if self.fleet is not None and self.fleet.accepting():
+            try:
+                self._check_poison(block)
+            except Exception as e:
+                self._block_failed(block, e)
+                return
+            self.fleet.offer(block)
+            publish(
+                "serve.batch.dispatch",
+                rows=block.real_rows,
+                fill=round(block.fill_ratio, 4),
+                depth=self.queue.depth(),
+                links=block.link_ids(),
+            )
+            return
         budget = self.policy.new_budget()
         links = block.link_ids()
         try:
@@ -302,6 +346,15 @@ class ServeLoop:
             promise, block.seq1_codes, block.codes, block.weights, budget
         )
         self._demux(rows, block)
+
+    def _fleet_fallback(self, block) -> None:
+        """Coordinator-local scoring for a fleet superblock with no live
+        workers (or at drain): the same sync score → retry → bisection
+        quarantine ladder as any failed local block."""
+        try:
+            self._score_block_sync(block)
+        except Exception as e:
+            self._block_failed(block, e)
 
     def _bisect(self, block, err) -> None:
         """Quarantine stage 2: split the failed block's sessions in half
@@ -402,6 +455,10 @@ class ServeLoop:
     def tick(self) -> bool:
         """One loop iteration; returns False once idle with no sources
         left (the stdin/file mode's termination condition)."""
+        # kill:serve-tick rides this fire point: SIGKILL at a tick
+        # boundary, where the live journal exactly holds the unanswered
+        # set (chaos-kill tier proves no-lost + no-double on resume).
+        _fault_fire("serve_tick")
         if drain_requested():
             self._drain(())
         window_s = (
@@ -416,6 +473,10 @@ class ServeLoop:
             self._drain(items)
         if self.breaker is not None:
             self.breaker.tick()
+        if self.fleet is not None:
+            self.fleet.pump(
+                idle=not items and self.queue.depth() == 0
+            )
         now = self.clock.now()
         if items:
             for item in items:
@@ -452,6 +513,11 @@ class ServeLoop:
                 # fatally until quarantine isolates it.
                 sess.poisoned = True
             sessions.append(sess)
+            self._inflight.append((sess, item.raw))
+        # Journal checkpoint A: popped-but-unanswered requests are now
+        # tracked as in-flight — a death anywhere in this tick keeps
+        # them journaled.
+        self._journal_live()
         live = self._admit_sessions(sessions, now)
         if live:
             for block in plan_blocks(live, self.rows_per_block):
@@ -461,9 +527,36 @@ class ServeLoop:
             # Emits the done record for empty (n == 0) requests; a
             # no-op for sessions already completed or failed.
             sess.advance()
+        # Journal checkpoint B: requests answered this tick leave the
+        # journal, so the next tick's kill cannot double-answer them.
+        self._journal_live()
         obs_gauge("queue_depth", self.queue.depth())
         obs_gauge("shed_state", self.controller.state)
-        return bool(items) or not self.queue.idle()
+        return (
+            bool(items)
+            or not self.queue.idle()
+            or (self.fleet is not None and self.fleet.outstanding() > 0)
+        )
+
+    def _journal_live(self) -> None:
+        """Rewrite the serve journal (whole-file atomic) with every
+        admitted-but-unanswered raw request — in-flight first (older),
+        then still-queued — skipping the write when nothing changed.
+        The drain path's :func:`journal_drained` call stays the final
+        authoritative write; this keeps the file honest BETWEEN drains
+        so ``kill -9`` + ``--resume`` loses and doubles nothing."""
+        if self.journal_path is None:
+            return
+        self._inflight = [
+            (sess, raw) for (sess, raw) in self._inflight if not sess.closed
+        ]
+        raws = [raw for (_sess, raw) in self._inflight]
+        raws += self.queue.snapshot_raws()
+        state = json.dumps(raws)
+        if state == self._journal_state:
+            return
+        self._journal_state = state
+        journal_drained(self.journal_path, raws)
 
     # -- drain -------------------------------------------------------------
 
@@ -472,6 +565,11 @@ class ServeLoop:
         and surface the resumable preemption (CLI maps it to exit 75)."""
         self.queue.close()
         self.window.flush()
+        if self.fleet is not None:
+            # Fence + locally finish fleet superblocks still in flight:
+            # their sessions answer BEFORE the journal write below, and
+            # any straggler worker post lands on a bumped epoch.
+            self.fleet.finish_locally()
         leftovers = list(popped) + self.queue.drain_pending()
         for it in leftovers:
             it.responder.send({"id": it.raw.get("id"), "drained": True})
@@ -597,6 +695,21 @@ def run_serve(args, timer, policy, deg, out_stream=None, prewarmed=False) -> int
     )
     if prewarmed:
         loop.baseline_steady()
+    if getattr(args, "fleet_board", None):
+        from ..resilience.rescue import FileBoard
+        from .fleet import FleetCoordinator
+
+        loop.fleet = FleetCoordinator(
+            FileBoard(args.fleet_board),
+            local_score=loop._fleet_fallback,
+            demux=loop._demux,
+            clock=loop.clock,
+        )
+        obs_gauge("fleet_workers", 0)
+        log_line(
+            "mpi_openmp_cuda_tpu: serve: fleet coordinator on board "
+            f"{args.fleet_board!r} (lease {loop.fleet.lease_ticks} ticks)"
+        )
     out_responder = Responder(out_stream or sys.stdout)
     if args.journal:
         resumed = load_drained(args.journal)
@@ -654,6 +767,8 @@ def run_serve(args, timer, policy, deg, out_stream=None, prewarmed=False) -> int
         timer.report()
         return 0
     finally:
+        if loop.fleet is not None:
+            loop.fleet.shutdown()
         loop.record_steady_gauge()
         if telem is not None:
             telem.close()

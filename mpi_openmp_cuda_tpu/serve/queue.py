@@ -209,6 +209,13 @@ class RequestQueue:
             popped, self._items[:take] = self._items[:take], []
             return popped
 
+    def snapshot_raws(self) -> list[dict]:
+        """Copy of the queued raw dicts in admission order, WITHOUT
+        popping (the serve loop's live journal rewrite — the queue keeps
+        ownership of every item)."""
+        with self._cond:
+            return [it.raw for it in self._items]
+
     def drain_pending(self) -> list[QueuedRequest]:
         """Remove and return everything still queued (drain journaling)."""
         with self._cond:

@@ -266,6 +266,32 @@ ENV_VARS: tuple[EnvVar, ...] = (
         "primary backend half-open",
     ),
     EnvVar(
+        "SEQALIGN_FLEET_WORKERS",
+        "int",
+        0,
+        "expected scoring-worker count for the elastic serve fleet "
+        "(--fleet-board): an observability hint only — the fleet is "
+        "elastic, workers join and leave mid-serve; the coordinator "
+        "logs when the fleet first reaches this size",
+    ),
+    EnvVar(
+        "SEQALIGN_LEASE_S",
+        "float",
+        2.0,
+        "fleet superblock lease: nominal seconds a claimed (or never-"
+        "claimed) offer may sit without a result before the coordinator "
+        "fences its epoch and re-dispatches; converted to board-poll "
+        "ticks so membership/lease decisions stay tick-counted",
+    ),
+    EnvVar(
+        "SEQALIGN_WORKER_HEARTBEAT_S",
+        "float",
+        0.02,
+        "fleet worker heartbeat/board-poll cadence in seconds; a worker "
+        "whose heartbeat value stalls for a full lease window is "
+        "declared dead and its claimed superblocks re-dispatched",
+    ),
+    EnvVar(
         "JAX_COORDINATOR_ADDRESS",
         "str",
         None,

@@ -1,0 +1,417 @@
+"""Fleet chaos tier (``make fleet-chaos``): elastic-fleet exactly-once
+under worker murder, zombies, torn posts, and stalled leases.
+
+Every scenario runs a REAL coordinator (``--serve --fleet-board``) plus
+real ``--fleet-worker`` subprocesses over a shared ``FileBoard``
+directory, then gates the one promise that matters: **every admitted
+request is answered exactly once, with per-id records byte-identical to
+a clean fleetless run** — no matter which process died, lied, or
+stalled along the way:
+
+* **kill-worker**: a worker is SIGKILLed (``kill:fleet-worker``) right
+  after claiming the superblock; the coordinator's tick-counted
+  membership declares it dead, re-dispatches the block at a bumped
+  epoch, and a late-joining survivor scores it;
+* **zombie-fence**: a worker freezes its heartbeats after scoring
+  (``zombie:fleet-worker``), gets declared dead and its block rescued
+  locally, then posts its stale epoch-0 result anyway — the post lands
+  on the board but never reaches a client (epoch fencing);
+* **torn-post**: a worker posts a torn half-written result
+  (``board:torn-post``); the coordinator reads it as MISSING, the lease
+  expires, and the re-dispatched epoch scores clean;
+* **lease-stall**: a worker claims and then never scores
+  (``lease:stall``); lease expiry re-dispatches and the same worker
+  completes the bumped epoch;
+* **usage**: ``--fleet-worker`` without ``--fleet-board`` is a hard
+  exit 64.
+
+The coordinator must never crash and the SLO armor must stay quiet:
+every scenario also gates "no Traceback", ``shed_state == accept``, and
+a schema-valid run report.  Exit 0 on success, 1 with every problem
+listed — the same all-problems-at-once reporting style as serve_chaos.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from mpi_openmp_cuda_tpu.obs.metrics import validate_report  # noqa: E402
+
+WEIGHTS = [1, -3, -5, -2]
+SEQ1 = "ACGTACGTACGTACGT"
+
+#: The request set every scenario serves: both requests share weights +
+#: seq1 so they pack into ONE superblock — the unit the fleet claims,
+#: kills, fences, and re-dispatches.
+REQS = [
+    {"id": "r1", "weights": WEIGHTS, "seq1": SEQ1,
+     "seq2": ["ACGT", "GATTACA"]},
+    {"id": "r2", "weights": WEIGHTS, "seq1": SEQ1, "seq2": ["TTTT"]},
+]
+
+
+def _spawn_worker(out_dir, board, tag, *, faults=None, env_extra=None):
+    """One ``--fleet-worker`` subprocess; stdout+stderr to a log file."""
+    argv = [
+        sys.executable, "-m", "mpi_openmp_cuda_tpu",
+        "--fleet-worker", "--fleet-board", board,
+    ]
+    if faults:
+        argv += ["--faults", faults]
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    env.setdefault("SEQALIGN_BACKOFF_BASE", "0.01")
+    env.update(env_extra or {})
+    log = open(os.path.join(out_dir, f"{tag}.worker.log"), "w")
+    proc = subprocess.Popen(
+        argv, cwd=REPO, env=env, stdout=log, stderr=subprocess.STDOUT
+    )
+    return proc, log
+
+
+def _wait_registered(board, n, timeout_s=90.0) -> bool:
+    """Block until >= n workers have posted registrations on the board
+    (the coordinator would otherwise score everything locally and the
+    scenario would degenerate into plain serve)."""
+    wdir = os.path.join(board, "seqalign", "fleet", "worker")
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        try:
+            names = [f for f in os.listdir(wdir) if not f.startswith(".tmp.")]
+        except OSError:
+            names = []
+        if len(names) >= n:
+            return True
+        time.sleep(0.1)
+    return False
+
+
+def _run_coordinator(out_dir, name, *, board=None, faults=None,
+                     env_extra=None):
+    """One pipe-mode --serve subprocess (the fleet coordinator when
+    ``board`` is set); returns (rc, records, report, stderr)."""
+    reqfile = os.path.join(out_dir, f"{name}.ndjson")
+    with open(reqfile, "w", encoding="utf-8") as fh:
+        for raw in REQS:
+            fh.write(json.dumps(raw) + "\n")
+    report_path = os.path.join(out_dir, f"{name}.report.json")
+    argv = [
+        sys.executable, "-m", "mpi_openmp_cuda_tpu",
+        "--serve", "--input", reqfile, "--metrics-out", report_path,
+    ]
+    if board:
+        argv += ["--fleet-board", board]
+    if faults:
+        argv += ["--faults", faults]
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    env.setdefault("SEQALIGN_BACKOFF_BASE", "0.01")
+    env.update(env_extra or {})
+    proc = subprocess.run(
+        argv, cwd=REPO, env=env, capture_output=True, text=True, timeout=300
+    )
+    records = [
+        json.loads(line)
+        for line in proc.stdout.splitlines()
+        if line.strip()
+    ]
+    report = None
+    try:
+        with open(report_path, encoding="utf-8") as fh:
+            report = json.load(fh)
+    except (OSError, json.JSONDecodeError):
+        pass
+    return proc.returncode, records, report, proc.stderr
+
+
+def _reap(proc, log, timeout_s=60.0) -> int:
+    """Wait a worker out (the coordinator's shutdown beacon releases
+    it); SIGKILL as a last-resort backstop so the tier never hangs."""
+    try:
+        rc = proc.wait(timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        rc = proc.wait()
+    log.close()
+    return rc
+
+
+def _by_id(records):
+    """Per-request record transcripts, canonically serialized: the
+    byte-identical-to-clean-run comparison unit."""
+    out: dict = {}
+    for r in records:
+        out.setdefault(r.get("id"), []).append(json.dumps(r, sort_keys=True))
+    return out
+
+
+def _base_gates(name, rc, records, report, stderr, baseline, problems):
+    if rc != 0:
+        problems.append(f"{name}: coordinator exit code: want 0, got {rc}")
+        sys.stderr.write(stderr)
+    if "Traceback" in stderr:
+        problems.append(f"{name}: coordinator crashed (Traceback on stderr)")
+    if report is None:
+        problems.append(f"{name}: no readable run report")
+    else:
+        try:
+            validate_report(report)
+        except ValueError as e:
+            problems.append(f"{name}: {e}")
+        if report["gauges"].get("shed_state") != "accept":
+            problems.append(
+                f"{name}: fleet faults must not trip admission: want "
+                f"shed_state 'accept', got "
+                f"{report['gauges'].get('shed_state')!r}"
+            )
+    got = _by_id(records)
+    if got != baseline:
+        problems.append(
+            f"{name}: per-id records must be byte-identical to the clean "
+            f"fleetless run (exactly once, no loss, no doubles); "
+            f"want {baseline}, got {got}"
+        )
+
+
+def _counter_gates(name, report, wants, problems):
+    if report is None:
+        return
+    c = report.get("counters", {})
+    for counter, want in wants.items():
+        if c.get(counter, 0) < want:
+            problems.append(
+                f"{name}: counters.{counter}: want >= {want}, got "
+                f"{c.get(counter, 0)}"
+            )
+
+
+def baseline_run(out_dir, problems):
+    """The clean fleetless run every scenario's records must match."""
+    rc, records, report, stderr = _run_coordinator(out_dir, "baseline")
+    if rc != 0 or "Traceback" in stderr:
+        problems.append(f"baseline: clean run failed (rc {rc})")
+        sys.stderr.write(stderr)
+    base = _by_id(records)
+    answered = {r.get("id") for r in records if r.get("done")}
+    if answered != {"r1", "r2"}:
+        problems.append(
+            f"baseline: want r1+r2 done, got {sorted(answered)}"
+        )
+    return base
+
+
+def scenario_kill_worker(out_dir, baseline, problems):
+    """kill -9 the claiming worker mid-superblock; a late-joining
+    survivor scores the re-dispatched epoch.
+
+    Staging makes the race deterministic: the doomed worker is the ONLY
+    registered worker when the coordinator starts, so IT claims the
+    block and dies (``kill:fleet-worker`` fires at score entry, after
+    the claim).  The survivor is launched only after the corpse is
+    reaped; the generous lease gives it time to register before the
+    tick-counted membership declares the first worker dead and
+    re-dispatches."""
+    name = "kill-worker"
+    board = os.path.join(out_dir, f"{name}.board")
+    doomed, doomed_log = _spawn_worker(
+        out_dir, board, f"{name}-doomed",
+        faults="kill:fleet-worker:fail=1",
+    )
+    survivor = survivor_log = None
+    try:
+        if not _wait_registered(board, 1):
+            problems.append(f"{name}: doomed worker never registered")
+            return
+        import threading
+
+        def _relieve():
+            # The survivor enlists the moment the doomed worker's corpse
+            # is reaped — well inside the 8s lease the coordinator waits
+            # before declaring death and re-dispatching.
+            doomed.wait()
+            nonlocal survivor, survivor_log
+            survivor, survivor_log = _spawn_worker(
+                out_dir, board, f"{name}-survivor"
+            )
+
+        relief = threading.Thread(target=_relieve, daemon=True)
+        relief.start()
+        rc, records, report, stderr = _run_coordinator(
+            out_dir, name, board=board,
+            env_extra={
+                "SEQALIGN_LEASE_S": "8",
+                "SEQALIGN_FLEET_WORKERS": "2",
+            },
+        )
+        relief.join(timeout=30)
+    finally:
+        doomed_rc = _reap(doomed, doomed_log)
+        if survivor is not None:
+            _reap(survivor, survivor_log)
+    _base_gates(name, rc, records, report, stderr, baseline, problems)
+    if doomed_rc != -signal.SIGKILL:
+        problems.append(
+            f"{name}: doomed worker must die by SIGKILL, got rc {doomed_rc}"
+        )
+    _counter_gates(name, report, {
+        "fleet_joins": 2,
+        "fleet_deaths": 1,
+        "fleet_redispatches": 1,
+    }, problems)
+
+
+def scenario_zombie_fence(out_dir, baseline, problems):
+    """A worker scores, then freezes its heartbeats and outlives its
+    lease before posting: the coordinator has already declared it dead
+    and rescued the block, so the stale epoch-0 post lands on the board
+    but is FENCED — present as a file, absent from every reply."""
+    name = "zombie-fence"
+    board = os.path.join(out_dir, f"{name}.board")
+    zombie, zombie_log = _spawn_worker(
+        out_dir, board, name, faults="zombie:fleet-worker:fail=1",
+    )
+    try:
+        if not _wait_registered(board, 1):
+            problems.append(f"{name}: zombie worker never registered")
+            return
+        rc, records, report, stderr = _run_coordinator(
+            out_dir, name, board=board,
+            env_extra={
+                "SEQALIGN_LEASE_S": "1",
+                "SEQALIGN_FLEET_WORKERS": "1",
+            },
+        )
+    finally:
+        zombie_rc = _reap(zombie, zombie_log)
+    _base_gates(name, rc, records, report, stderr, baseline, problems)
+    if zombie_rc != 0:
+        problems.append(
+            f"{name}: the zombie must exit 0 after its stale post, got "
+            f"rc {zombie_rc}"
+        )
+    _counter_gates(name, report, {
+        "fleet_deaths": 1,
+        "fleet_redispatches": 1,
+    }, problems)
+    # The smoking gun: the stale epoch-0 result file IS on the board —
+    # and the byte-identical gate above already proved no client saw it.
+    stale = os.path.join(board, "seqalign", "fleet", "result", "b1", "e0")
+    if not os.path.exists(stale):
+        problems.append(
+            f"{name}: expected the zombie's stale e0 result on the board "
+            f"at {stale}"
+        )
+
+
+def scenario_torn_post(out_dir, baseline, problems):
+    """A torn half-written result reads as MISSING; lease expiry
+    re-dispatches and the bumped epoch scores clean."""
+    name = "torn-post"
+    board = os.path.join(out_dir, f"{name}.board")
+    worker, worker_log = _spawn_worker(
+        out_dir, board, name, faults="board:torn-post:fail=1",
+    )
+    try:
+        if not _wait_registered(board, 1):
+            problems.append(f"{name}: worker never registered")
+            return
+        rc, records, report, stderr = _run_coordinator(
+            out_dir, name, board=board,
+            env_extra={
+                "SEQALIGN_LEASE_S": "3",
+                "SEQALIGN_FLEET_WORKERS": "1",
+            },
+        )
+    finally:
+        worker_rc = _reap(worker, worker_log)
+    _base_gates(name, rc, records, report, stderr, baseline, problems)
+    if worker_rc != 0:
+        problems.append(f"{name}: worker must exit clean, got rc {worker_rc}")
+    _counter_gates(name, report, {
+        "fleet_lease_expiries": 1,
+        "fleet_redispatches": 1,
+    }, problems)
+
+
+def scenario_lease_stall(out_dir, baseline, problems):
+    """A worker claims and never scores; lease expiry re-dispatches and
+    the SAME worker completes the bumped epoch."""
+    name = "lease-stall"
+    board = os.path.join(out_dir, f"{name}.board")
+    worker, worker_log = _spawn_worker(
+        out_dir, board, name, faults="lease:stall:fail=1",
+    )
+    try:
+        if not _wait_registered(board, 1):
+            problems.append(f"{name}: worker never registered")
+            return
+        rc, records, report, stderr = _run_coordinator(
+            out_dir, name, board=board,
+            env_extra={
+                "SEQALIGN_LEASE_S": "3",
+                "SEQALIGN_FLEET_WORKERS": "1",
+            },
+        )
+    finally:
+        worker_rc = _reap(worker, worker_log)
+    _base_gates(name, rc, records, report, stderr, baseline, problems)
+    if worker_rc != 0:
+        problems.append(f"{name}: worker must exit clean, got rc {worker_rc}")
+    _counter_gates(name, report, {
+        "fleet_lease_expiries": 1,
+        "fleet_redispatches": 1,
+    }, problems)
+
+
+def scenario_usage(out_dir, problems):
+    """--fleet-worker without --fleet-board: hard exit 64."""
+    name = "usage"
+    proc = subprocess.run(
+        [sys.executable, "-m", "mpi_openmp_cuda_tpu", "--fleet-worker"],
+        cwd=REPO, env=dict(os.environ, JAX_PLATFORMS="cpu"),
+        capture_output=True, text=True, timeout=120,
+    )
+    if proc.returncode != 64:
+        problems.append(
+            f"{name}: --fleet-worker without --fleet-board: want exit "
+            f"64, got {proc.returncode}"
+        )
+    if "--fleet-board" not in proc.stderr:
+        problems.append(
+            f"{name}: stderr must name the missing flag, got: "
+            f"{proc.stderr.strip()[:200]}"
+        )
+
+
+def main() -> int:
+    out_dir = tempfile.mkdtemp(prefix="fleet_chaos_")
+    problems: list[str] = []
+    baseline = baseline_run(out_dir, problems)
+    if not problems:
+        scenario_kill_worker(out_dir, baseline, problems)
+        scenario_zombie_fence(out_dir, baseline, problems)
+        scenario_torn_post(out_dir, baseline, problems)
+        scenario_lease_stall(out_dir, baseline, problems)
+    scenario_usage(out_dir, problems)
+    if problems:
+        for p in problems:
+            print(f"fleet-chaos: FAIL: {p}")
+        return 1
+    print(
+        "fleet-chaos: OK (kill -9 redispatch, zombie fence, torn post, "
+        f"lease stall, usage gate; artifacts={out_dir})"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,530 @@
+"""Elastic serve fleet tests (ISSUE 11): FileBoard atomicity and claim
+races, torn-post reads, tick-counted membership and lease expiry, epoch
+fencing, and the coordinator/worker protocol driven end-to-end on an
+in-memory board with a fake clock — zero subprocesses, zero sleeps.
+
+The multi-process story (real ``--fleet-worker`` subprocesses, real
+SIGKILL) lives in ``scripts/fleet_chaos.py`` (``make fleet-chaos``);
+these tests pin the decision logic those scenarios rely on.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from mpi_openmp_cuda_tpu.obs import arm_observability, disarm_observability
+from mpi_openmp_cuda_tpu.resilience.membership import (
+    LeaseTable,
+    Membership,
+    board_read_json,
+    claim_key,
+    heartbeat_key,
+    offer_key,
+    result_key,
+    shutdown_key,
+    worker_key,
+)
+from mpi_openmp_cuda_tpu.resilience.rescue import FileBoard, MemoryBoard
+from mpi_openmp_cuda_tpu.serve.fleet import FleetCoordinator, FleetWorker
+
+
+class FakeClock:
+    """ServeClock stand-in: time moves only when a wait consumes it."""
+
+    def __init__(self):
+        self.t = 0.0
+
+    def now(self) -> float:
+        return self.t
+
+    def block_until(self, cond, predicate, timeout_s: float) -> bool:
+        self.t += max(0.0, float(timeout_s))
+        return predicate()
+
+
+class Block:
+    """The three superblock fields the fleet protocol reads."""
+
+    def __init__(self, n_rows: int = 2):
+        self.weights = [1, -3, -5, -2]
+        self.seq1_codes = np.arange(4, dtype=np.int8)
+        self.codes = [
+            np.full(3, i, dtype=np.int8) for i in range(n_rows)
+        ]
+
+
+class StubPipeline:
+    """Deterministic rows: row i scores (i, i, i) — enough to assert
+    the demuxed payload came from the worker, not the fallback."""
+
+    def dispatch(self, seq1, codes, weights, budget):
+        return len(codes)
+
+    def materialise(self, promise, seq1, codes, weights, budget):
+        return np.stack(
+            [np.full(3, i, dtype=np.int64) for i in range(promise)]
+        )
+
+
+class StubPolicy:
+    def new_budget(self):
+        return object()
+
+
+@pytest.fixture
+def obs_registry():
+    registry, _ = arm_observability(lambda: 0.0, lambda: 0.0)
+    yield registry
+    disarm_observability()
+
+
+def make_coordinator(board, clock, **kw):
+    kw.setdefault("lease_s", 5.0)
+    kw.setdefault("poll_s", 1.0)
+    collected, fallback = [], []
+    coord = FleetCoordinator(
+        board,
+        local_score=fallback.append,
+        demux=lambda rows, block: collected.append((rows, block)),
+        clock=clock,
+        **kw,
+    )
+    return coord, collected, fallback
+
+
+def tick(coord, clock, n: int = 1) -> None:
+    """Advance wall time past the poll interval and pump: one call ==
+    one membership/lease tick, exactly the coordinator's real cadence."""
+    for _ in range(n):
+        clock.t += coord.poll_s
+        coord.pump()
+
+
+def enlist(board, wid: str, beat: int = 1) -> None:
+    """Register a (simulated) worker and give it a heartbeat value."""
+    board.post(worker_key(wid), json.dumps({"wid": wid, "pid": 1}))
+    board.post(heartbeat_key(wid), str(beat))
+
+
+def make_worker(board, wid: str) -> FleetWorker:
+    worker = FleetWorker(board, StubPipeline(), StubPolicy(), FakeClock())
+    worker.wid = wid  # distinct ids within one test process
+    return worker
+
+
+# -- FileBoard ---------------------------------------------------------------
+
+
+def test_fileboard_post_get_delete_roundtrip(tmp_path):
+    board = FileBoard(str(tmp_path / "board"))
+    assert board.get("seqalign/fleet/x") is None
+    board.post("seqalign/fleet/x", "hello")
+    assert board.get("seqalign/fleet/x") == "hello"
+    board.post("seqalign/fleet/x", "rewritten")  # post overwrites
+    assert board.get("seqalign/fleet/x") == "rewritten"
+    board.delete("seqalign/fleet/x")
+    assert board.get("seqalign/fleet/x") is None
+    board.delete("seqalign/fleet/x")  # deleting a missing key: no-op
+
+
+def test_fileboard_zero_length_reads_as_missing(tmp_path):
+    board = FileBoard(str(tmp_path / "board"))
+    board.post("k", "")
+    assert board.get("k") is None
+
+
+def test_fileboard_claim_exactly_one_winner(tmp_path):
+    board = FileBoard(str(tmp_path / "board"))
+    assert board.claim("claim/b1/e0", "first") is True
+    assert board.claim("claim/b1/e0", "second") is False
+    # The loser's attempt must not clobber the winner's value.
+    assert board.get("claim/b1/e0") == "first"
+
+
+def test_fileboard_keys_skip_tmp_files(tmp_path):
+    root = tmp_path / "board"
+    board = FileBoard(str(root))
+    board.post("fleet/worker/w1", "a")
+    board.post("fleet/worker/w2", "b")
+    board.post("fleet/other", "c")
+    # A writer killed mid-post leaves a tmp file behind: never a key.
+    (root / "fleet" / "worker" / ".tmp.w3.999").write_text("torn")
+    assert board.keys("fleet/worker/") == [
+        "fleet/worker/w1", "fleet/worker/w2",
+    ]
+    assert board.keys("") == [
+        "fleet/other", "fleet/worker/w1", "fleet/worker/w2",
+    ]
+
+
+def test_fileboard_keys_never_escape_root(tmp_path):
+    root = tmp_path / "board"
+    board = FileBoard(str(root))
+    (tmp_path / "outside").write_text("secret")
+    board.post("../outside", "overwrite-attempt")
+    # Traversal parts are dropped: the write landed INSIDE the root and
+    # the file outside is untouched.
+    assert (tmp_path / "outside").read_text() == "secret"
+    assert board.get("outside") == "overwrite-attempt"
+
+
+# -- torn posts read as missing ----------------------------------------------
+
+
+@pytest.mark.parametrize("raw", [
+    None,  # absent
+    "",  # zero-length
+    "   ",  # whitespace
+    '{"bid": "b1", "epo',  # torn mid-write
+    "[1, 2, 3]",  # not an object
+    "42",
+])
+def test_board_read_json_torn_posts_read_as_missing(raw):
+    board = MemoryBoard()
+    if raw is not None:
+        board.post("k", raw)
+    assert board_read_json(board, "k") is None
+
+
+def test_board_read_json_whole_post():
+    board = MemoryBoard()
+    board.post("k", '{"bid": "b1", "epoch": 0}')
+    assert board_read_json(board, "k") == {"bid": "b1", "epoch": 0}
+
+
+# -- membership --------------------------------------------------------------
+
+
+def test_membership_join_then_heartbeat_death():
+    board = MemoryBoard()
+    members = Membership(board, deadline_ticks=3)
+    enlist(board, "w1")
+    joined, died = members.observe(1)
+    assert joined == ["w1"] and died == []
+    assert members.is_live("w1") and members.live() == ["w1"]
+    # Beats frozen from tick 1: death lands exactly deadline_ticks later.
+    _, died = members.observe(2)
+    assert died == []
+    _, died = members.observe(3)
+    assert died == []
+    _, died = members.observe(4)
+    assert died == ["w1"]
+    assert not members.is_live("w1") and members.live_count() == 0
+
+
+def test_membership_changing_beat_defers_death():
+    board = MemoryBoard()
+    members = Membership(board, deadline_ticks=2)
+    enlist(board, "w1", beat=1)
+    members.observe(1)
+    for t in range(2, 8):
+        board.post(heartbeat_key("w1"), str(t))  # beat keeps changing
+        _, died = members.observe(t)
+        assert died == []
+    assert members.is_live("w1")
+
+
+def test_membership_death_is_terminal():
+    board = MemoryBoard()
+    members = Membership(board, deadline_ticks=2)
+    enlist(board, "w1")
+    members.observe(1)
+    _, died = members.observe(3)
+    assert died == ["w1"]
+    # A zombie's heartbeat resuming after the verdict changes nothing:
+    # its leases were already re-dispatched.
+    board.post(heartbeat_key("w1"), "999")
+    joined, died = members.observe(4)
+    assert joined == [] and died == []
+    assert not members.is_live("w1")
+
+
+def test_membership_torn_registration_is_not_a_member():
+    board = MemoryBoard()
+    members = Membership(board, deadline_ticks=2)
+    board.post(worker_key("w1"), '{"wid": "w')  # killed mid-register
+    joined, _ = members.observe(1)
+    assert joined == []
+    enlist(board, "w1")  # the retry lands whole
+    joined, _ = members.observe(2)
+    assert joined == ["w1"]
+
+
+# -- leases ------------------------------------------------------------------
+
+
+def test_lease_epoch_fencing():
+    leases = LeaseTable(lease_ticks=3)
+    leases.issue("b1", tick=0)
+    assert leases.admits("b1", 0)
+    assert not leases.admits("b1", 1)
+    leases.note_claim("b1", "w1", tick=1)
+    assert leases.get("b1").holder == "w1"
+    # The re-dispatch bump: the zombie's epoch-0 post is now fenced.
+    assert leases.bump("b1", tick=2) == 1
+    assert not leases.admits("b1", 0)
+    assert leases.admits("b1", 1)
+    assert leases.get("b1").holder is None
+    leases.retire("b1")
+    assert not leases.admits("b1", 1)  # retired blocks admit nothing
+    with pytest.raises(KeyError):
+        leases.get("b1")
+
+
+def test_lease_duplicate_issue_rejected():
+    leases = LeaseTable(lease_ticks=2)
+    leases.issue("b1", tick=0)
+    with pytest.raises(ValueError, match="already issued"):
+        leases.issue("b1", tick=1)
+
+
+def test_lease_expiry_clock_restarts_on_claim_and_bump():
+    leases = LeaseTable(lease_ticks=3)
+    leases.issue("b1", tick=0)
+    assert leases.expired(2) == []
+    assert [lease.bid for lease in leases.expired(3)] == ["b1"]
+    leases.note_claim("b1", "w1", tick=3)  # claim restarts the clock
+    assert leases.expired(5) == []
+    assert [lease.bid for lease in leases.expired(6)] == ["b1"]
+    leases.bump("b1", tick=6)  # so does the re-dispatch bump
+    assert leases.expired(8) == []
+    assert [lease.bid for lease in leases.expired(9)] == ["b1"]
+
+
+# -- coordinator x worker (in-memory board, fake clock) ----------------------
+
+
+def test_coordinator_offer_claim_score_collect(obs_registry):
+    board = MemoryBoard()
+    clock = FakeClock()
+    coord, collected, fallback = make_coordinator(board, clock)
+    assert not coord.accepting()  # no workers: the loop scores locally
+    worker = make_worker(board, "wa")
+    worker.register()
+    worker.heartbeat()
+    tick(coord, clock)
+    assert coord.accepting()
+    block = Block(n_rows=2)
+    bid = coord.offer(block)
+    assert board_read_json(board, offer_key(bid))["epoch"] == 0
+    assert coord.outstanding() == 1
+    assert worker.step() is True  # claim + score + post
+    tick(coord, clock)
+    assert coord.outstanding() == 0
+    assert fallback == []
+    [(rows, got_block)] = collected
+    assert got_block is block
+    np.testing.assert_array_equal(
+        rows, np.array([[0, 0, 0], [1, 1, 1]], dtype=np.int64)
+    )
+    assert board.get(offer_key(bid)) is None  # offer cleaned off the board
+    assert obs_registry.counters["fleet_joins"] == 1
+    assert obs_registry.gauges["fleet_workers"] == 1
+
+
+def test_two_workers_race_exactly_one_wins():
+    board = MemoryBoard()
+    clock = FakeClock()
+    coord, collected, _ = make_coordinator(board, clock)
+    wa, wb = make_worker(board, "wa"), make_worker(board, "wb")
+    for worker in (wa, wb):
+        worker.register()
+        worker.heartbeat()
+    tick(coord, clock)
+    bid = coord.offer(Block())
+    assert wa.step() is True  # first scan wins the claim...
+    assert wb.step() is False  # ...the loser backs off without posting
+    assert json.loads(board.get(claim_key(bid, 0)))["wid"] == "wa"
+    tick(coord, clock)
+    assert len(collected) == 1
+
+
+def test_dead_worker_superblocks_redispatch_to_survivor(obs_registry):
+    board = MemoryBoard()
+    clock = FakeClock()
+    coord, collected, fallback = make_coordinator(board, clock)
+    enlist(board, "doomed")
+    tick(coord, clock)
+    bid = coord.offer(Block())
+    # The doomed worker claims, then goes silent without posting.
+    board.claim(claim_key(bid, 0), json.dumps({"wid": "doomed"}))
+    tick(coord, clock)  # coordinator notes the claim
+    assert coord.leases.get(bid).holder == "doomed"
+    survivor = make_worker(board, "survivor")
+    survivor.register()
+    survivor.heartbeat()
+    tick(coord, clock, n=coord.lease_ticks)  # beats frozen -> verdict
+    assert obs_registry.counters["fleet_deaths"] == 1
+    assert obs_registry.counters["fleet_redispatches"] == 1
+    offer = board_read_json(board, offer_key(bid))
+    assert offer["epoch"] == 1  # re-offered at the bumped epoch
+    assert survivor.step() is True
+    tick(coord, clock)
+    assert len(collected) == 1 and fallback == []
+    assert coord.outstanding() == 0
+
+
+def test_all_workers_dead_falls_back_to_local_scoring(obs_registry):
+    board = MemoryBoard()
+    clock = FakeClock()
+    coord, collected, fallback = make_coordinator(board, clock)
+    enlist(board, "w1")
+    tick(coord, clock)
+    block = Block()
+    bid = coord.offer(block)
+    board.claim(claim_key(bid, 0), json.dumps({"wid": "w1"}))
+    tick(coord, clock, n=1 + coord.lease_ticks)  # silence -> death
+    assert obs_registry.counters["fleet_deaths"] == 1
+    # No survivor to re-offer to: the coordinator scores it itself.
+    assert fallback == [block] and collected == []
+    assert coord.outstanding() == 0
+    assert not coord.accepting()
+    assert obs_registry.gauges["fleet_workers"] == 0
+
+
+def test_lease_expiry_without_claim_redispatches(obs_registry):
+    board = MemoryBoard()
+    clock = FakeClock()
+    coord, _, _ = make_coordinator(board, clock)
+    enlist(board, "w1")
+    tick(coord, clock)
+    bid = coord.offer(Block())
+    # The worker stays alive (beats change) but never claims: only the
+    # lease deadline — not a death verdict — re-dispatches.
+    for t in range(coord.lease_ticks + 1):
+        board.post(heartbeat_key("w1"), str(10 + t))
+        tick(coord, clock)
+    assert obs_registry.counters["fleet_lease_expiries"] == 1
+    assert obs_registry.counters.get("fleet_deaths", 0) == 0
+    assert board_read_json(board, offer_key(bid))["epoch"] == 1
+
+
+def test_zombie_stale_epoch_post_is_fenced_never_demuxed(obs_registry):
+    board = MemoryBoard()
+    clock = FakeClock()
+    coord, collected, _ = make_coordinator(board, clock)
+    enlist(board, "zombie")
+    tick(coord, clock)
+    block = Block(n_rows=1)
+    bid = coord.offer(block)
+    board.claim(claim_key(bid, 0), json.dumps({"wid": "zombie"}))
+    tick(coord, clock)
+    enlist(board, "fresh")  # the survivor that will score epoch 1
+    tick(coord, clock, n=coord.lease_ticks)  # zombie declared dead
+    assert board_read_json(board, offer_key(bid))["epoch"] == 1
+    # The zombie posts its STALE epoch-0 result — well-formed rows, the
+    # right block, just the wrong epoch.  Fenced: counted, not demuxed.
+    board.post(result_key(bid, 0), json.dumps({
+        "bid": bid, "epoch": 0, "wid": "zombie", "rows": [[9, 9, 9]],
+    }))
+    board.post(heartbeat_key("fresh"), "2")
+    tick(coord, clock)
+    assert collected == []
+    assert coord.outstanding() == 1
+    assert obs_registry.counters["fleet_fenced_posts"] == 1
+    # The current-epoch post answers; the fence event stays counted once.
+    board.post(result_key(bid, 1), json.dumps({
+        "bid": bid, "epoch": 1, "wid": "fresh", "rows": [[1, 2, 3]],
+    }))
+    board.post(heartbeat_key("fresh"), "3")
+    tick(coord, clock)
+    [(rows, _)] = collected
+    np.testing.assert_array_equal(rows, [[1, 2, 3]])
+    tick(coord, clock)
+    assert obs_registry.counters["fleet_fenced_posts"] == 1
+
+
+def test_malformed_result_rows_read_as_missing():
+    board = MemoryBoard()
+    clock = FakeClock()
+    coord, collected, _ = make_coordinator(board, clock)
+    enlist(board, "w1")
+    tick(coord, clock)
+    bid = coord.offer(Block(n_rows=2))
+    for bad in (
+        {"bid": bid, "epoch": 0, "rows": [[1, 2, 3]]},  # wrong shape
+        {"bid": bid, "epoch": 0, "rows": "garbage"},
+        {"bid": bid, "epoch": "x", "rows": [[1, 2, 3], [4, 5, 6]]},
+    ):
+        board.post(result_key(bid, 0), json.dumps(bad))
+        board.post(heartbeat_key("w1"), str(id(bad)))
+        tick(coord, clock)
+        assert collected == [] and coord.outstanding() == 1
+
+
+def test_finish_locally_drains_and_fences_outstanding_blocks():
+    board = MemoryBoard()
+    clock = FakeClock()
+    coord, collected, fallback = make_coordinator(board, clock)
+    enlist(board, "w1")
+    tick(coord, clock)
+    blocks = [Block(), Block()]
+    bids = [coord.offer(b) for b in blocks]
+    coord.finish_locally()
+    assert fallback == blocks and collected == []
+    assert coord.outstanding() == 0
+    for bid in bids:
+        assert board.get(offer_key(bid)) is None
+        assert not coord.leases.admits(bid, 0)  # stragglers land fenced
+
+
+def test_join_mid_serve_flips_accepting():
+    board = MemoryBoard()
+    clock = FakeClock()
+    coord, collected, _ = make_coordinator(board, clock)
+    tick(coord, clock)
+    assert not coord.accepting()
+    late = make_worker(board, "late")
+    late.register()
+    late.heartbeat()
+    tick(coord, clock)
+    assert coord.accepting()  # the next planned block goes to the fleet
+    coord.offer(Block(n_rows=1))
+    assert late.step() is True
+    tick(coord, clock)
+    assert len(collected) == 1
+
+
+# -- worker loop edges -------------------------------------------------------
+
+
+def test_worker_skips_torn_offers_and_foreign_claims():
+    board = MemoryBoard()
+    worker = make_worker(board, "wa")
+    board.post(offer_key("b1"), '{"bid": "b1", "ep')  # torn offer
+    assert worker.step() is False
+    board.post(offer_key("b1"), json.dumps({
+        "bid": "b1", "epoch": 0, "weights": [1, -3, -5, -2],
+        "seq1": [0, 1], "rows": [[1, 2]],
+    }))
+    board.claim(claim_key("b1", 0), json.dumps({"wid": "other"}))
+    assert worker.step() is False  # someone else holds this epoch
+    assert board.get(result_key("b1", 0)) is None
+
+
+def test_worker_exits_on_coordinator_shutdown_key():
+    board = MemoryBoard()
+    worker = make_worker(board, "wa")
+    assert worker.should_exit() is False
+    board.post(shutdown_key(), "shutdown")
+    assert worker.should_exit() is True
+
+
+def test_worker_scoring_failure_leaves_redispatch_to_lease(capsys):
+    class SickPipeline(StubPipeline):
+        def materialise(self, *a, **k):
+            raise RuntimeError("boom")
+
+    board = MemoryBoard()
+    worker = FleetWorker(board, SickPipeline(), StubPolicy(), FakeClock())
+    board.post(offer_key("b1"), json.dumps({
+        "bid": "b1", "epoch": 0, "weights": [1, -3, -5, -2],
+        "seq1": [0, 1], "rows": [[1, 2]],
+    }))
+    assert worker.step() is True  # the claim was attempted...
+    assert board.get(result_key("b1", 0)) is None  # ...but nothing posted
+    assert "leaving it to lease re-dispatch" in capsys.readouterr().err

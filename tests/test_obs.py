@@ -114,6 +114,11 @@ def test_record_event_counter_catalogue():
     reg.record_event("watchdog.guard", {"state": "disarmed"})
     reg.record_event("rescue.beacon_miss", {"worker": 2})
     reg.record_event("rescue.orphans", {"count": 7})
+    reg.record_event("worker.join", {"worker": "w1", "workers": 2})
+    reg.record_event("worker.dead", {"worker": "w1", "workers": 1})
+    reg.record_event("lease.expired", {"block": "b1", "epoch": 0})
+    reg.record_event("lease.fenced", {"block": "b1", "epoch": 0})
+    reg.record_event("fleet.redispatch", {"block": "b1", "epoch": 1})
     reg.record_event("mystery", {})
     assert reg.counters == {
         "retry_attempts": 1,
@@ -128,8 +133,16 @@ def test_record_event_counter_catalogue():
         "guard_disarms": 1,
         "beacon_misses": 1,
         "rescued_sequences": 7,
+        "fleet_joins": 1,
+        "fleet_deaths": 1,
+        "fleet_lease_expiries": 1,
+        "fleet_fenced_posts": 1,
+        "fleet_redispatches": 1,
         "events.mystery": 1,
     }
+    # The membership events also drive the live-worker gauge (the
+    # heartbeat's coordinator-only ` fleet=N` suffix).
+    assert reg.gauges["fleet_workers"] == 1
     assert reg.histograms["backoff_delay_s"] == {
         "count": 1, "sum": 0.5, "min": 0.5, "max": 0.5,
         "buckets": {
@@ -394,6 +407,16 @@ def test_heartbeat_line_golden():
         "counters": {"degrade_transitions": 1},
         "gauges": {},
     }) == "[obs] chunk 0/? retries=0 degraded=yes"
+
+
+def test_heartbeat_line_fleet_suffix_coordinator_only():
+    # The fleet_workers gauge exists only under --fleet-board: batch and
+    # plain-serve heartbeats (the goldens above) stay byte-identical,
+    # while a coordinator's line carries the live-worker count.
+    assert obs_export.heartbeat_line({
+        "counters": {},
+        "gauges": {"queue_depth": 2, "fleet_workers": 3},
+    }) == "[obs] chunk 0/? retries=0 degraded=no queue=2 fleet=3"
 
 
 def test_heartbeat_callback_reads_armed_registry():

@@ -522,3 +522,71 @@ def test_kill_mid_stream_then_resume_byte_identical(tmp_path):
     )
     assert resumed.returncode == 0, resumed.stderr
     assert resumed.stdout == clean.stdout
+
+
+def _serve_records_by_id(stdout: str) -> dict:
+    out: dict = {}
+    for line in stdout.splitlines():
+        if line.strip():
+            out.setdefault(json.loads(line).get("id"), []).append(line)
+    return out
+
+
+@pytest.mark.slow
+@pytest.mark.chaos_kill
+def test_kill_serve_tick_resume_loses_and_doubles_nothing(tmp_path):
+    # SIGKILL entering the THIRD serve tick (after=2): with MAX_POP=1
+    # one request completes per tick, so r1+r2 answered and flushed,
+    # and the live serve journal (checkpoint B of tick 2) holds exactly
+    # the unanswered r3+r4.  The --resume rerun answers exactly those:
+    # the union is every request once, per-id byte-identical to a clean
+    # run — kill -9 at a tick boundary loses nothing, doubles nothing.
+    from test_cli import REPO
+
+    from mpi_openmp_cuda_tpu.serve.session import load_drained
+
+    reqs = [
+        {
+            "id": f"r{i}",
+            "weights": [1, -3, -5, -2],
+            "seq1": "ACGTACGTACGTACGT",
+            "seq2": ["ACGT", "GATTACA"],
+        }
+        for i in range(1, 5)
+    ]
+    reqfile = str(tmp_path / "reqs.ndjson")
+    with open(reqfile, "w") as f:
+        for raw in reqs:
+            f.write(json.dumps(raw) + "\n")
+    empty = str(tmp_path / "empty.ndjson")
+    open(empty, "w").close()
+    env = _kill_env()
+    env["SEQALIGN_SERVE_MAX_POP"] = "1"
+    journal = str(tmp_path / "serve.jsonl")
+
+    def serve(*args):
+        return subprocess.run(
+            [sys.executable, "-m", "mpi_openmp_cuda_tpu", "--serve", *args],
+            capture_output=True, text=True, env=env, cwd=REPO,
+        )
+
+    clean = serve("--input", reqfile)
+    assert clean.returncode == 0, clean.stderr
+    want = _serve_records_by_id(clean.stdout)
+    assert set(want) == {"r1", "r2", "r3", "r4"}
+
+    killed = serve(
+        "--input", reqfile, "--journal", journal,
+        "--faults", "kill:serve-tick:fail=1,after=2",
+    )
+    assert killed.returncode == -signal.SIGKILL  # really killed, no unwind
+    first = _serve_records_by_id(killed.stdout)
+    assert set(first) == {"r1", "r2"}  # flushed before the kill
+    assert [r["id"] for r in load_drained(journal)] == ["r3", "r4"]
+
+    resumed = serve("--input", empty, "--journal", journal, "--resume")
+    assert resumed.returncode == 0, resumed.stderr
+    second = _serve_records_by_id(resumed.stdout)
+    assert set(second) == {"r3", "r4"}  # no double-answers on resume
+    assert {**first, **second} == want  # exactly once, byte-identical
+    assert load_drained(journal) == []  # clean completion empties it

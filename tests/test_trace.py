@@ -382,6 +382,27 @@ def test_flightrec_breaker_open_triggers_dump(monkeypatch, tmp_path):
     ]
 
 
+def test_flightrec_worker_dead_triggers_dump(monkeypatch, tmp_path):
+    # A fleet death verdict is an incident: the tape leading up to it
+    # (joins, offers, expiries) dumps exactly like a breaker open.
+    _redirect_flightrec_dumps(monkeypatch, tmp_path)
+    arm_observability(flightrec_depth=8)
+    events.publish("worker.join", worker="w1", workers=1)
+    events.publish("worker.dead", worker="w1", workers=0)
+    rec = flightrec.active_flightrec()
+    assert rec is not None
+    assert len(rec.dump_paths) == 1
+    name = os.path.basename(rec.dump_paths[0])
+    assert name.endswith("-worker-dead.json")
+    data = json.loads(pathlib.Path(rec.dump_paths[0]).read_text())
+    validate_report(data)
+    assert data["reason"] == "worker-dead"
+    assert [e["name"] for e in data["events"]] == [
+        "worker.join",
+        "worker.dead",
+    ]
+
+
 def test_dump_active_disarmed_is_noop():
     assert flightrec.active_flightrec() is None
     assert flightrec.dump_active("sigusr2") is None
