@@ -18,7 +18,7 @@ PY_CFLAGS  := $(shell $(PYCONFIG) --includes)
 PY_LDFLAGS := $(shell $(PYCONFIG) --ldflags --embed)
 INPUT      ?= /root/reference/input5.txt
 
-.PHONY: build run run2 runOn2 test chaos chaos-kill analyze schedule-audit metrics-smoke serve-smoke serve-chaos fleet-chaos aot-smoke trace-smoke bench bench-table bench-gather check clean
+.PHONY: build run run2 runOn2 test chaos chaos-kill analyze schedule-audit concurrency-audit metrics-smoke serve-smoke serve-chaos fleet-chaos aot-smoke trace-smoke bench bench-table bench-gather check clean
 
 build: final
 
@@ -106,6 +106,16 @@ analyze:
 # with scripts/schedule_audit.py --update).  CPU-only, zero devices.
 schedule-audit:
 	JAX_PLATFORMS=cpu $(PYTHON) scripts/schedule_audit.py
+
+# Concurrency gate (docs/ARCHITECTURE.md §9): the whole-program
+# lock-graph audit (ordering cycles, blocking ops under serve/obs
+# locks, cross-class acquire/release) plus the exhaustive interleaving
+# explorer running the REAL fleet-protocol state machines to a depth
+# bound, diffed against the committed golden
+# (tests/golden/concurrency_audit.json; regenerate deliberately with
+# scripts/concurrency_audit.py --update).  CPU-only, a few seconds.
+concurrency-audit:
+	JAX_PLATFORMS=cpu $(PYTHON) scripts/concurrency_audit.py
 
 # Observability smoke gate (docs/ARCHITECTURE.md §10): one CLI run on
 # the tiny fixture with --metrics --metrics-out, then schema-validate

@@ -30,6 +30,15 @@ runnable on CPU-only CI (``make analyze``):
   points and schedule bodies: un-donated large buffers on the chunk
   pipeline, implicit host transfers / ``convert`` widenings in hot
   paths, and the executables-per-schedule static launch count.
+* :mod:`.lockgraph` — a whole-program lock-graph audit: every lock
+  acquisition site plus the intra-package call graph, failing on
+  lock-order cycles, blocking operations reachable while a serve-plane
+  or obs lock is held, and cross-class acquire/release splits.
+* :mod:`.interleave` — a small-scope model checker that runs the REAL
+  fleet-protocol state machines (``Membership``, ``LeaseTable``,
+  ``RequestQueue``, ``FleetCoordinator``) under a virtual scheduler,
+  exhaustively enumerating sleep-set-pruned interleavings to a depth
+  bound and asserting the §8.6 protocol invariants on every schedule.
 
 Everything raises a :class:`SeqcheckError` subclass with a message
 naming the violated bound and the fix, so a CI failure is actionable
@@ -100,6 +109,21 @@ class ScheduleDriftError(SeqcheckError):
     --update) or fix the regression."""
 
 
+class LockGraphError(SeqcheckError):
+    """The whole-program lock-graph audit (analysis/lockgraph.py) found
+    a lock-order cycle, a blocking operation reachable while a
+    serve-plane/obs lock is held, or a lock acquired and released by
+    different classes."""
+
+
+class InterleaveViolation(SeqcheckError):
+    """The interleaving explorer (analysis/interleave.py) found a
+    schedule that violates a fleet-protocol invariant (double demux,
+    fenced-epoch post admitted, dead-worker resurrection, dropped
+    reply).  The message carries the exact event schedule so the
+    counterexample replays deterministically."""
+
+
 __all__ = [
     "SeqcheckError",
     "ContractViolation",
@@ -112,4 +136,6 @@ __all__ = [
     "CostModelError",
     "TraceAuditError",
     "ScheduleDriftError",
+    "LockGraphError",
+    "InterleaveViolation",
 ]

@@ -1,0 +1,583 @@
+"""Small-scope interleaving explorer (``interleave``) — pillar four of
+the analysis plane.
+
+The fleet protocol's safety net (ARCHITECTURE §8.6) was *tested* by
+chaos tiers that sample a handful of schedules.  This module makes the
+matrix machine-checked: it runs the **real** protocol state machines —
+:class:`~..resilience.membership.Membership`,
+:class:`~..resilience.membership.LeaseTable` (``admits`` is the one
+acceptance predicate), :class:`~..serve.fleet.FleetCoordinator` over a
+real :class:`~..resilience.rescue.MemoryBoard`, and the real
+:class:`~..serve.queue.RequestQueue` — under a virtual scheduler that
+**exhaustively enumerates every interleaving of protocol events up to a
+depth bound**, sleep-set pruned (classic DPOR: a pruned schedule is
+Mazurkiewicz-equivalent to an explored one, so safety verdicts are
+unaffected).
+
+Event alphabet (the §8.6 failure matrix, one event per row):
+
+* ``tick`` — one coordinator board poll (``FleetCoordinator.pump``):
+  membership observe (join/death verdicts), stale-post fencing, result
+  collection/demux, lease expiry → re-dispatch.  **Worker death** is
+  heartbeat silence — exactly as in production, a SIGKILLed worker is
+  indistinguishable from one the scheduler never runs again, so every
+  schedule that stops beating a worker explores its death; **lease
+  expiry** is ticks elapsing with a lease outstanding (the fencing
+  scenario pins ``lease_ticks=1`` so expiry is reachable inside the
+  depth bound).
+* ``w<i>.beat`` — one heartbeat post (liveness proof).
+* ``w<i>.claim`` — scan the offer, race ``board.claim`` on the
+  epoch-stamped claim key (exactly-one-winner is asserted).
+* ``w<i>.post`` — post the scored result under the claimed epoch.
+* ``w<i>.stale`` — the adversarial zombie probe: re-post previously
+  scored rows at the CURRENT offer's result key but carrying the stale
+  claimed epoch in the payload — the buggy-writer shape
+  ``LeaseTable.admits`` exists to fence.  A coordinator that admits
+  without the epoch check demuxes it; the invariant catches that (the
+  seeded-bug test in tests/test_interleave.py proves it).
+
+Invariants, checked after every transition and at quiescence:
+
+1. **each offer demuxed exactly once** — never two completions (demux
+   or local fallback) for one block id;
+2. **a fenced epoch's post is never admitted** — every demuxed row set
+   carries exactly the newest epoch ever offered for its block;
+3. **a dead worker is never resurrected** — once membership's verdict
+   lands, a resumed heartbeat must not flip the worker live again;
+4. **no reply is dropped** — from every reachable state, freezing the
+   workers and pumping the coordinator drains every outstanding block
+   (re-dispatch or local fallback) within a bounded number of ticks.
+
+State is never copied: the explorer replays each event prefix from a
+fresh scenario (stateless-replay DFS), so the real classes run with
+their real mutation paths and no deepcopy aliasing.  Everything is
+deterministic — virtual clock, fixed event order, no randomness — so
+the explored-schedule counts are pinned byte-exact in the committed
+``concurrency-audit`` golden.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import io
+import json
+
+from ..resilience.membership import (
+    board_read_json,
+    claim_key,
+    heartbeat_key,
+    offer_key,
+    result_key,
+    worker_key,
+)
+from ..resilience.rescue import MemoryBoard
+from ..serve.fleet import FleetCoordinator
+from ..serve.queue import ADMIT_CLOSED, ADMIT_OK, RequestQueue
+from . import InterleaveViolation
+
+#: Quiescence bound: ticks allowed to drain all outstanding blocks once
+#: workers freeze.  Death verdicts take ``deadline_ticks`` and expiry
+#: ``lease_ticks`` — far below this; hitting the bound IS the
+#: dropped-reply violation.
+_QUIESCE_TICKS = 50
+
+
+class VirtualClock:
+    """The explorer's ServeClock stand-in: ``now()`` jumps a full poll
+    interval per read (every ``pump`` polls — one pump == one tick) and
+    ``block_until`` evaluates its predicate exactly once, immediately
+    (single-threaded exploration never actually waits)."""
+
+    def __init__(self):
+        self._t = 0.0
+
+    def now(self) -> float:
+        self._t += 10.0
+        return self._t
+
+    def block_until(self, cond, predicate, timeout_s) -> bool:
+        return bool(predicate())
+
+
+class _Recorder:
+    """Coordinator callbacks: where demuxed / locally-scored blocks
+    land, in completion order."""
+
+    def __init__(self):
+        self.demuxed = []  # (block label, rows) in demux order
+        self.local = []  # block labels completed via local fallback
+
+    def demux(self, rows, block):
+        self.demuxed.append((block.label, rows))
+
+    def local_score(self, block):
+        self.local.append(block.label)
+
+
+class _ModelBlock:
+    """The minimal superblock the coordinator's offer path can post:
+    one row, so worker results are shape ``(1, 3)`` int64 and carry
+    ``(worker idx, epoch, 0)`` as verifiable provenance."""
+
+    def __init__(self):
+        self.label = "?"
+        self.weights = [1]
+        self.seq1_codes = [1]
+        self.codes = [[1]]
+
+
+class _ModelWorker:
+    """One worker's local state.  The board verbs and the key schema
+    are the REAL ones (resilience/membership.py) — only the scoring is
+    modelled (provenance rows instead of an alignment)."""
+
+    def __init__(self, idx: int):
+        self.idx = idx
+        self.wid = f"mw{idx}"
+        self.beats = 0
+        self.claimed: dict[str, int] = {}  # bid -> claimed epoch
+
+
+class _FleetState:
+    """One replay's world: the real board/coordinator plus the
+    invariant-checking ledgers."""
+
+    def __init__(self):
+        self.board = None
+        self.coord = None
+        self.workers = []
+        self.recorder = None
+        self.bids = []
+        self.ledger = {}  # bid -> newest epoch ever offered
+        self.seen_dead = set()
+        self.winners = {}  # (bid, epoch) -> wid
+        self.checked = 0  # demux records already invariant-checked
+
+
+class FleetScenario:
+    """The lease/epoch protocol under exploration."""
+
+    def __init__(self, name: str, *, workers: int = 2, stale: bool = False,
+                 lease_ticks: int | None = None,
+                 seed_admit_bug: bool = False):
+        self.name = name
+        self.n_workers = int(workers)
+        self.stale = bool(stale)
+        self.lease_ticks = lease_ticks
+        self.seed_admit_bug = bool(seed_admit_bug)
+        self.invariants = (
+            "demux-exactly-once",
+            "fenced-epoch-never-admitted",
+            "dead-worker-never-resurrected",
+            "no-reply-dropped",
+        )
+
+    # -- world construction ------------------------------------------------
+
+    def fresh(self) -> _FleetState:
+        state = _FleetState()
+        state.board = MemoryBoard()
+        state.recorder = _Recorder()
+        coord = FleetCoordinator(
+            state.board,
+            local_score=state.recorder.local_score,
+            demux=state.recorder.demux,
+            clock=VirtualClock(),
+            lease_s=2.0,
+            poll_s=1.0,  # lease_ticks = deadline_ticks = 2
+        )
+        if self.lease_ticks is not None:
+            coord.leases.lease_ticks = int(self.lease_ticks)
+        state.coord = coord
+        state.workers = [_ModelWorker(i) for i in range(self.n_workers)]
+        for w in state.workers:
+            state.board.post(worker_key(w.wid), json.dumps({"wid": w.wid}))
+            w.beats = 1
+            state.board.post(heartbeat_key(w.wid), str(w.beats))
+        coord.pump(idle=True)  # tick 1: every worker joins
+        block = _ModelBlock()
+        bid = coord.offer(block)
+        block.label = bid
+        state.bids = [bid]
+        state.ledger = {bid: 0}
+        if self.seed_admit_bug:
+            # The seeded fencing bug the acceptance criteria demand: an
+            # admit that ignores the epoch.  Instance-attribute override
+            # of the REAL predicate — everything else runs unmodified.
+            leases = coord.leases
+            coord.leases.admits = (
+                lambda bid, epoch, _t=leases: bid in _t._leases
+            )
+        return state
+
+    # -- the event alphabet ------------------------------------------------
+
+    def enabled(self, state: _FleetState):
+        evs = ["tick"]
+        board = state.board
+        for w in state.workers:
+            evs.append(f"w{w.idx}.beat")
+            for bid in state.bids:
+                offer = board_read_json(board, offer_key(bid))
+                epoch = offer.get("epoch") if offer else None
+                if (
+                    offer is not None
+                    and isinstance(epoch, int)
+                    and w.claimed.get(bid) != epoch
+                    and board.get(claim_key(bid, epoch)) is None
+                    and board.get(result_key(bid, epoch)) is None
+                ):
+                    evs.append(f"w{w.idx}.claim")
+                if bid in w.claimed and board.get(
+                    result_key(bid, w.claimed[bid])
+                ) is None:
+                    evs.append(f"w{w.idx}.post")
+                if (
+                    self.stale
+                    and bid in w.claimed
+                    and offer is not None
+                    and isinstance(epoch, int)
+                    and epoch > w.claimed[bid]
+                    and board.get(result_key(bid, epoch)) is None
+                ):
+                    evs.append(f"w{w.idx}.stale")
+        return evs
+
+    def execute(self, state: _FleetState, ev: str) -> None:
+        if ev == "tick":
+            state.coord.pump(idle=True)
+            return
+        widx, verb = ev.split(".", 1)
+        w = state.workers[int(widx[1:])]
+        board = state.board
+        bid = state.bids[0]
+        if verb == "beat":
+            w.beats += 1
+            board.post(heartbeat_key(w.wid), str(w.beats))
+        elif verb == "claim":
+            offer = board_read_json(board, offer_key(bid))
+            epoch = int(offer["epoch"])
+            if board.claim(
+                claim_key(bid, epoch),
+                json.dumps({"wid": w.wid, "epoch": epoch}),
+            ):
+                if (bid, epoch) in state.winners:
+                    raise InterleaveViolation(
+                        f"two claim winners for {bid} epoch {epoch}: "
+                        f"{state.winners[(bid, epoch)]} and {w.wid}"
+                    )
+                state.winners[(bid, epoch)] = w.wid
+                w.claimed[bid] = epoch
+        elif verb == "post":
+            epoch = w.claimed[bid]
+            board.post(
+                result_key(bid, epoch),
+                json.dumps({
+                    "bid": bid, "epoch": epoch, "wid": w.wid,
+                    "rows": [[w.idx, epoch, 0]],
+                }),
+            )
+        elif verb == "stale":
+            # Re-post the rows scored under the OLD claimed epoch at the
+            # CURRENT offer's result key: key recomputed, payload stale.
+            offer = board_read_json(board, offer_key(bid))
+            cur = int(offer["epoch"])
+            old = w.claimed[bid]
+            board.post(
+                result_key(bid, cur),
+                json.dumps({
+                    "bid": bid, "epoch": old, "wid": w.wid,
+                    "rows": [[w.idx, old, 0]],
+                }),
+            )
+        else:
+            raise InterleaveViolation(f"unknown event {ev!r} (model bug)")
+
+    # -- invariants --------------------------------------------------------
+
+    def check(self, state: _FleetState, schedule) -> None:
+        rec = state.recorder
+        for label, rows in rec.demuxed[state.checked:]:
+            epoch = int(rows[0][1])
+            if epoch != state.ledger[label]:
+                raise InterleaveViolation(
+                    f"fenced-epoch post ADMITTED: block {label} demuxed "
+                    f"rows carrying epoch {epoch}, newest offered epoch "
+                    f"is {state.ledger[label]} — LeaseTable.admits must "
+                    f"fence it; schedule={list(schedule)}"
+                )
+        state.checked = len(rec.demuxed)
+        done: dict[str, int] = {}
+        for label, _rows in rec.demuxed:
+            done[label] = done.get(label, 0) + 1
+        for label in rec.local:
+            done[label] = done.get(label, 0) + 1
+        for label, n in done.items():
+            if n > 1:
+                raise InterleaveViolation(
+                    f"block {label} completed {n} times (demux/local) — "
+                    f"exactly-once broken; schedule={list(schedule)}"
+                )
+        for wid, view in state.coord.membership.workers.items():
+            if not view.alive:
+                state.seen_dead.add(wid)
+            elif wid in state.seen_dead:
+                raise InterleaveViolation(
+                    f"dead worker {wid} RESURRECTED after its death "
+                    f"verdict; schedule={list(schedule)}"
+                )
+        for bid in state.bids:
+            offer = board_read_json(state.board, offer_key(bid))
+            if offer is not None and isinstance(offer.get("epoch"), int):
+                state.ledger[bid] = max(state.ledger[bid], offer["epoch"])
+
+    def finish(self, state: _FleetState, schedule) -> None:
+        """Leaf closure: freeze the workers, pump until every block
+        drains (death verdicts → re-dispatch → local fallback), then
+        require exactly one completion per block."""
+        ticks = 0
+        while state.coord.blocks and ticks < _QUIESCE_TICKS:
+            self.execute(state, "tick")
+            self.check(state, schedule)
+            ticks += 1
+        if state.coord.blocks:
+            raise InterleaveViolation(
+                f"reply DROPPED: blocks {sorted(state.coord.blocks)} "
+                f"still outstanding after {_QUIESCE_TICKS} quiescence "
+                f"ticks; schedule={list(schedule)}"
+            )
+        done: dict[str, int] = {}
+        for label, _rows in state.recorder.demuxed:
+            done[label] = done.get(label, 0) + 1
+        for label in state.recorder.local:
+            done[label] = done.get(label, 0) + 1
+        for bid in state.bids:
+            if done.get(bid, 0) != 1:
+                raise InterleaveViolation(
+                    f"block {bid} completed {done.get(bid, 0)} times at "
+                    f"quiescence (want exactly 1); "
+                    f"schedule={list(schedule)}"
+                )
+
+    # -- independence (sleep-set pruning) ----------------------------------
+
+    def _actor(self, ev: str) -> str:
+        return "coord" if ev == "tick" else ev.split(".", 1)[0]
+
+    def _footprint(self, ev: str):
+        if ev == "tick":
+            return {"*"}
+        _w, verb = ev.split(".", 1)
+        if verb == "beat":
+            return {f"hb/{_w}"}
+        return {"blk"}  # claim/post/stale all race on the block's keys
+
+    def independent(self, a: str, b: str) -> bool:
+        if self._actor(a) == self._actor(b):
+            return False
+        fa, fb = self._footprint(a), self._footprint(b)
+        if "*" in fa or "*" in fb:
+            return False
+        return not (fa & fb)
+
+
+class QueueScenario:
+    """The RequestQueue under exploration: three submitting clients, the
+    popping loop, drain close, and source close, interleaved every way.
+    Invariants: every admitted request is delivered exactly once (pop or
+    drain), rejected requests never appear, sequence ids are unique,
+    depth never exceeds ``max_depth``, and a submit after ``close()``
+    is always verdict ``closed``."""
+
+    MAX_DEPTH = 2
+    CLIENTS = 3
+
+    def __init__(self, name: str = "request-queue"):
+        self.name = name
+        self.invariants = (
+            "admitted-delivered-exactly-once",
+            "rejected-never-delivered",
+            "seq-unique",
+            "depth-bounded",
+            "closed-means-closed",
+        )
+
+    def fresh(self):
+        state = {
+            "queue": RequestQueue(self.MAX_DEPTH, VirtualClock()),
+            "tokens": [object() for _ in range(self.CLIENTS)],
+            "verdicts": {},  # client idx -> ADMIT_* verdict
+            "popped": [],
+            "closed": False,
+            "close_src_done": False,
+        }
+        state["queue"].open_source()
+        return state
+
+    def enabled(self, state):
+        evs = []
+        for i in range(self.CLIENTS):
+            if i not in state["verdicts"]:
+                evs.append(f"s{i}.submit")
+        evs.append("pop")
+        if not state["closed"]:
+            evs.append("close")
+        if not state["close_src_done"]:
+            evs.append("close_src")
+        return evs
+
+    def execute(self, state, ev: str) -> None:
+        q = state["queue"]
+        if ev == "pop":
+            state["popped"].extend(q.pop_ready(0.0, 0.0))
+        elif ev == "close":
+            state["closed"] = True
+            q.close()
+        elif ev == "close_src":
+            state["close_src_done"] = True
+            q.close_source()
+        else:
+            i = int(ev.split(".", 1)[0][1:])
+            was_closed = state["closed"]
+            verdict = q.submit({"id": f"c{i}"}, state["tokens"][i])
+            state["verdicts"][i] = verdict
+            if was_closed and verdict != ADMIT_CLOSED:
+                raise InterleaveViolation(
+                    f"submit after close() returned {verdict!r}, want "
+                    f"{ADMIT_CLOSED!r}"
+                )
+
+    def check(self, state, schedule) -> None:
+        depth = state["queue"].depth()
+        if depth > self.MAX_DEPTH:
+            raise InterleaveViolation(
+                f"queue depth {depth} exceeds max_depth "
+                f"{self.MAX_DEPTH}; schedule={list(schedule)}"
+            )
+
+    def finish(self, state, schedule) -> None:
+        drained = state["queue"].drain_pending()
+        out = list(state["popped"]) + list(drained)
+        seqs = [r.seq for r in out]
+        if len(set(seqs)) != len(seqs):
+            raise InterleaveViolation(
+                f"duplicate sequence ids {sorted(seqs)}; "
+                f"schedule={list(schedule)}"
+            )
+        by_token = {}
+        for r in out:
+            by_token[id(r.responder)] = by_token.get(id(r.responder), 0) + 1
+        for i, verdict in state["verdicts"].items():
+            n = by_token.get(id(state["tokens"][i]), 0)
+            want = 1 if verdict == ADMIT_OK else 0
+            if n != want:
+                raise InterleaveViolation(
+                    f"client {i} verdict {verdict!r} delivered {n} "
+                    f"time(s), want {want}; schedule={list(schedule)}"
+                )
+
+    def independent(self, a: str, b: str) -> bool:
+        return False  # one shared queue: every pair of events conflicts
+
+
+# -- the explorer ----------------------------------------------------------
+
+
+def explore(scenario, depth: int) -> dict:
+    """Exhaustive sleep-set DFS over ``scenario`` to ``depth`` events.
+
+    Stateless replay: every node rebuilds the world from scratch and
+    re-executes its prefix, so the real classes mutate real state with
+    no copying.  Returns the stats dict (schedules / transitions /
+    pruned / violations); exploration stops at the FIRST violating
+    schedule — a model checker's job is the counterexample."""
+    stats = {
+        "name": scenario.name,
+        "depth": int(depth),
+        "schedules": 0,
+        "transitions": 0,
+        "pruned": 0,
+        "violations": [],
+        "invariants": list(scenario.invariants),
+    }
+
+    def recurse(prefix, sleep):
+        state = scenario.fresh()
+        for ev in prefix:
+            scenario.execute(state, ev)
+            stats["transitions"] += 1
+            scenario.check(state, prefix)
+        enabled = scenario.enabled(state)
+        if len(prefix) >= depth or not enabled:
+            scenario.finish(state, prefix)
+            stats["schedules"] += 1
+            return
+        explored = []
+        for ev in enabled:
+            if ev in sleep:
+                stats["pruned"] += 1
+                continue
+            child_sleep = {
+                s for s in (sleep | set(explored))
+                if scenario.independent(s, ev)
+            }
+            recurse(prefix + [ev], child_sleep)
+            explored.append(ev)
+
+    try:
+        # The coordinator narrates joins/deaths/redispatches on stderr
+        # (obs.events.log_line); thousands of replays must not flood the
+        # terminal — the bus itself stays unarmed, nothing else changes.
+        with contextlib.redirect_stderr(io.StringIO()):
+            recurse([], set())
+    except InterleaveViolation as exc:
+        stats["violations"].append(str(exc))
+    return stats
+
+
+#: The committed exploration matrix (golden-pinned, >1000 schedules).
+#: fleet-races: two workers racing one offer — claim exclusivity,
+#:   exactly-once under death/expiry re-dispatch.
+#: fleet-fencing: one worker with the adversarial stale re-post enabled
+#:   and lease_ticks=1, deep enough that claim → expiry → re-offer →
+#:   stale post → collect all fit inside the depth bound.
+#: request-queue: admission/pop/close/close-source interleavings.
+def scenarios():
+    return [
+        (FleetScenario("fleet-races", workers=2), 6),
+        (
+            FleetScenario(
+                "fleet-fencing", workers=1, stale=True, lease_ticks=1
+            ),
+            8,
+        ),
+        (QueueScenario(), 6),
+    ]
+
+
+def run_all() -> dict:
+    """Explore every committed scenario; the concurrency-audit report's
+    ``interleave`` section."""
+    rows = [explore(scn, depth) for scn, depth in scenarios()]
+    return {
+        "scenarios": rows,
+        "total_schedules": sum(r["schedules"] for r in rows),
+        "total_transitions": sum(r["transitions"] for r in rows),
+    }
+
+
+def run_or_raise() -> dict:
+    """Driver entry: explore, raise :class:`InterleaveViolation` on any
+    violating schedule, return the report section when clean."""
+    report = run_all()
+    bad = [
+        f"[{r['name']}] {v}"
+        for r in report["scenarios"]
+        for v in r["violations"]
+    ]
+    if bad:
+        raise InterleaveViolation(
+            "interleave: protocol invariant violated:\n  "
+            + "\n  ".join(bad)
+        )
+    return report

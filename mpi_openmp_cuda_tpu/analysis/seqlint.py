@@ -49,6 +49,18 @@ SEQ009   every package module is explicitly classified in the
          rule list knows about would silently escape SEQ001-008; the
          registry makes that a lint failure instead (the PR 6 drift:
          ``io/pipeline.py`` and ``serve/*`` predated it).
+SEQ010   no blocking operation lexically inside a ``with <lock>:`` body
+         in serve-plane modules: socket ``accept``/``recv``/``connect``
+         (and ``send`` on socket-named receivers), board file I/O
+         (``post``/``claim``/``delete`` on board-named receivers,
+         ``board_read_json``), ``os`` file ops / ``open()``,
+         ``subprocess``, and ``ServeClock.block_until`` on anything but
+         the held lock itself (a Condition wait RELEASES its own lock
+         while waiting — waiting on a different one keeps the held lock
+         pinned through the wait).  A blocking op under a serve lock
+         stalls every thread that contends it — the lexical twin of the
+         transitive reachability audit in ``analysis/lockgraph.py``
+         (rule b), cheap enough to run on every ``make analyze``.
 =======  ==================================================================
 
 Suppression: append ``# seqlint: disable=SEQ00N`` to the offending line
@@ -190,6 +202,19 @@ _WALLCLOCK_ATTRS = {
     ("date", "today"),
 }
 
+#: SEQ010's blocking-operation tables — the lexical mirror of the
+#: reachability sets in ``analysis/lockgraph.py`` (keep in sync).
+#: ``.write``/``.flush`` on a locked stream are deliberately absent:
+#: they are bounded by SO_SNDTIMEO and serialising them is the lock's
+#: purpose (Responder.send).
+_SEQ010_SOCKET_ATTRS = ("accept", "recv", "recvfrom", "connect", "listen")
+_SEQ010_SOCKETISH_SEND = ("send", "sendall")
+_SEQ010_BOARD_ATTRS = ("post", "claim", "delete")
+_SEQ010_OS_ATTRS = (
+    "replace", "fsync", "link", "unlink", "makedirs", "rename",
+    "remove", "rmdir", "listdir", "walk",
+)
+
 _SUPPRESS_RE = re.compile(r"#\s*seqlint:\s*disable=([A-Z0-9, ]+)")
 _SUPPRESS_FILE_RE = re.compile(r"#\s*seqlint:\s*disable-file=([A-Z0-9, ]+)")
 
@@ -267,6 +292,12 @@ class _Linter(ast.NodeVisitor):
         self.in_deterministic = ROLE_DETERMINISTIC in roles
         self.in_instrumented = ROLE_INSTRUMENTED in roles
         self.in_serve = ROLE_SERVE in roles
+        # SEQ010 lexical state: the guard attrs of each enclosing class,
+        # the local guard names of each enclosing function, and the
+        # stack of guards currently held by enclosing `with` bodies.
+        self._class_guard_stack: list[set[str]] = []
+        self._local_guard_stack: list[set[str]] = []
+        self._held_guards: list[tuple[str, str]] = []
 
     # -- bookkeeping -------------------------------------------------------
 
@@ -281,8 +312,39 @@ class _Linter(ast.NodeVisitor):
             _TRACED_NAME_RE.match(node.name)
         )
         self.scopes.append(_Scope(node.name, traced))
+        # SEQ010: a nested def inside a `with lock:` body runs LATER,
+        # not under the lock — lexical held state does not cross a
+        # function boundary.
+        held, self._held_guards = self._held_guards, []
+        self._local_guard_stack.append(self._local_guards(node))
         self.generic_visit(node)
+        self._local_guard_stack.pop()
+        self._held_guards = held
         self.scopes.pop()
+
+    @staticmethod
+    def _is_guard_ctor(value: ast.AST) -> bool:
+        if not isinstance(value, ast.Call):
+            return False
+        func = value.func
+        return (
+            isinstance(func, ast.Attribute)
+            and func.attr in _GUARD_TYPES
+            and isinstance(func.value, ast.Name)
+            and func.value.id == "threading"
+        ) or (isinstance(func, ast.Name) and func.id in _GUARD_TYPES)
+
+    @classmethod
+    def _local_guards(cls, node) -> set[str]:
+        """Plain local names assigned ``threading.Lock()/Condition()/
+        RLock()`` anywhere in this function (SEQ010)."""
+        out: set[str] = set()
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Assign) and cls._is_guard_ctor(sub.value):
+                for tgt in sub.targets:
+                    if isinstance(tgt, ast.Name):
+                        out.add(tgt.id)
+        return out
 
     visit_FunctionDef = _enter_function
     visit_AsyncFunctionDef = _enter_function
@@ -310,39 +372,33 @@ class _Linter(ast.NodeVisitor):
     # -- SEQ008: serve-plane shared state under its lock -------------------
 
     def visit_ClassDef(self, node: ast.ClassDef):
-        if self.in_serve:
-            guards = self._class_guards(node)
-            if guards:
-                for stmt in node.body:
-                    if (
-                        isinstance(
-                            stmt, (ast.FunctionDef, ast.AsyncFunctionDef)
+        guards = self._class_guards(node) if self.in_serve else set()
+        if guards:
+            for stmt in node.body:
+                if (
+                    isinstance(
+                        stmt, (ast.FunctionDef, ast.AsyncFunctionDef)
+                    )
+                    and stmt.name != "__init__"
+                ):
+                    for child in stmt.body:
+                        self._scan_guarded(
+                            child, node.name, guards, held=False
                         )
-                        and stmt.name != "__init__"
-                    ):
-                        for child in stmt.body:
-                            self._scan_guarded(
-                                child, node.name, guards, held=False
-                            )
+        self._class_guard_stack.append(guards)
         self.generic_visit(node)
+        self._class_guard_stack.pop()
 
-    @staticmethod
-    def _class_guards(node: ast.ClassDef) -> set[str]:
+    @classmethod
+    def _class_guards(cls, node: ast.ClassDef) -> set[str]:
         """Attribute names assigned ``threading.Condition()/Lock()/
         RLock()`` (or a bare imported ``Lock()`` etc.) anywhere in the
         class: the class's owning guards."""
         guards: set[str] = set()
         for sub in ast.walk(node):
-            if not (isinstance(sub, ast.Assign) and isinstance(sub.value, ast.Call)):
-                continue
-            func = sub.value.func
-            is_guard_ctor = (
-                isinstance(func, ast.Attribute)
-                and func.attr in _GUARD_TYPES
-                and isinstance(func.value, ast.Name)
-                and func.value.id == "threading"
-            ) or (isinstance(func, ast.Name) and func.id in _GUARD_TYPES)
-            if not is_guard_ctor:
+            if not (
+                isinstance(sub, ast.Assign) and cls._is_guard_ctor(sub.value)
+            ):
                 continue
             for tgt in sub.targets:
                 if (
@@ -412,6 +468,120 @@ class _Linter(ast.NodeVisitor):
                 )
         for child in ast.iter_child_nodes(node):
             self._scan_guarded(child, cls, guards, held)
+
+    # -- SEQ010: blocking ops lexically under a serve lock -----------------
+
+    def _guard_token(self, expr: ast.AST) -> tuple[str, str] | None:
+        """``self.X`` where X is an enclosing class's guard, or a local
+        name assigned a guard constructor — the lock a ``with`` on this
+        expression holds."""
+        attr = self._self_attr_root(expr)
+        if (
+            attr is not None
+            and self._class_guard_stack
+            and attr in self._class_guard_stack[-1]
+        ):
+            return ("self", attr)
+        if (
+            isinstance(expr, ast.Name)
+            and self._local_guard_stack
+            and expr.id in self._local_guard_stack[-1]
+        ):
+            return ("local", expr.id)
+        return None
+
+    def _enter_with(self, node):
+        pushed = 0
+        if self.in_serve:
+            for item in node.items:
+                token = self._guard_token(item.context_expr)
+                if token is not None:
+                    self._held_guards.append(token)
+                    pushed += 1
+        self.generic_visit(node)
+        del self._held_guards[len(self._held_guards) - pushed:]
+
+    visit_With = _enter_with
+    visit_AsyncWith = _enter_with
+
+    @staticmethod
+    def _receiver_name(func: ast.Attribute) -> str:
+        """The receiver's last name segment, lowercased: ``x`` for
+        ``x.post``, ``_board`` for ``self._board.post``."""
+        base = func.value
+        if isinstance(base, ast.Attribute):
+            return base.attr.lower()
+        if isinstance(base, ast.Name):
+            return base.id.lower()
+        return ""
+
+    def _seq010_blocking(self, node: ast.Call) -> str | None:
+        """Classify one call as a blocking op for SEQ010 (None = not
+        blocking).  ``block_until`` is handled separately — it is legal
+        on the held lock itself."""
+        func = node.func
+        if isinstance(func, ast.Name):
+            if func.id == "open":
+                return "file I/O (open)"
+            if func.id == "board_read_json":
+                return "board file I/O (board_read_json)"
+            if func.id == "Popen":
+                return "subprocess (Popen)"
+            return None
+        if not isinstance(func, ast.Attribute):
+            return None
+        attr = func.attr
+        recv = self._receiver_name(func)
+        if attr in _SEQ010_SOCKET_ATTRS:
+            return f"socket .{attr}()"
+        if attr in _SEQ010_SOCKETISH_SEND and (
+            "sock" in recv or "conn" in recv
+        ):
+            return f"socket .{attr}()"
+        if attr in _SEQ010_BOARD_ATTRS and "board" in recv:
+            return f"board file I/O (.{attr}())"
+        if recv == "os" and attr in _SEQ010_OS_ATTRS:
+            return f"file I/O (os.{attr})"
+        if recv == "subprocess" or attr == "Popen":
+            return f"subprocess ({attr})"
+        if recv == "shutil":
+            return f"file I/O (shutil.{attr})"
+        return None
+
+    def _check_seq010(self, node: ast.Call) -> None:
+        if not (self.in_serve and self._held_guards):
+            return
+        func = node.func
+        if isinstance(func, ast.Attribute) and func.attr == "block_until":
+            # Waiting ON the held lock releases it while waiting
+            # (Condition.wait_for) — that is the pop_ready/_pause
+            # pattern.  Waiting on anything else keeps the held lock
+            # pinned through the whole wait.
+            if node.args and self._guard_token(node.args[0]) == (
+                self._held_guards[-1]
+            ):
+                return
+            self._emit(
+                "SEQ010",
+                node,
+                "block_until on a condition other than the held lock "
+                "keeps that lock pinned through the wait; wait on the "
+                "owning Condition itself, or move the wait outside the "
+                "`with` body",
+            )
+            return
+        detail = self._seq010_blocking(node)
+        if detail is not None:
+            held = ".".join(self._held_guards[-1])
+            self._emit(
+                "SEQ010",
+                node,
+                f"{detail} lexically inside `with {held}:` stalls every "
+                "thread contending that lock behind the operation; "
+                "compute the verdict under the lock, do the blocking "
+                "work after releasing it (see RequestQueue.submit and "
+                "analysis/lockgraph.py rule b)",
+            )
 
     # -- SEQ004: bare assert ----------------------------------------------
 
@@ -578,6 +748,9 @@ class _Linter(ast.NodeVisitor):
                     "(serve/clock.py) so tests drive a fake clock and "
                     "drain signals stay bounded",
                 )
+
+        # SEQ010: blocking ops lexically under a held serve lock.
+        self._check_seq010(node)
         self.generic_visit(node)
 
     # -- SEQ002: os.environ subscripts / membership ------------------------

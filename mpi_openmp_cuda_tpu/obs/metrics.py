@@ -543,6 +543,51 @@ def validate_report(rec) -> None:
                         f"got {e!r}"
                     )
                     break
+    elif kind == "concurrency-audit":
+        # scripts/concurrency_audit.py's lock-graph + interleave report.
+        lg = rec.get("lockgraph")
+        if not isinstance(lg, dict):
+            problems.append(f"lockgraph: want an object, got {lg!r}")
+        else:
+            if not isinstance(lg.get("locks"), list):
+                problems.append("lockgraph.locks: want a list of lock ids")
+            if not isinstance(lg.get("edges"), list):
+                problems.append("lockgraph.edges: want a list")
+            if not isinstance(lg.get("findings"), list):
+                problems.append("lockgraph.findings: want a list")
+            counts = lg.get("counts")
+            if not isinstance(counts, dict) or not all(
+                isinstance(counts.get(k), int)
+                for k in ("locks", "edges", "findings")
+            ):
+                problems.append(
+                    "lockgraph.counts: want locks/edges/findings ints, "
+                    f"got {counts!r}"
+                )
+        il = rec.get("interleave")
+        if not isinstance(il, dict):
+            problems.append(f"interleave: want an object, got {il!r}")
+        else:
+            rows = il.get("scenarios")
+            if not isinstance(rows, list):
+                problems.append(f"interleave.scenarios: want a list, got {rows!r}")
+            else:
+                for i, row in enumerate(rows):
+                    if (
+                        not isinstance(row, dict)
+                        or not isinstance(row.get("name"), str)
+                        or not isinstance(row.get("schedules"), int)
+                        or not isinstance(row.get("violations"), list)
+                    ):
+                        problems.append(
+                            f"interleave.scenarios[{i}]: want name plus "
+                            f"schedules int plus violations list, got {row!r}"
+                        )
+            if not isinstance(il.get("total_schedules"), int):
+                problems.append(
+                    "interleave.total_schedules: want an int, got "
+                    f"{il.get('total_schedules')!r}"
+                )
     elif kind == "aot-manifest":
         # aot/manifest.py's warm-set manifest.
         fp = rec.get("fingerprint")

@@ -33,6 +33,7 @@ from __future__ import annotations
 
 import json
 import os
+import threading
 
 import numpy as np
 
@@ -75,6 +76,12 @@ class MemoryBoard:
 
     def __init__(self):
         self._kv: dict[str, str] = {}
+        # Single dict reads/writes are GIL-atomic; `claim` is a
+        # check-THEN-set, which is not — two threads racing one lease
+        # key could both pass the check and both report victory.  The
+        # lock restores the single-winner contract FileBoard gets from
+        # os.link (the concurrent-claimers test races N threads on it).
+        self._claim_lock = threading.Lock()
 
     def post(self, key: str, value: str) -> None:
         self._kv[key] = value
@@ -84,10 +91,11 @@ class MemoryBoard:
         return value if value else None  # zero-length post reads as missing
 
     def claim(self, key: str, value: str) -> bool:
-        if key in self._kv:
-            return False
-        self._kv[key] = value
-        return True
+        with self._claim_lock:
+            if key in self._kv:
+                return False
+            self._kv[key] = value
+            return True
 
     def delete(self, key: str) -> None:
         self._kv.pop(key, None)
@@ -126,9 +134,15 @@ class FileBoard:
 
     def _write_tmp(self, path: str, value: str) -> str:
         os.makedirs(os.path.dirname(path), exist_ok=True)
+        # pid alone is not unique enough: in-process worker THREADS
+        # (the fleet tests, the serve readers) racing one key would
+        # share one tmp file, and a claim could link the other racer's
+        # bytes under its own victory.  pid + thread id makes every
+        # concurrent writer's staging file its own.
         tmp = os.path.join(
             os.path.dirname(path),
-            f"{self._TMP}{os.path.basename(path)}.{os.getpid()}",
+            f"{self._TMP}{os.path.basename(path)}"
+            f".{os.getpid()}.{threading.get_ident()}",
         )
         with open(tmp, "w", encoding="utf-8") as fh:
             fh.write(value)

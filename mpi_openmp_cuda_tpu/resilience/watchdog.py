@@ -135,41 +135,55 @@ class Watchdog:
             self._heartbeat()
 
     def _monitor(self) -> None:
+        # The bus fan-out (publish / log_line / the heartbeat callback)
+        # happens OUTSIDE the condition: every subscriber sits behind its
+        # own lock and the flight recorder's trigger events do file I/O,
+        # so emitting under `_cond` would stall every `guard()`/`stop()`
+        # caller behind a disk write (analysis/lockgraph.py rule b —
+        # obs recorder locks must never nest under a subsystem lock).
         hb = self.heartbeat_s
-        with self._cond:
-            while not self._stopped:
+        while True:
+            beat = False
+            expired = None
+            with self._cond:
+                if self._stopped:
+                    return
                 if self._arm is None or self.deadline_s is None:
                     # Idle (or heartbeat-only mode, where armed guards
                     # carry no deadline): sleep a heartbeat interval —
                     # forever when none is configured — and emit the
                     # status line on each quiet timeout.
                     notified = self._cond.wait(timeout=hb)
-                    if not notified and not self._stopped:
-                        self._beat()
-                    continue
-                cur = self._arm
-                disarmed = self._cond.wait_for(
-                    lambda: self._stopped or self._arm is not cur,
-                    timeout=self.deadline_s,
-                )
-                if disarmed:
-                    continue
-                # Deadline hit while cur is still armed: signal expiry
-                # (an injected hang blocked on cur.expired now raises a
-                # transient DeadlineExpiredError into the retry policy),
-                # warn about the real-hang case, then wait for disarm.
-                self.expiries += 1
-                cur.expired.set()
-                publish("watchdog.expiry", site=cur.describe)
+                    beat = not notified and not self._stopped
+                else:
+                    cur = self._arm
+                    disarmed = self._cond.wait_for(
+                        lambda: self._stopped or self._arm is not cur,
+                        timeout=self.deadline_s,
+                    )
+                    if not disarmed:
+                        # Deadline hit while cur is still armed: signal
+                        # expiry (an injected hang blocked on
+                        # cur.expired now raises a transient
+                        # DeadlineExpiredError into the retry policy).
+                        self.expiries += 1
+                        cur.expired.set()
+                        expired = cur
+            if beat:
+                self._beat()
+            if expired is not None:
+                publish("watchdog.expiry", site=expired.describe)
                 self._log(
-                    f"mpi_openmp_cuda_tpu: warning: {cur.describe} exceeded "
-                    f"the {self.deadline_s:g}s watchdog deadline; if it "
-                    "never returns the process must be preempted externally "
-                    "(SIGTERM drains with journalled progress; see --resume)"
+                    f"mpi_openmp_cuda_tpu: warning: {expired.describe} "
+                    f"exceeded the {self.deadline_s:g}s watchdog deadline; "
+                    "if it never returns the process must be preempted "
+                    "externally (SIGTERM drains with journalled progress; "
+                    "see --resume)"
                 )
-                self._cond.wait_for(
-                    lambda: self._stopped or self._arm is not cur
-                )
+                with self._cond:
+                    self._cond.wait_for(
+                        lambda: self._stopped or self._arm is not expired
+                    )
 
     # -- arming ------------------------------------------------------------
     @contextlib.contextmanager

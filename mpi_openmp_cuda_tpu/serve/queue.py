@@ -100,56 +100,56 @@ class RequestQueue:
     # -- admission ---------------------------------------------------------
 
     def submit(self, raw: dict, responder) -> str:
-        """Admit one raw request; returns an ADMIT_* verdict."""
+        """Admit one raw request; returns an ADMIT_* verdict.
+
+        The bus event is published AFTER ``_cond`` is released: publish
+        fans out synchronously to the obs recorders (each behind its own
+        lock, the flight recorder with file I/O on trigger events), so
+        publishing under the queue condition would nest every recorder
+        lock — and a dump's disk write — beneath the serve lock every
+        reader thread contends (analysis/lockgraph.py rule b)."""
         with self._cond:
-            if self._closed:
-                publish(
-                    "serve.request.rejected",
-                    reason="closed",
-                    depth=len(self._items),
-                )
-                return ADMIT_CLOSED
+            rejection = None
             cost = 0.0
-            if self._controller is not None:
+            if not self._closed and self._controller is not None:
                 rejection, cost = self._controller.admit(raw)
-                if rejection is not None:
-                    publish(
-                        "serve.request.shed",
-                        reason=rejection,
-                        depth=len(self._items),
-                    )
-                    return ADMIT_OVERLOADED
-            if len(self._items) >= self.max_depth:
+            if self._closed:
+                verdict, event, fields = ADMIT_CLOSED, "serve.request.rejected", {
+                    "reason": "closed", "depth": len(self._items),
+                }
+            elif rejection is not None:
+                verdict, event, fields = ADMIT_OVERLOADED, "serve.request.shed", {
+                    "reason": rejection, "depth": len(self._items),
+                }
+            elif len(self._items) >= self.max_depth:
                 if self._controller is not None:
                     # The bucket admitted it; the depth backstop did not.
                     self._controller.release(cost)
-                publish(
-                    "serve.request.rejected",
-                    reason="full",
-                    depth=len(self._items),
+                verdict, event, fields = ADMIT_FULL, "serve.request.rejected", {
+                    "reason": "full", "depth": len(self._items),
+                }
+            else:
+                self._seq += 1
+                trace_id = f"t{self._seq}"
+                rid = raw.get("id")
+                self._items.append(
+                    QueuedRequest(
+                        raw,
+                        responder,
+                        self._clock.now(),
+                        self._seq,
+                        cost,
+                        trace_id,
+                    )
                 )
-                return ADMIT_FULL
-            self._seq += 1
-            trace_id = f"t{self._seq}"
-            rid = raw.get("id")
-            self._items.append(
-                QueuedRequest(
-                    raw,
-                    responder,
-                    self._clock.now(),
-                    self._seq,
-                    cost,
-                    trace_id,
-                )
-            )
-            publish(
-                "serve.request.admitted",
-                depth=len(self._items),
-                id=f"req-{self._seq}" if rid is None else str(rid),
-                trace=trace_id,
-            )
-            self._cond.notify_all()
-            return ADMIT_OK
+                self._cond.notify_all()
+                verdict, event, fields = ADMIT_OK, "serve.request.admitted", {
+                    "depth": len(self._items),
+                    "id": f"req-{self._seq}" if rid is None else str(rid),
+                    "trace": trace_id,
+                }
+        publish(event, **fields)
+        return verdict
 
     def close(self) -> None:
         """Stop admission (drain); waiters wake immediately."""

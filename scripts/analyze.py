@@ -1,28 +1,38 @@
 #!/usr/bin/env python3
 """`make analyze` driver: run the full static-analysis gate on CPU.
 
-Six passes (docs/ARCHITECTURE.md §9), in cheapest-first order so the
+Eight passes (docs/ARCHITECTURE.md §9), in cheapest-first order so the
 common failure (a lint regression) reports before jax even imports:
 
 1. seqlint        — repo-specific AST rules over the package tree.
-2. VMEM audit     — exhaustive sweep of every kernel config the
+2. lock graph     — whole-program lock-ordering + blocking-reachability
+                    audit (analysis/lockgraph.py; golden drift gating
+                    lives in scripts/concurrency_audit.py).
+3. VMEM audit     — exhaustive sweep of every kernel config the
                     dispatch choosers can emit vs the per-core budget.
-3. cost model     — the same emittable space priced by the calibrated
+4. cost model     — the same emittable space priced by the calibrated
                     iteration model (analysis/costmodel.py): every
                     config must cost finite and positive, and the
                     default schedule must yield a prediction.
-4. contract audit — jax.eval_shape over every registered scorer entry
+5. contract audit — jax.eval_shape over every registered scorer entry
                     point (the shard_map wrapper needs a mesh, hence
                     the 8-virtual-device CPU backend forced below).
-5. trace audit    — lower every entry point and walk the jaxpr for
+6. trace audit    — lower every entry point and walk the jaxpr for
                     host transfers, convert widenings, donation
                     coverage, and pallas-launch structure
                     (analysis/traceaudit.py; golden drift gating lives
                     in scripts/schedule_audit.py).
-6. ruff / mypy    — only when installed (the container may not ship
+7. interleave     — exhaustive small-scope exploration of the fleet
+                    protocol's event interleavings against the §8.6
+                    invariants (analysis/interleave.py).
+8. ruff / mypy    — only when installed (the container may not ship
                     them); the baselines live in pyproject.toml.
 
-Exit 0 iff every pass is clean.  Runs in under a minute, no TPU.
+EVERY pass runs regardless of earlier failures — an unexpected crash in
+one pass is itself a failure of that pass, never a reason to skip the
+rest — and the run ends with a per-pass summary table and a single
+deferred exit code.  Exit 0 iff every pass is clean.  Runs in under a
+minute, no TPU.
 """
 
 from __future__ import annotations
@@ -31,6 +41,7 @@ import os
 import shutil
 import subprocess
 import sys
+import traceback
 
 # Force the CPU backend with enough virtual devices for the shard_map
 # contract BEFORE jax initialises (same idiom as tests/conftest.py).
@@ -41,113 +52,174 @@ if "xla_force_host_platform_device_count" not in _flags:
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+SKIPPED = "skipped"
+
+
+def _pass_seqlint() -> str:
+    from mpi_openmp_cuda_tpu.analysis.seqlint import run_or_raise
+
+    nfiles = run_or_raise()
+    print(f"clean: {nfiles} files, 0 findings")
+    return f"{nfiles} files, 0 findings"
+
+
+def _pass_lockgraph() -> str:
+    from mpi_openmp_cuda_tpu.analysis.lockgraph import run_or_raise
+
+    report = run_or_raise()
+    counts = report["counts"]
+    for e in report["edges"]:
+        print(f"  edge {e['src']} -> {e['dst']}  [{e['via']}]")
+    print(
+        f"clean: {report['files']} files, {counts['locks']} locks, "
+        f"{counts['edges']} ordering edges, 0 findings"
+    )
+    return (
+        f"{counts['locks']} locks, {counts['edges']} edges, 0 findings"
+    )
+
+
+def _pass_vmem() -> str:
+    from mpi_openmp_cuda_tpu.analysis import vmem
+
+    n, worst = vmem.audit_chooser_space()
+    print(f"clean: {n} emittable configs within budget; tightest:")
+    print(f"  {worst.describe()}")
+    print(f"  headroom {worst.headroom_bytes / (1 << 20):.2f} MiB")
+    return f"{n} configs within budget"
+
+
+def _pass_costmodel() -> str:
+    from mpi_openmp_cuda_tpu.analysis import SeqcheckError, costmodel
+    from mpi_openmp_cuda_tpu.models.workload import input3_class_problem
+
+    n, best = costmodel.audit_config_space()
+    sheet = costmodel.schedule_cost_sheet(input3_class_problem(), "pallas")
+    pred = sheet["predicted_mfu_vs_feed_roofline"]
+    if pred is None or not 0.0 < pred <= 1.0:
+        raise SeqcheckError(
+            f"default input3-class schedule prediction is {pred!r}, "
+            "want a ratio in (0, 1]: the cost model and the schedule "
+            "derivation have drifted apart (analysis/costmodel.py)"
+        )
+    print(f"clean: {n} emittable configs priced; best MFU bound:")
+    print(f"  {best.describe()}")
+    totals = sheet["totals"]
+    print(
+        f"  default schedule: {totals['launches']} launches, "
+        f"{totals['executables']} executables, "
+        f"predicted mfu_vs_feed_roofline {pred}"
+    )
+    return f"{n} configs priced, predicted MFU {pred}"
+
+
+def _pass_contracts() -> str:
+    from mpi_openmp_cuda_tpu.analysis import contracts
+
+    rows = contracts.audit_entry_points()
+    for row in rows:
+        print(f"  {row}")
+    print(f"clean: {len(rows)} contract x bucket evaluations")
+    return f"{len(rows)} contract x bucket evaluations"
+
+
+def _pass_traceaudit() -> str:
+    from mpi_openmp_cuda_tpu.analysis import traceaudit
+
+    reports = traceaudit.audit_entry_points()
+    undonated = 0
+    for rep in reports:
+        undonated += len(rep.undonated_large)
+        print(
+            f"  {rep.entry:<45s} bucket={str(rep.bucket):<22s} "
+            f"pallas={rep.pallas_calls} widen={rep.convert_widenings} "
+            f"undonated_large={len(rep.undonated_large)}"
+        )
+    # Donation coverage is REPORTED, not asserted: the honest current
+    # state is zero donation, and the drift gate on the count lives in
+    # the schedule-audit golden.
+    print(
+        f"clean: {len(reports)} lowers, 0 host transfers; "
+        f"{undonated} un-donated large buffers listed"
+    )
+    return f"{len(reports)} lowers, 0 host transfers"
+
+
+def _pass_interleave() -> str:
+    from mpi_openmp_cuda_tpu.analysis.interleave import run_or_raise
+
+    report = run_or_raise()
+    for r in report["scenarios"]:
+        print(
+            f"  {r['name']}: depth={r['depth']} "
+            f"schedules={r['schedules']} pruned={r['pruned']} "
+            f"violations=0"
+        )
+    total = report["total_schedules"]
+    print(f"clean: {total} schedules explored, 0 invariant violations")
+    return f"{total} schedules, 0 violations"
+
+
+def _tool_pass(tool: str, argv: list[str]):
+    def run() -> str:
+        # Optional generic tooling: gate on availability, never on
+        # import — the deployment container does not ship ruff/mypy.
+        if shutil.which(tool) is None:
+            print(f"{tool} not installed; skipped")
+            return SKIPPED
+        rc = subprocess.call(argv, cwd=REPO)
+        if rc != 0:
+            raise RuntimeError(f"{tool} exited {rc}")
+        return "clean"
+
+    return run
+
+
+PASSES = [
+    ("seqlint", _pass_seqlint),
+    ("lock graph", _pass_lockgraph),
+    ("vmem audit", _pass_vmem),
+    ("cost model", _pass_costmodel),
+    ("entry-point contracts", _pass_contracts),
+    ("trace audit", _pass_traceaudit),
+    ("interleave", _pass_interleave),
+    ("ruff", _tool_pass("ruff", ["ruff", "check", "mpi_openmp_cuda_tpu"])),
+    ("mypy", _tool_pass("mypy", ["mypy", "mpi_openmp_cuda_tpu"])),
+]
 
 
 def main() -> int:
-    from mpi_openmp_cuda_tpu.analysis import SeqcheckError, contracts, vmem
-    from mpi_openmp_cuda_tpu.analysis.seqlint import run_or_raise
+    from mpi_openmp_cuda_tpu.analysis import SeqcheckError
 
-    failures = 0
-
-    print("== seqlint ==")
-    try:
-        nfiles = run_or_raise()
-    except SeqcheckError as exc:
-        print(exc)
-        failures += 1
-    else:
-        print(f"clean: {nfiles} files, 0 findings")
-
-    print("\n== vmem audit ==")
-    try:
-        n, worst = vmem.audit_chooser_space()
-    except SeqcheckError as exc:
-        print(exc)
-        failures += 1
-    else:
-        print(f"clean: {n} emittable configs within budget; tightest:")
-        print(f"  {worst.describe()}")
-        print(f"  headroom {worst.headroom_bytes / (1 << 20):.2f} MiB")
-
-    print("\n== cost model ==")
-    try:
-        from mpi_openmp_cuda_tpu.analysis import costmodel
-        from mpi_openmp_cuda_tpu.models.workload import input3_class_problem
-
-        n, best = costmodel.audit_config_space()
-        sheet = costmodel.schedule_cost_sheet(input3_class_problem(), "pallas")
-        pred = sheet["predicted_mfu_vs_feed_roofline"]
-        if pred is None or not 0.0 < pred <= 1.0:
-            raise SeqcheckError(
-                f"default input3-class schedule prediction is {pred!r}, "
-                "want a ratio in (0, 1]: the cost model and the schedule "
-                "derivation have drifted apart (analysis/costmodel.py)"
+    results: list[tuple[str, str, str]] = []  # (pass, status, summary)
+    for i, (name, fn) in enumerate(PASSES):
+        print(("" if i == 0 else "\n") + f"== {name} ==")
+        try:
+            summary = fn()
+        except SeqcheckError as exc:
+            # An analysis finding: the message IS the report.
+            print(exc)
+            results.append((name, "FAIL", str(exc).splitlines()[0]))
+        except Exception as exc:  # noqa: BLE001 — a crashed pass must
+            # not take the remaining passes down with it; the traceback
+            # is the finding and the pass fails.
+            traceback.print_exc()
+            results.append(
+                (name, "FAIL", f"crashed: {type(exc).__name__}: {exc}")
             )
-    except SeqcheckError as exc:
-        print(exc)
-        failures += 1
-    else:
-        print(f"clean: {n} emittable configs priced; best MFU bound:")
-        print(f"  {best.describe()}")
-        totals = sheet["totals"]
-        print(
-            f"  default schedule: {totals['launches']} launches, "
-            f"{totals['executables']} executables, "
-            f"predicted mfu_vs_feed_roofline {pred}"
-        )
+        else:
+            status = "SKIP" if summary == SKIPPED else "OK"
+            results.append((name, status, summary))
 
-    print("\n== entry-point contracts ==")
-    try:
-        rows = contracts.audit_entry_points()
-    except SeqcheckError as exc:
-        print(exc)
-        failures += 1
-    else:
-        for row in rows:
-            print(f"  {row}")
-        print(f"clean: {len(rows)} contract x bucket evaluations")
+    width = max(len(name) for name, _, _ in results)
+    print("\n== summary ==")
+    for name, status, summary in results:
+        print(f"  {name:<{width}s}  {status:<4s}  {summary}")
 
-    print("\n== trace audit ==")
-    try:
-        from mpi_openmp_cuda_tpu.analysis import traceaudit
-
-        reports = traceaudit.audit_entry_points()
-    except SeqcheckError as exc:
-        print(exc)
-        failures += 1
-    else:
-        undonated = 0
-        for rep in reports:
-            undonated += len(rep.undonated_large)
-            print(
-                f"  {rep.entry:<45s} bucket={str(rep.bucket):<22s} "
-                f"pallas={rep.pallas_calls} widen={rep.convert_widenings} "
-                f"undonated_large={len(rep.undonated_large)}"
-            )
-        # Donation coverage is REPORTED, not asserted: the honest
-        # current state is zero donation, and the drift gate on the
-        # count lives in the schedule-audit golden.
-        print(
-            f"clean: {len(reports)} lowers, 0 host transfers; "
-            f"{undonated} un-donated large buffers listed"
-        )
-
-    # Optional generic tooling: gate on availability, never on import —
-    # the deployment container does not ship ruff/mypy.
-    for tool, argv in (
-        ("ruff", ["ruff", "check", "mpi_openmp_cuda_tpu"]),
-        ("mypy", ["mypy", "mpi_openmp_cuda_tpu"]),
-    ):
-        print(f"\n== {tool} ==")
-        if shutil.which(tool) is None:
-            print(f"{tool} not installed; skipped")
-            continue
-        rc = subprocess.call(argv, cwd=os.path.dirname(os.path.dirname(
-            os.path.abspath(__file__))))
-        if rc != 0:
-            failures += 1
-
+    failures = sum(1 for _, status, _ in results if status == "FAIL")
     print(
         "\nanalyze: "
         + ("FAILED" if failures else "OK")

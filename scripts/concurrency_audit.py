@@ -1,0 +1,217 @@
+#!/usr/bin/env python3
+"""`make concurrency-audit` driver: the concurrency gate on CPU.
+
+Two passes over the live tree, both deterministic, both golden-pinned:
+
+1. **Lock graph** (``analysis/lockgraph.py``): AST + intra-package call
+   graph → every lock acquisition site and every lock-ordering edge;
+   fails on lock-order cycles, on blocking operations (socket accept/
+   recv/connect, board file I/O, ``block_until`` on a foreign lock,
+   subprocess, ``open``) reachable — transitively, through the call
+   graph and the obs bus fan-out — while a serve-plane or obs lock is
+   held, and on locks acquired and released by different classes.
+2. **Interleaving explorer** (``analysis/interleave.py``): the REAL
+   ``Membership`` / ``LeaseTable`` / ``FleetCoordinator`` /
+   ``RequestQueue`` state machines under a virtual scheduler,
+   exhaustively enumerating sleep-set-pruned event interleavings to a
+   depth bound and asserting the §8.6 protocol invariants (demux
+   exactly once, fenced epochs never admitted, dead workers never
+   resurrected, no reply dropped) on every schedule.
+
+The committed golden (``tests/golden/concurrency_audit.json``) pins the
+full lock inventory, the complete ordering-edge list (so a NEW nesting
+— however benign it looks — must be reviewed and committed), the
+finding count at zero, and the per-scenario explored-schedule counts
+(a drop means the explorer silently lost coverage; a rise means the
+protocol grew states — both are review events).
+
+Exit 0 iff both passes are clean, the report is schema-valid, the
+explored-schedule total clears the >1000 floor, and nothing drifted
+from the golden.  CPU-only, zero devices, a few seconds.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+# Force the CPU backend BEFORE jax initialises (the interleave pass
+# imports serve/fleet.py, which imports jax; same idiom as analyze.py).
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+GOLDEN_PATH = os.path.join(REPO, "tests", "golden", "concurrency_audit.json")
+
+#: The acceptance floor on exhaustiveness: below this the depth bounds
+#: have been cut too far for the matrix rows to mean anything.
+MIN_SCHEDULES = 1000
+
+
+def build_report() -> dict:
+    """The full enveloped concurrency-audit report."""
+    from mpi_openmp_cuda_tpu.analysis.interleave import run_all
+    from mpi_openmp_cuda_tpu.analysis.lockgraph import audit_lock_graph
+    from mpi_openmp_cuda_tpu.obs.metrics import wrap_report
+
+    return wrap_report(
+        "concurrency-audit",
+        {"lockgraph": audit_lock_graph(), "interleave": run_all()},
+    )
+
+
+def golden_view(report: dict) -> dict:
+    """The drift-gated subset: lock inventory, the full ordering-edge
+    list, finding count, and per-scenario schedule counts — all static
+    facts of the tree and the explorer, no walls, no clocks."""
+    lg = report["lockgraph"]
+    il = report["interleave"]
+    return {
+        "locks": sorted(lg["locks"]),
+        "edges": sorted(
+            f"{e['src']} -> {e['dst']}" for e in lg["edges"]
+        ),
+        "findings": lg["counts"]["findings"],
+        "scenarios": [
+            {
+                "name": r["name"],
+                "depth": r["depth"],
+                "schedules": r["schedules"],
+                "violations": len(r["violations"]),
+                "invariants": list(r["invariants"]),
+            }
+            for r in il["scenarios"]
+        ],
+        "total_schedules": il["total_schedules"],
+    }
+
+
+def diff_views(want: dict, got: dict) -> list[str]:
+    """Field-by-field drift rows (empty = match)."""
+    rows: list[str] = []
+    for key in sorted(set(want) | set(got)):
+        w, g = want.get(key), got.get(key)
+        if w != g:
+            rows.append(f"  {key}: golden {json.dumps(w)} != got {json.dumps(g)}")
+    return rows
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument(
+        "--update",
+        action="store_true",
+        help="rewrite the committed golden baseline from this run "
+        "(commit it together with the change that explains the drift)",
+    )
+    parser.add_argument(
+        "--out",
+        default=None,
+        help="also write the full enveloped report JSON to this path "
+        "(CI uploads it as the failure artifact)",
+    )
+    args = parser.parse_args()
+
+    from mpi_openmp_cuda_tpu.obs.metrics import validate_report
+
+    report = build_report()
+    failed = False
+
+    print("== schema ==")
+    try:
+        validate_report(report)
+        print("valid: kind=concurrency-audit")
+    except ValueError as exc:
+        print(f"FAIL: {exc}")
+        failed = True
+
+    lg = report["lockgraph"]
+    print("\n== lock graph ==")
+    print(
+        f"files={lg['files']} functions={lg['functions']} "
+        f"locks={lg['counts']['locks']} edges={lg['counts']['edges']} "
+        f"findings={lg['counts']['findings']}"
+    )
+    for lock in sorted(lg["locks"]):
+        print(f"  lock {lock}")
+    for e in lg["edges"]:
+        print(f"  edge {e['src']} -> {e['dst']}  [{e['via']}]")
+    for f in lg["findings"]:
+        print(f"  FINDING [{f['kind']}] {f['detail']}")
+        failed = True
+
+    il = report["interleave"]
+    print("\n== interleavings ==")
+    for r in il["scenarios"]:
+        print(
+            f"  {r['name']}: depth={r['depth']} "
+            f"schedules={r['schedules']} transitions={r['transitions']} "
+            f"pruned={r['pruned']} violations={len(r['violations'])}"
+        )
+        for v in r["violations"]:
+            print(f"    VIOLATION {v}")
+            failed = True
+    total = il["total_schedules"]
+    print(f"total_schedules={total} (floor {MIN_SCHEDULES})")
+    if total <= MIN_SCHEDULES:
+        print(
+            f"FAIL: only {total} schedules explored — the depth bounds "
+            f"no longer clear the >{MIN_SCHEDULES} exhaustiveness floor"
+        )
+        failed = True
+
+    if args.out:
+        with open(args.out, "w") as fh:
+            json.dump(report, fh, indent=1, sort_keys=True)
+            fh.write("\n")
+        print(f"\nreport written to {args.out}")
+
+    view = golden_view(report)
+    if args.update:
+        if failed:
+            print("\nrefusing --update: the run itself failed")
+            return 1
+        os.makedirs(os.path.dirname(GOLDEN_PATH), exist_ok=True)
+        with open(GOLDEN_PATH, "w") as fh:
+            json.dump(view, fh, indent=1, sort_keys=True)
+            fh.write("\n")
+        print(f"\ngolden updated: {GOLDEN_PATH}")
+        return 0
+
+    print("\n== golden drift ==")
+    if not os.path.exists(GOLDEN_PATH):
+        print(
+            f"FAIL: no committed golden at {GOLDEN_PATH} "
+            "(run scripts/concurrency_audit.py --update and commit it)"
+        )
+        return 1
+    with open(GOLDEN_PATH) as fh:
+        want = json.load(fh)
+    rows = diff_views(want, view)
+    if rows:
+        print(f"FAIL: {len(rows)} field(s) drifted from the golden:")
+        print("\n".join(rows))
+        print(
+            "either fix the regression, or regenerate deliberately with "
+            "scripts/concurrency_audit.py --update and commit the new "
+            "baseline with the change that explains it"
+        )
+        return 1
+    print("match: concurrency audit equals the committed golden")
+    if failed:
+        print("\nconcurrency-audit: FAIL")
+        return 1
+    print("\nconcurrency-audit: OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

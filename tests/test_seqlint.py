@@ -493,3 +493,221 @@ class TestDriver:
     def test_syntax_error_reported_not_raised(self, tmp_path):
         findings = _lint_snippet(tmp_path, "io/broken.py", "def f(:\n")
         assert [f.code for f in findings] == ["SEQ000"]
+
+
+class TestSeq010BlockingUnderLock:
+    def test_board_post_under_lock(self, tmp_path):
+        findings = _lint_snippet(
+            tmp_path,
+            "serve/foo.py",
+            """
+            import threading
+
+            class W:
+                def __init__(self, board):
+                    self._lock = threading.Lock()
+                    self._board = board
+
+                def publish(self, key, val):
+                    with self._lock:
+                        self._board.post(key, val)
+            """,
+        )
+        assert [f.code for f in findings] == ["SEQ010"]
+        assert "board file I/O" in findings[0].message
+
+    def test_socket_accept_under_lock(self, tmp_path):
+        findings = _lint_snippet(
+            tmp_path,
+            "serve/foo.py",
+            """
+            import threading
+
+            class L:
+                def __init__(self, sock):
+                    self._lock = threading.Lock()
+                    self._sock = sock
+
+                def take(self):
+                    with self._lock:
+                        return self._sock.accept()
+            """,
+        )
+        assert [f.code for f in findings] == ["SEQ010"]
+        assert ".accept()" in findings[0].message
+
+    def test_open_under_local_lock(self, tmp_path):
+        # Function-local locks count too (the loop.py release_lock
+        # shape) — file I/O inside the with body is still a stall.
+        findings = _lint_snippet(
+            tmp_path,
+            "serve/foo.py",
+            """
+            import threading
+
+            def journal(path, line):
+                lock = threading.Lock()
+                with lock:
+                    with open(path, "a") as fh:
+                        fh.write(line)
+            """,
+        )
+        assert [f.code for f in findings] == ["SEQ010"]
+        assert "open" in findings[0].message
+
+    def test_subprocess_and_os_ops_under_lock(self, tmp_path):
+        findings = _lint_snippet(
+            tmp_path,
+            "serve/foo.py",
+            """
+            import os
+            import subprocess
+            import threading
+
+            class D:
+                def __init__(self):
+                    self._cond = threading.Condition()
+
+                def rotate(self, a, b):
+                    with self._cond:
+                        os.replace(a, b)
+                        subprocess.run(["sync"])
+            """,
+        )
+        assert sorted(f.code for f in findings) == ["SEQ010", "SEQ010"]
+
+    def test_block_until_on_foreign_lock(self, tmp_path):
+        findings = _lint_snippet(
+            tmp_path,
+            "serve/foo.py",
+            """
+            import threading
+
+            class Q:
+                def __init__(self, clock):
+                    self._lock = threading.Lock()
+                    self._cond = threading.Condition()
+                    self._clock = clock
+                    self._n = 0
+
+                def wait_other(self):
+                    with self._lock:
+                        self._clock.block_until(
+                            self._cond, lambda: True, 1.0
+                        )
+            """,
+        )
+        assert [f.code for f in findings] == ["SEQ010"]
+        assert "block_until" in findings[0].message
+
+    def test_block_until_on_held_lock_is_legal(self, tmp_path):
+        # The pop_ready/_pause pattern: Condition.wait_for RELEASES the
+        # lock it waits on — waiting on the held guard is the designed
+        # serve-plane wait, not a stall.
+        assert not _lint_snippet(
+            tmp_path,
+            "serve/foo.py",
+            """
+            import threading
+
+            class Q:
+                def __init__(self, clock):
+                    self._cond = threading.Condition()
+                    self._clock = clock
+                    self._items = []
+
+                def pop(self):
+                    with self._cond:
+                        self._clock.block_until(
+                            self._cond, lambda: bool(self._items), 1.0
+                        )
+                        popped, self._items[:] = list(self._items), []
+                        return popped
+            """,
+        )
+
+    def test_stream_write_under_lock_is_legal(self, tmp_path):
+        # Responder.send: serialising .write/.flush on the locked stream
+        # is the lock's PURPOSE (bounded by SO_SNDTIMEO), not a finding.
+        assert not _lint_snippet(
+            tmp_path,
+            "serve/foo.py",
+            """
+            import threading
+
+            class R:
+                def __init__(self, out):
+                    self._lock = threading.Lock()
+                    self._out = out
+
+                def send(self, line):
+                    with self._lock:
+                        self._out.write(line)
+                        self._out.flush()
+            """,
+        )
+
+    def test_blocking_after_release_is_legal(self, tmp_path):
+        # The hoist pattern SEQ010 pushes toward: verdict under the
+        # lock, blocking work after it.
+        assert not _lint_snippet(
+            tmp_path,
+            "serve/foo.py",
+            """
+            import threading
+
+            class W:
+                def __init__(self, board):
+                    self._lock = threading.Lock()
+                    self._board = board
+                    self._n = 0
+
+                def publish(self, key, val):
+                    with self._lock:
+                        self._n += 1
+                    self._board.post(key, val)
+            """,
+        )
+
+    def test_nested_def_under_lock_is_not_held(self, tmp_path):
+        # A closure defined inside a with body runs later, not under
+        # the lock — lexical held state stops at the function boundary.
+        assert not _lint_snippet(
+            tmp_path,
+            "serve/foo.py",
+            """
+            import threading
+
+            class W:
+                def __init__(self, board):
+                    self._lock = threading.Lock()
+                    self._board = board
+                    self._flush = None
+
+                def arm(self, key, val):
+                    with self._lock:
+                        def flush():
+                            self._board.post(key, val)
+                        self._flush = flush
+            """,
+        )
+
+    def test_outside_serve_plane_is_out_of_scope(self, tmp_path):
+        # SEQ010 is the serve-plane lock discipline; host modules may
+        # hold a lock across file I/O (e.g. an atomic cache write).
+        assert not _lint_snippet(
+            tmp_path,
+            "io/foo.py",
+            """
+            import threading
+
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def save(self, path, data):
+                    with self._lock:
+                        with open(path, "w") as fh:
+                            fh.write(data)
+            """,
+        )
