@@ -18,7 +18,7 @@ PY_CFLAGS  := $(shell $(PYCONFIG) --includes)
 PY_LDFLAGS := $(shell $(PYCONFIG) --ldflags --embed)
 INPUT      ?= /root/reference/input5.txt
 
-.PHONY: build run run2 runOn2 test chaos chaos-kill analyze schedule-audit concurrency-audit metrics-smoke serve-smoke serve-chaos fleet-chaos aot-smoke trace-smoke bench bench-table bench-gather check clean
+.PHONY: build run run2 runOn2 test chaos chaos-kill analyze schedule-audit concurrency-audit donation-audit metrics-smoke serve-smoke serve-chaos fleet-chaos aot-smoke trace-smoke bench bench-table bench-gather check clean
 
 build: final
 
@@ -116,6 +116,16 @@ schedule-audit:
 # scripts/concurrency_audit.py --update).  CPU-only, a few seconds.
 concurrency-audit:
 	JAX_PLATFORMS=cpu $(PYTHON) scripts/concurrency_audit.py
+
+# Donation-safety gate (docs/ARCHITECTURE.md §9): the whole-program
+# dataflow pass proving which jit-entry operands are dead at every
+# call site (incl. the retry/degrade/rescue re-dispatch ladders), then
+# the trace-audit enforcement that every provably-dead large buffer is
+# donated and every pinned-live one carries a reason, diffed against
+# the committed golden (tests/golden/donation_plan.json; regenerate
+# deliberately with scripts/donation_audit.py --update).  CPU-only.
+donation-audit:
+	JAX_PLATFORMS=cpu $(PYTHON) scripts/donation_audit.py
 
 # Observability smoke gate (docs/ARCHITECTURE.md §10): one CLI run on
 # the tiny fixture with --metrics --metrics-out, then schema-validate

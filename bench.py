@@ -701,6 +701,29 @@ def attempt_logger(on_tpu: bool, prefix: str = "[bench]"):
     return log
 
 
+def donation_record(measured_mfu=None, baseline="BENCH_r05.json"):
+    """The DonationPlan wired into the jit entry points, plus the MFU
+    delta vs the last committed pre-donation record (BENCH_r05's
+    0.217).  Pure host work — safe to call without hardware."""
+    from mpi_openmp_cuda_tpu.analysis.dataflow import donation_plan
+
+    plan = donation_plan()
+    donation = {
+        "entries": {e.wrapper: list(e.donate) for e in plan.entries},
+        "pinned_args": sum(len(e.pinned) for e in plan.entries),
+        "findings": len(plan.findings),
+    }
+    base_path = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), baseline
+    )
+    with open(base_path) as fh:
+        base = json.load(fh).get("parsed", {}).get("mfu_vs_feed_roofline")
+    donation["baseline_mfu_vs_feed_roofline"] = base
+    if base is not None and measured_mfu is not None:
+        donation["mfu_delta_vs_predonation"] = round(measured_mfu - base, 3)
+    return donation
+
+
 def main() -> None:
     # Respect an explicit JAX_PLATFORMS choice (TPU site hooks clobber it):
     # a CPU-forced bench (the pytest contract test) must actually run CPU.
@@ -950,6 +973,18 @@ def main() -> None:
         probe = (
             f" probe={probe_min:.0f}TFLOP/s real={real_tflops:.0f}TFLOP/s"
             f" mfu_feed={real_tflops / roof:.2f} ({roof_kind} {roof:.0f})"
+        )
+    # Donation section (never fatal, same contract as the cost model
+    # above): a donation regression must show up as a bench-visible
+    # number, not only as an audit failure.
+    try:
+        record["donation"] = donation_record(
+            record.get("mfu_vs_feed_roofline")
+        )
+    except Exception as e:  # noqa: BLE001 - diagnostic only
+        print(
+            f"[bench] WARNING: donation section failed ({e})",
+            file=sys.stderr,
         )
     pred_mfu = record.get("predicted_mfu_vs_feed_roofline")
     cold = (

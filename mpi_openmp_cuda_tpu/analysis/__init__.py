@@ -39,6 +39,12 @@ runnable on CPU-only CI (``make analyze``):
   ``RequestQueue``, ``FleetCoordinator``) under a virtual scheduler,
   exhaustively enumerating sleep-set-pruned interleavings to a depth
   bound and asserting the §8.6 protocol invariants on every schedule.
+* :mod:`.dataflow` — a whole-program donation-safety pass: def-use /
+  liveness for every array operand flowing into the module-level jit
+  entry points across all call sites (dispatch, pipeline, fleet, and
+  the retry/degrade/rescue re-dispatch ladders), emitting the
+  machine-checked ``DonationPlan`` that the ``donate_argnums`` wiring
+  and traceaudit's enforced donation gate are derived from.
 
 Everything raises a :class:`SeqcheckError` subclass with a message
 naming the violated bound and the fix, so a CI failure is actionable
@@ -124,6 +130,16 @@ class InterleaveViolation(SeqcheckError):
     counterexample replays deterministically."""
 
 
+class DataflowError(SeqcheckError):
+    """The donation-safety dataflow pass (analysis/dataflow.py) found a
+    plan violation: a donated operand that is not provably dead at some
+    call site, a re-dispatch path that stages device buffers above the
+    retry boundary (a retried chunk would alias donated inputs), or
+    ``donate_argnums`` wiring that drifted from the proven plan.  The
+    message carries the blocking call path, so the counterexample reads
+    like a stack trace."""
+
+
 __all__ = [
     "SeqcheckError",
     "ContractViolation",
@@ -138,4 +154,5 @@ __all__ = [
     "ScheduleDriftError",
     "LockGraphError",
     "InterleaveViolation",
+    "DataflowError",
 ]

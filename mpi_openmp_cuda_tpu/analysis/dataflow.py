@@ -1,0 +1,1079 @@
+"""Whole-program donation-safety dataflow pass (``dataflow``).
+
+PR 7's trace auditor LISTS every un-donated >= 16 KiB input buffer on
+the chunk pipeline; this pass is the proof that lets the wiring act on
+the list.  Donating blind is how you get use-after-donate crashes in
+the retry/degrade/rescue re-dispatch paths: ``jax.jit(donate_argnums)``
+deletes the caller's buffer on platforms that can alias it, so a retry
+that re-reads its inputs must be *proved* to re-stage fresh device
+buffers rather than alias the donated ones.
+
+The pass walks the package AST (reusing lockgraph's module index and
+call-descriptor resolution) and, for every module-level
+``X = jax.jit(body)`` entry point, checks three properties:
+
+(a) **call-site staging** — every package call site of the wrapper
+    (direct, through dispatch indirections like
+    ``resolve_xla_formulation(...)(*args)``, and through
+    wrapper-returning helpers like ``aot.compile._target``) must stage
+    each positional operand FRESH at the site: a ``jnp.asarray(...)``
+    / ``jnp.int32(...)`` construction from host data, or a tuple built
+    by a helper whose every return is such constructions.  An operand
+    whose provenance is a device-typed local would ALIAS the wrapper's
+    input (``jnp.asarray`` on a device array is a no-op) and is a
+    hazard, not a staging.
+(b) **post-dispatch liveness** — the name holding the staged operands
+    must be dead after the executing call: no read downstream in
+    execution order (sibling ``if``/``else`` branches do not count; a
+    call inside a loop whose operands were staged OUTSIDE the loop is
+    live — the next iteration would re-read deleted buffers).
+(c) **re-staging on retry** — from every re-dispatch root (the
+    ChunkPipeline dispatch/materialise retry ladders, whose rescore
+    closures the pass inlines, and the fleet worker's score path),
+    every call path to a staging site must create device buffers ONLY
+    at the staging leaf, below the retry boundary: each retried
+    attempt then re-enters the staging code with host operands and
+    cannot see a donated buffer.  The degrade/rescue lambdas live in
+    (and are inlined into) dispatch/materialise, so the backend-chain
+    fallbacks ride the same proof.
+
+The result is a machine-checked :class:`DonationPlan`: per entry, the
+argnums that are provably dead after dispatch AND large enough to
+matter (>= traceaudit's 16 KiB bound at some audit bucket) become
+``donate``; everything else is pinned live with a reason — and, for
+hazards, the blocking call path embedded, the same counterexample
+shape interleave's violation schedules carry.  The plan is the single
+source of truth: this pass cross-checks the ``donate_argnums``
+literals actually wired on the jit assignments against it and fails on
+drift, traceaudit lowers the audited bodies under it (flipping the
+donation section from honest-zero reporting to an enforced gate), and
+scripts/donation_audit.py diffs the stable view against the committed
+golden.
+
+Pure AST + arithmetic: no jax import, no devices, milliseconds.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from pathlib import Path
+
+from . import DataflowError
+from .lockgraph import _index_module, _package_files, _resolve_call
+from .traceaudit import LARGE_BUFFER_BYTES
+
+#: ``jax.numpy`` constructors that stage a NEW device buffer when fed
+#: host data (the freshness predicate of rule a).  Reductions/ops are
+#: deliberately absent: an op output is fresh too, but the repo's
+#: staging contract is "host numpy in, one constructor per operand" —
+#: anything else deserves a hazard row and a human look.
+_FRESH_CTORS = frozenset({
+    "asarray", "array", "zeros", "ones", "full", "arange",
+    "int8", "int32", "int64", "uint32", "float32",
+})
+
+#: Variable/receiver types the AST cannot see: the retry ladders invoke
+#: the scorer through closure-captured degrader state and a lambda
+#: parameter.  Like lockgraph's ``_ATTR_TYPE_HINTS``, these encode the
+#: package's WIRING CONTRACT (io/pipeline.py routes all scoring through
+#: ``degrader.scorer`` at call time); the vacuous-proof check below
+#: fails the audit if a hint rots and a root stops reaching a staging
+#: site.
+_VAR_TYPE_HINTS: dict[tuple[str, str], str] = {
+    ("io/pipeline.py", "deg.scorer"): "AlignmentScorer",
+    ("io/pipeline.py", "sc"): "AlignmentScorer",
+}
+
+#: Constructor-parameter wiring (attribute assigned from an ``__init__``
+#: parameter): the fleet worker scores through the ChunkPipeline the
+#: serve loop hands it.
+_ATTR_TYPE_HINTS: dict[tuple[str, str, str], str] = {
+    ("serve/fleet.py", "FleetWorker", "pipeline"): "ChunkPipeline",
+}
+
+#: The re-dispatch roots of rule (c): every function that can invoke
+#: the scorer MORE THAN ONCE for the same logical chunk (retry budget,
+#: degrade ladder, breaker bypass, fleet re-claim).  Their rescore
+#: closures are lambdas/nested defs defined inside these bodies, which
+#: the call collector inlines, so the whole ladder is covered.
+_REDISPATCH_ROOTS: tuple[tuple[str, str], ...] = (
+    ("io/pipeline.py", "ChunkPipeline.dispatch"),
+    ("io/pipeline.py", "ChunkPipeline.materialise"),
+    ("serve/fleet.py", "FleetWorker._score_offer"),
+)
+
+#: The chunked-scorer ABI every module-level entry shares (contracts'
+#: ``_chunk_args`` order).  The byte model below prices each position
+#: at the trace-audit buckets; an entry with a different signature
+#: (seeded test packages) has no size model and donates every provably
+#: dead argnum instead.
+_CHUNK_PARAMS = ("seq1ext", "len1", "seq2_chunks", "len2_chunks", "val_flat")
+
+
+def _chunk_arg_bytes(bucket: tuple[int, int, int, int]) -> tuple[int, ...]:
+    """Per-position operand bytes at one (b, nc, l1p, l2p) audit bucket
+    — int32 end to end, mirroring ``contracts._chunk_args``."""
+    b, nc, l1p, l2p = bucket
+    cb = b // nc
+    return (
+        (l1p + l2p + 1) * 4,  # seq1ext
+        4,                    # len1 scalar
+        nc * cb * l2p * 4,    # seq2_chunks rows
+        nc * cb * 4,          # len2_chunks
+        27 * 27 * 4,          # val_flat
+    )
+
+
+# -- plan dataclasses ------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class PinnedArg:
+    """One argnum deliberately left undonated, with its proof."""
+
+    argnum: int
+    name: str
+    kind: str  # "scalar" | "below-threshold" | "alias-hazard"
+    reason: str
+    #: For hazards: the blocking call path (re-dispatch root down to
+    #: the offending site) plus the hazard rows — the counterexample.
+    #: For size pins: the staging sites the decision covers.
+    path: tuple[str, ...] = ()
+
+    def to_json(self) -> dict:
+        return {
+            "argnum": self.argnum,
+            "name": self.name,
+            "kind": self.kind,
+            "reason": self.reason,
+            "path": list(self.path),
+        }
+
+
+@dataclasses.dataclass(frozen=True)
+class EntryPlan:
+    """The donation decision for one module-level jit entry point."""
+
+    module: str
+    wrapper: str
+    body: str
+    params: tuple[str, ...]
+    donate: tuple[int, ...]
+    pinned: tuple[PinnedArg, ...]
+    call_sites: tuple[str, ...]  # "module:qualname" rows, sorted
+    #: The donate_argnums literal actually wired on the jit assignment
+    #: (None = unannotated — a wiring finding AND a SEQ011 finding).
+    wired: tuple[int, ...] | None
+
+    def to_json(self) -> dict:
+        return {
+            "module": self.module,
+            "wrapper": self.wrapper,
+            "body": self.body,
+            "params": list(self.params),
+            "donate": list(self.donate),
+            "wired": None if self.wired is None else list(self.wired),
+            "pinned": [p.to_json() for p in self.pinned],
+            "call_sites": list(self.call_sites),
+        }
+
+
+@dataclasses.dataclass(frozen=True)
+class DonationPlan:
+    """The whole-package donation-safety verdict."""
+
+    entries: tuple[EntryPlan, ...]
+    #: Rule (c) rows: {root, leaf, path, ok}.
+    restage_paths: tuple[dict, ...]
+    #: {kind, entry, detail} rows; empty == the plan is enforceable.
+    findings: tuple[dict, ...]
+
+    def entry_for_body(self, body_name: str) -> EntryPlan | None:
+        for e in self.entries:
+            if e.body == body_name:
+                return e
+        return None
+
+    def donate_for_callable(self, fn) -> tuple[int, ...] | None:
+        """Plan donation for a body callable (functools.partial of a
+        body included); None when the callable is outside the plan
+        (function-local jits below the shard_map/pair seam)."""
+        name = getattr(getattr(fn, "func", fn), "__name__", None)
+        entry = self.entry_for_body(name) if name else None
+        return entry.donate if entry is not None else None
+
+    def to_body(self) -> dict:
+        """The ``kind="donation-audit"`` run-report body."""
+        return {
+            "plan": {
+                "large_buffer_bytes": LARGE_BUFFER_BYTES,
+                "entries": [e.to_json() for e in self.entries],
+            },
+            "restage_paths": [dict(r) for r in self.restage_paths],
+            "findings": [dict(f) for f in self.findings],
+            "counts": {
+                "entries": len(self.entries),
+                "donated_argnums": sum(len(e.donate) for e in self.entries),
+                "pinned": sum(len(e.pinned) for e in self.entries),
+                "restage_paths": len(self.restage_paths),
+                "findings": len(self.findings),
+            },
+        }
+
+
+# -- AST collection --------------------------------------------------------
+
+
+@dataclasses.dataclass
+class _FuncNode:
+    """One function/method with lambdas and nested defs INLINED: their
+    bodies run under the enclosing retry machinery (policy.run invokes
+    the closures), which is exactly the flow rule (c) must see."""
+
+    module: str
+    qualname: str
+    node: ast.AST
+    calls: list = dataclasses.field(default_factory=list)  # (desc, line)
+    #: Lines of device-buffer constructions (jnp.* / jax.device_put).
+    stages: list = dataclasses.field(default_factory=list)
+
+    def key(self) -> tuple[str, str]:
+        return (self.module, self.qualname)
+
+
+def _is_jnp_stage(call: ast.Call) -> bool:
+    func = call.func
+    if not isinstance(func, ast.Attribute):
+        return False
+    root = func.value
+    if isinstance(root, ast.Name) and root.id == "jnp":
+        return True
+    return (
+        isinstance(root, ast.Name)
+        and root.id == "jax"
+        and func.attr == "device_put"
+    )
+
+
+def _dotted(node: ast.AST) -> str | None:
+    """`a.b.c` receiver chains as a dotted string (None when the chain
+    roots in anything but a plain Name)."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if not isinstance(node, ast.Name):
+        return None
+    parts.append(node.id)
+    return ".".join(reversed(parts))
+
+
+def _collect_func(module: str, qualname: str, node: ast.AST) -> _FuncNode:
+    fn = _FuncNode(module, qualname, node)
+    for sub in ast.walk(node):
+        if not isinstance(sub, ast.Call):
+            continue
+        if _is_jnp_stage(sub):
+            fn.stages.append(sub.lineno)
+            continue
+        func = sub.func
+        desc = None
+        if isinstance(func, ast.Name):
+            desc = ("name", func.id)
+        elif isinstance(func, ast.Attribute):
+            base = func.value
+            if isinstance(base, ast.Name) and base.id == "self":
+                desc = ("self", func.attr)
+            elif (
+                isinstance(base, ast.Attribute)
+                and isinstance(base.value, ast.Name)
+                and base.value.id == "self"
+            ):
+                desc = ("selfattr", base.attr, func.attr)
+            elif isinstance(base, ast.Name):
+                desc = ("mod", base.id, func.attr)
+            else:
+                recv = _dotted(base)
+                if recv is not None:
+                    desc = ("varattr", recv, func.attr)
+        if desc is not None:
+            fn.calls.append((desc, sub.lineno))
+    return fn
+
+
+class _Package:
+    """The parsed package: func table (lambda-inlined), module indexes,
+    class table, and the module-level jit assignments."""
+
+    def __init__(self, package_root: str | Path | None = None):
+        if package_root is None:
+            package_root = Path(__file__).resolve().parent.parent
+        self.root = Path(package_root)
+        self.trees: dict[str, ast.Module] = {}
+        self.indexes: dict = {}
+        self.funcs: dict[tuple[str, str], _FuncNode] = {}
+        self.classes: dict = {}
+        for path, rel in _package_files(self.root):
+            try:
+                tree = ast.parse(path.read_text(), filename=str(path))
+            except SyntaxError:
+                continue  # seqlint owns syntax errors
+            self.trees[rel] = tree
+            index = _index_module(rel, tree)
+            self.indexes[rel] = index
+            for (mod, cls, attr), tname in _ATTR_TYPE_HINTS.items():
+                if mod == rel and cls in index.classes:
+                    index.classes[cls].attr_types.setdefault(attr, tname)
+            for cname, cinfo in index.classes.items():
+                self.classes.setdefault(cname, (rel, cinfo))
+            for node in tree.body:
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    fn = _collect_func(rel, node.name, node)
+                    self.funcs[fn.key()] = fn
+                elif isinstance(node, ast.ClassDef):
+                    for stmt in node.body:
+                        if isinstance(
+                            stmt, (ast.FunctionDef, ast.AsyncFunctionDef)
+                        ):
+                            fn = _collect_func(
+                                rel, f"{node.name}.{stmt.name}", stmt
+                            )
+                            self.funcs[fn.key()] = fn
+
+    def resolve(self, desc, module: str, qualname: str):
+        """lockgraph's resolution plus the varattr/type-hint kinds."""
+        kind = desc[0]
+        if kind in ("varattr", "mod"):
+            tname = _VAR_TYPE_HINTS.get((module, desc[1]))
+            if tname is not None and tname in self.classes:
+                home, _ = self.classes[tname]
+                key = (home, f"{tname}.{desc[2]}")
+                if key in self.funcs:
+                    return key
+            if kind == "varattr":
+                return None
+        return _resolve_call(
+            desc, module, qualname, self.indexes, self.classes, self.funcs
+        )
+
+    def reachable(self, start: tuple[str, str]) -> dict:
+        """Func keys reachable from ``start`` (inclusive) -> call path
+        — the same shortest-witness shape lockgraph._reachable emits."""
+        paths = {start: (start,)}
+        frontier = [start]
+        while frontier:
+            cur = frontier.pop()
+            info = self.funcs.get(cur)
+            if info is None:
+                continue
+            for desc, _line in info.calls:
+                callee = self.resolve(desc, info.module, info.qualname)
+                if callee is not None and callee not in paths:
+                    paths[callee] = paths[cur] + (callee,)
+                    frontier.append(callee)
+        return paths
+
+
+# -- module-level jit discovery --------------------------------------------
+
+
+@dataclasses.dataclass
+class _JitEntry:
+    module: str
+    wrapper: str
+    body: str
+    lineno: int
+    wired: tuple[int, ...] | None
+    wired_literal: bool  # False = donate_argnums present but not a literal
+    params: tuple[str, ...]
+
+
+def is_jit_call(value: ast.AST) -> bool:
+    """``jax.jit(...)`` / bare ``jit(...)`` — shared predicate with
+    seqlint's SEQ011 (which re-implements it lexically; keep in step)."""
+    if not isinstance(value, ast.Call):
+        return False
+    func = value.func
+    if isinstance(func, ast.Name):
+        return func.id == "jit"
+    return (
+        isinstance(func, ast.Attribute)
+        and func.attr == "jit"
+        and isinstance(func.value, ast.Name)
+        and func.value.id == "jax"
+    )
+
+
+def _literal_argnums(node: ast.AST) -> tuple[int, ...] | None:
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return (node.value,)
+    if isinstance(node, ast.Tuple) and all(
+        isinstance(e, ast.Constant) and isinstance(e.value, int)
+        for e in node.elts
+    ):
+        return tuple(e.value for e in node.elts)
+    return None
+
+
+def _jit_entries(pkg: _Package) -> list[_JitEntry]:
+    entries: list[_JitEntry] = []
+    for rel, tree in sorted(pkg.trees.items()):
+        defs = {
+            n.name: n
+            for n in tree.body
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+        }
+        for node in tree.body:
+            if not (
+                isinstance(node, ast.Assign)
+                and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and is_jit_call(node.value)
+            ):
+                continue
+            call = node.value
+            if not call.args or not isinstance(call.args[0], ast.Name):
+                continue  # jit of a non-Name (lambda/partial): no body
+            body = call.args[0].id
+            wired = None
+            wired_literal = True
+            for kw in call.keywords:
+                if kw.arg == "donate_argnums":
+                    wired = _literal_argnums(kw.value)
+                    wired_literal = wired is not None
+            params: tuple[str, ...] = ()
+            bdef = defs.get(body)
+            if bdef is not None:
+                params = tuple(
+                    a.arg for a in bdef.args.posonlyargs + bdef.args.args
+                )
+            entries.append(_JitEntry(
+                module=rel,
+                wrapper=node.targets[0].id,
+                body=body,
+                lineno=node.lineno,
+                wired=wired,
+                wired_literal=wired_literal,
+                params=params,
+            ))
+    return entries
+
+
+# -- call-site staging / liveness ------------------------------------------
+
+
+@dataclasses.dataclass
+class _CallSite:
+    module: str
+    qualname: str
+    line: int
+    wrappers: tuple[tuple[str, str], ...]  # jit entries invoked here
+    n_args: int
+    fresh: tuple[bool, ...]
+    hazards: tuple[str, ...]  # staging hazards, human rows
+    reused: tuple[str, ...]  # post-call reads of the staged holder
+
+    def site(self) -> str:
+        return f"{self.module}:{self.qualname}"
+
+    def ok(self, argnum: int) -> bool:
+        return (
+            not self.reused
+            and argnum < self.n_args
+            and self.fresh[argnum]
+        )
+
+
+def _parent_map(root: ast.AST) -> dict:
+    parents: dict = {}
+    for node in ast.walk(root):
+        for child in ast.iter_child_nodes(node):
+            parents[child] = node
+    return parents
+
+
+def _resolves_to_wrapper(name: str, module: str, pkg: _Package, wrappers):
+    """Map a Name in ``module`` to a jit-entry key (module, wrapper)."""
+    if (module, name) in wrappers:
+        return (module, name)
+    imp = pkg.indexes[module].from_imports.get(name)
+    if imp is not None and imp[0] is not None and tuple(imp) in wrappers:
+        return tuple(imp)
+    return None
+
+
+def _returner_map(pkg: _Package, wrappers) -> tuple[dict, list]:
+    """Functions whose returns can hand a jit wrapper to the caller
+    (``resolve_xla_formulation``, ``aot.compile._target``): func key ->
+    set of wrapper keys.  A wrapper passed positionally into a partial
+    would shift argnums — flagged, never silently supported."""
+    out: dict = {}
+    findings: list[dict] = []
+    for key, fn in pkg.funcs.items():
+        returned: set = set()
+        for sub in ast.walk(fn.node):
+            if not isinstance(sub, ast.Return) or sub.value is None:
+                continue
+            parents = None
+            for leaf in ast.walk(sub.value):
+                if not isinstance(leaf, ast.Name):
+                    continue
+                wkey = _resolves_to_wrapper(
+                    leaf.id, fn.module, pkg, wrappers
+                )
+                if wkey is None:
+                    continue
+                if parents is None:
+                    parents = _parent_map(sub.value)
+                par = parents.get(leaf)
+                if (
+                    isinstance(par, ast.Call)
+                    and par.args
+                    and par.args[0] is leaf
+                    and len(par.args) > 1
+                    and isinstance(par.func, (ast.Name, ast.Attribute))
+                    and (
+                        getattr(par.func, "id", None) == "partial"
+                        or getattr(par.func, "attr", None) == "partial"
+                    )
+                ):
+                    findings.append({
+                        "kind": "positional-partial",
+                        "entry": f"{wkey[0]}:{wkey[1]}",
+                        "detail": (
+                            f"{fn.module}:{fn.qualname}:{leaf.lineno} "
+                            "returns a POSITIONAL functools.partial of a "
+                            "jit entry — the bound args shift every "
+                            "argnum and the plan cannot map donation "
+                            "through it; bind by keyword instead"
+                        ),
+                    })
+                    continue
+                returned.add(wkey)
+        if returned:
+            out[key] = returned
+    return out, findings
+
+
+def _fresh_providers(pkg: _Package) -> dict:
+    """Functions whose every return is a Tuple of fresh jnp
+    constructions (``aot.compile._concrete_args``): func key -> arity."""
+    out: dict = {}
+    for key, fn in pkg.funcs.items():
+        arity = None
+        for sub in ast.walk(fn.node):
+            if not isinstance(sub, ast.Return) or sub.value is None:
+                continue
+            if not (
+                isinstance(sub.value, ast.Tuple)
+                and sub.value.elts
+                and all(
+                    isinstance(e, ast.Call)
+                    and _is_jnp_stage(e)
+                    and isinstance(e.func, ast.Attribute)
+                    and e.func.attr in _FRESH_CTORS
+                    for e in sub.value.elts
+                )
+            ):
+                arity = None
+                break
+            n = len(sub.value.elts)
+            if arity is not None and arity != n:
+                arity = None
+                break
+            arity = n
+        if arity is not None:
+            out[key] = arity
+    return out
+
+
+def _device_locals(fn_node: ast.AST) -> set[str]:
+    """Names assigned from a jnp construction anywhere in the function:
+    feeding one back into ``jnp.asarray`` would alias, not stage."""
+    out: set[str] = set()
+    for sub in ast.walk(fn_node):
+        if isinstance(sub, ast.Assign) and isinstance(sub.value, ast.Call):
+            if _is_jnp_stage(sub.value):
+                for tgt in sub.targets:
+                    if isinstance(tgt, ast.Name):
+                        out.add(tgt.id)
+    return out
+
+
+def _expr_fresh(expr: ast.AST, device_names: set[str]) -> str | None:
+    """None when ``expr`` stages a fresh device buffer; else the hazard
+    description."""
+    if not (isinstance(expr, ast.Call) and _is_jnp_stage(expr)):
+        return (
+            f"operand is not a jnp staging construction "
+            f"({ast.dump(expr)[:60]}...)"
+        )
+    func = expr.func
+    if isinstance(func, ast.Attribute) and func.attr not in _FRESH_CTORS:
+        if func.attr == "device_put":
+            return None
+        return f"jnp.{func.attr} is not a recognised staging constructor"
+    for leaf in ast.walk(expr):
+        if isinstance(leaf, ast.Name) and leaf.id in device_names:
+            return (
+                f"operand built from device-typed local {leaf.id!r} — "
+                "jnp.asarray on a device array aliases instead of staging"
+            )
+    return None
+
+
+def _reads_after(
+    fn_node: ast.AST, call: ast.Call, holders: set[str], parents: dict
+) -> list[str]:
+    """Reads of ``holders`` that can execute AFTER ``call``: statements
+    following the call's statement chain in each enclosing block, plus
+    — when the call sits in a loop whose holder assignment is outside
+    that loop — any read in the loop at all (the next iteration)."""
+    rows: list[str] = []
+
+    def loads_in(node: ast.AST, skip: ast.AST | None = None):
+        for sub in ast.walk(node):
+            if sub is skip:
+                continue
+            if (
+                isinstance(sub, ast.Name)
+                and isinstance(sub.ctx, ast.Load)
+                and sub.id in holders
+            ):
+                yield sub
+
+    # Assignment lines of each holder (for the loop rule).
+    assign_lines: dict[str, int] = {}
+    for sub in ast.walk(fn_node):
+        if isinstance(sub, ast.Assign):
+            for tgt in sub.targets:
+                for leaf in ast.walk(tgt):
+                    if isinstance(leaf, ast.Name) and leaf.id in holders:
+                        assign_lines.setdefault(leaf.id, sub.lineno)
+
+    node: ast.AST = call
+    while node is not fn_node:
+        parent = parents.get(node)
+        if parent is None:
+            break
+        if isinstance(parent, (ast.For, ast.While, ast.AsyncFor)):
+            for h in holders:
+                line = assign_lines.get(h)
+                staged_inside = (
+                    line is not None
+                    and parent.lineno <= line <= parent.end_lineno
+                )
+                if not staged_inside:
+                    rows.append(
+                        f"call at line {call.lineno} sits in a loop "
+                        f"(line {parent.lineno}) but {h!r} is staged "
+                        "outside it: the next iteration re-reads "
+                        "donated buffers"
+                    )
+        for field in ("body", "orelse", "finalbody"):
+            block = getattr(parent, field, None)
+            if not isinstance(block, list) or node not in block:
+                continue
+            after = block[block.index(node) + 1:]
+            for stmt in after:
+                for leaf in loads_in(stmt):
+                    rows.append(
+                        f"{sorted(holders & {leaf.id})[0]!s} re-read at "
+                        f"line {leaf.lineno} after the donating call at "
+                        f"line {call.lineno}"
+                    )
+        node = parent
+    return rows
+
+
+def _call_sites(pkg: _Package, wrappers, returners) -> list[_CallSite]:
+    providers = _fresh_providers(pkg)
+    sites: list[_CallSite] = []
+    for key, fn in pkg.funcs.items():
+        parents = None
+        bindings: dict[str, set] = {}  # local name -> wrapper keys
+        tuple_assigns: dict[str, ast.AST] = {}  # name -> value expr
+        for sub in ast.walk(fn.node):
+            if isinstance(sub, ast.Assign) and len(sub.targets) == 1:
+                tgt = sub.targets[0]
+                if isinstance(tgt, ast.Name):
+                    tuple_assigns.setdefault(tgt.id, sub.value)
+                if isinstance(sub.value, ast.Call) and isinstance(
+                    sub.value.func, ast.Name
+                ):
+                    callee = pkg.resolve(
+                        ("name", sub.value.func.id), fn.module, fn.qualname
+                    )
+                    if callee in returners:
+                        names = (
+                            [tgt]
+                            if isinstance(tgt, ast.Name)
+                            else list(getattr(tgt, "elts", []))
+                        )
+                        for n in names:
+                            if isinstance(n, ast.Name):
+                                bindings.setdefault(n.id, set()).update(
+                                    returners[callee]
+                                )
+        device_names = _device_locals(fn.node)
+        for sub in ast.walk(fn.node):
+            if not isinstance(sub, ast.Call):
+                continue
+            func = sub.func
+            wkeys: set = set()
+            if isinstance(func, ast.Name):
+                w = _resolves_to_wrapper(func.id, fn.module, pkg, wrappers)
+                if w is not None:
+                    wkeys.add(w)
+                wkeys.update(bindings.get(func.id, ()))
+            elif isinstance(func, ast.Call) and isinstance(
+                func.func, ast.Name
+            ):
+                callee = pkg.resolve(
+                    ("name", func.func.id), fn.module, fn.qualname
+                )
+                if callee in returners:
+                    wkeys.update(returners[callee])
+            if not wkeys:
+                continue
+            # Positional operand exprs + the holder name to track.
+            holders: set[str] = set()
+            hazards: list[str] = []
+            fresh: list[bool] = []
+            if (
+                len(sub.args) == 1
+                and isinstance(sub.args[0], ast.Starred)
+                and isinstance(sub.args[0].value, ast.Name)
+            ):
+                hname = sub.args[0].value.id
+                holders.add(hname)
+                value = tuple_assigns.get(hname)
+                if isinstance(value, ast.Tuple):
+                    for e in value.elts:
+                        why = _expr_fresh(e, device_names)
+                        fresh.append(why is None)
+                        if why is not None:
+                            hazards.append(
+                                f"arg{len(fresh) - 1}: {why} "
+                                f"(line {e.lineno})"
+                            )
+                elif isinstance(value, ast.Call) and isinstance(
+                    value.func, ast.Name
+                ):
+                    callee = pkg.resolve(
+                        ("name", value.func.id), fn.module, fn.qualname
+                    )
+                    if callee in providers:
+                        fresh = [True] * providers[callee]
+                    else:
+                        hazards.append(
+                            f"*{hname} built by "
+                            f"{value.func.id}(), which is not a proven "
+                            "fresh-staging helper"
+                        )
+                else:
+                    hazards.append(
+                        f"*{hname} has no visible tuple construction in "
+                        "this function"
+                    )
+            else:
+                for i, e in enumerate(sub.args):
+                    if isinstance(e, ast.Starred):
+                        hazards.append(f"arg{i}: unresolvable *star operand")
+                        fresh.append(False)
+                        continue
+                    if isinstance(e, ast.Name):
+                        src = tuple_assigns.get(e.id)
+                        why = (
+                            _expr_fresh(src, device_names)
+                            if src is not None
+                            else "no visible staging assignment"
+                        )
+                        holders.add(e.id)
+                    else:
+                        why = _expr_fresh(e, device_names)
+                    fresh.append(why is None)
+                    if why is not None:
+                        hazards.append(f"arg{i}: {why} (line {e.lineno})")
+            if parents is None:
+                parents = _parent_map(fn.node)
+            reused = (
+                _reads_after(fn.node, sub, holders, parents)
+                if holders
+                else []
+            )
+            sites.append(_CallSite(
+                module=fn.module,
+                qualname=fn.qualname,
+                line=sub.lineno,
+                wrappers=tuple(sorted(wkeys)),
+                n_args=len(fresh),
+                fresh=tuple(fresh),
+                hazards=tuple(hazards),
+                reused=tuple(reused),
+            ))
+    return sites
+
+
+# -- the plan --------------------------------------------------------------
+
+
+def _max_arg_bytes(params: tuple[str, ...]) -> tuple[int, ...] | None:
+    """Max per-position operand bytes over the trace-audit buckets for
+    the chunked-scorer ABI; None for foreign signatures."""
+    if params != _CHUNK_PARAMS:
+        return None
+    from .contracts import _AUDIT_BUCKETS
+
+    per_bucket = [_chunk_arg_bytes(b) for b in _AUDIT_BUCKETS]
+    return tuple(max(col) for col in zip(*per_bucket))
+
+
+def _plan_entry(
+    entry: _JitEntry, sites: list[_CallSite], root_paths: dict
+) -> tuple[EntryPlan, list[dict]]:
+    findings: list[dict] = []
+    ekey = (entry.module, entry.wrapper)
+    mine = [s for s in sites if ekey in s.wrappers]
+    name = f"{entry.module}:{entry.wrapper}"
+    nparams = len(entry.params)
+    max_bytes = _max_arg_bytes(entry.params)
+
+    def blocking_path(site: _CallSite) -> list[str]:
+        rows = []
+        fkey = (site.module, site.qualname)
+        for root, paths in root_paths.items():
+            if fkey in paths:
+                rows.append(
+                    " -> ".join(f"{m}:{q}" for m, q in paths[fkey])
+                )
+                break
+        rows.extend(site.hazards)
+        rows.extend(site.reused)
+        return rows
+
+    donate: list[int] = []
+    pinned: list[PinnedArg] = []
+    for argnum in range(nparams):
+        pname = entry.params[argnum]
+        bad = [s for s in mine if not s.ok(argnum)]
+        if bad:
+            site = bad[0]
+            pinned.append(PinnedArg(
+                argnum=argnum,
+                name=pname,
+                kind="alias-hazard",
+                reason=(
+                    f"not provably dead at "
+                    f"{site.site()}:{site.line} — donation would delete "
+                    "a buffer the caller still reads"
+                ),
+                path=tuple(
+                    [f"{site.site()}:{site.line}"] + blocking_path(site)
+                ),
+            ))
+            continue
+        nbytes = max_bytes[argnum] if max_bytes is not None else None
+        if nbytes is not None and nbytes < LARGE_BUFFER_BYTES:
+            kind = "scalar" if nbytes <= 8 else "below-threshold"
+            reason = (
+                "0-d scalar operand: nothing to reclaim"
+                if kind == "scalar"
+                else (
+                    f"provably dead but max {nbytes / 1024:.1f} KiB over "
+                    f"the audit buckets, under the "
+                    f"{LARGE_BUFFER_BYTES / 1024:.0f} KiB large-buffer "
+                    "bound: donating reclaims no material HBM while "
+                    "costing an unusable-donation warning per compile "
+                    "on backends that cannot alias it"
+                )
+            )
+            pinned.append(PinnedArg(
+                argnum=argnum,
+                name=pname,
+                kind=kind,
+                reason=reason,
+                path=tuple(
+                    sorted({f"{s.site()}:{s.line}" for s in mine})
+                ),
+            ))
+            continue
+        donate.append(argnum)
+
+    if not mine:
+        findings.append({
+            "kind": "no-call-sites",
+            "entry": name,
+            "detail": (
+                "no package call site of this jit entry resolved — the "
+                "call-site discovery (or a _VAR_TYPE_HINTS row) rotted; "
+                "a plan proven against zero sites proves nothing"
+            ),
+        })
+    wired = entry.wired
+    if not entry.wired_literal:
+        findings.append({
+            "kind": "wiring-drift",
+            "entry": name,
+            "detail": (
+                f"{entry.module}:{entry.lineno} wires donate_argnums "
+                "with a non-literal expression: the plan cannot "
+                "cross-check it — spell the argnums as a literal tuple"
+            ),
+        })
+    elif tuple(wired or ()) != tuple(donate):
+        findings.append({
+            "kind": "wiring-drift",
+            "entry": name,
+            "detail": (
+                f"{entry.module}:{entry.lineno} wires donate_argnums="
+                f"{wired!r} but the proof says {tuple(donate)!r}: wire "
+                "exactly the provably-dead large argnums (analysis/"
+                "dataflow.py is the single source)"
+            ),
+        })
+    plan = EntryPlan(
+        module=entry.module,
+        wrapper=entry.wrapper,
+        body=entry.body,
+        params=entry.params,
+        donate=tuple(donate),
+        pinned=tuple(pinned),
+        call_sites=tuple(sorted({s.site() for s in mine})),
+        wired=wired,
+    )
+    return plan, findings
+
+
+def _restage_rows(
+    pkg: _Package, sites: list[_CallSite], roots
+) -> tuple[list[dict], list[dict], dict]:
+    """Rule (c): every re-dispatch root must reach at least one staging
+    leaf, and every function on the witness path except the leaf must
+    stage nothing."""
+    rows: list[dict] = []
+    findings: list[dict] = []
+    leaves = {(s.module, s.qualname) for s in sites}
+    root_paths: dict = {}
+    for root in roots:
+        rname = f"{root[0]}:{root[1]}"
+        if root not in pkg.funcs:
+            findings.append({
+                "kind": "restage-root-missing",
+                "entry": rname,
+                "detail": (
+                    "re-dispatch root no longer exists — update "
+                    "_REDISPATCH_ROOTS in analysis/dataflow.py"
+                ),
+            })
+            continue
+        paths = pkg.reachable(root)
+        root_paths[root] = paths
+        reached = sorted(leaves & set(paths))
+        if not reached:
+            findings.append({
+                "kind": "restage-unproven",
+                "entry": rname,
+                "detail": (
+                    "re-dispatch root reaches NO staging site through "
+                    "the resolved call graph: either the retry ladder "
+                    "stopped scoring (real bug) or a _VAR_TYPE_HINTS "
+                    "row rotted (fix the hint) — a vacuous proof fails "
+                    "closed"
+                ),
+            })
+            continue
+        for leaf in reached:
+            path = paths[leaf]
+            stagers = [
+                f for f in path[:-1] if pkg.funcs[f].stages
+            ]
+            ok = not stagers
+            rows.append({
+                "root": rname,
+                "leaf": f"{leaf[0]}:{leaf[1]}",
+                "path": [f"{m}:{q}" for m, q in path],
+                "ok": ok,
+            })
+            for f in stagers:
+                lines = pkg.funcs[f].stages
+                findings.append({
+                    "kind": "stage-above-retry",
+                    "entry": rname,
+                    "detail": (
+                        f"{f[0]}:{f[1]} stages device buffers (line "
+                        f"{lines[0]}) ABOVE the staging leaf on the "
+                        "re-dispatch path "
+                        + " -> ".join(f"{m}:{q}" for m, q in path)
+                        + ": a retry would re-read them after donation "
+                        "— keep every operand host-side until the leaf"
+                    ),
+                })
+    return rows, findings, root_paths
+
+
+def build_plan(
+    package_root: str | Path | None = None,
+    *,
+    redispatch_roots=_REDISPATCH_ROOTS,
+) -> DonationPlan:
+    """Run the whole pass and return the :class:`DonationPlan`.
+
+    ``redispatch_roots`` exists for seeded-violation tests walking a
+    synthetic package tree; production callers always audit the real
+    roots."""
+    pkg = _Package(package_root)
+    entries = _jit_entries(pkg)
+    wrappers = {(e.module, e.wrapper) for e in entries}
+    returners, findings = _returner_map(pkg, wrappers)
+    sites = _call_sites(pkg, wrappers, returners)
+    restage, rfindings, root_paths = _restage_rows(
+        pkg, sites, redispatch_roots
+    )
+    findings.extend(rfindings)
+    plans: list[EntryPlan] = []
+    for entry in sorted(entries, key=lambda e: (e.module, e.wrapper)):
+        plan, efindings = _plan_entry(entry, sites, root_paths)
+        plans.append(plan)
+        findings.extend(efindings)
+    return DonationPlan(
+        entries=tuple(plans),
+        restage_paths=tuple(restage),
+        findings=tuple(
+            sorted(findings, key=lambda f: (f["kind"], f["entry"]))
+        ),
+    )
+
+
+_PLAN_CACHE: dict = {}
+
+
+def donation_plan() -> DonationPlan:
+    """The cached plan for the installed package tree (traceaudit and
+    the dispatch-side consumers ask per lowering; the AST walk runs
+    once per process)."""
+    plan = _PLAN_CACHE.get("plan")
+    if plan is None:
+        plan = _PLAN_CACHE["plan"] = build_plan()
+    return plan
+
+
+def audit_dataflow(package_root: str | Path | None = None) -> dict:
+    """The full audit report body (never raises on findings)."""
+    return build_plan(package_root).to_body()
+
+
+def run_or_raise(package_root: str | Path | None = None) -> dict:
+    """Driver entry: build the plan, raise :class:`DataflowError` on
+    findings, return the report body when clean."""
+    body = audit_dataflow(package_root)
+    if body["findings"]:
+        rows = "\n  ".join(
+            f"[{f['kind']}] {f['entry']}: {f['detail']}"
+            for f in body["findings"]
+        )
+        raise DataflowError(
+            f"dataflow: {len(body['findings'])} finding(s):\n  {rows}"
+        )
+    return body

@@ -61,6 +61,14 @@ SEQ010   no blocking operation lexically inside a ``with <lock>:`` body
          stalls every thread that contends it — the lexical twin of the
          transitive reachability audit in ``analysis/lockgraph.py``
          (rule b), cheap enough to run on every ``make analyze``.
+SEQ011   every module-level ``jax.jit(...)`` assignment declares its
+         donation policy explicitly: either ``donate_argnums=...``
+         (cross-checked against the proven DonationPlan by
+         ``analysis/dataflow.py``) or a ``# nodonate: <reason>`` marker
+         on the assignment saying why nothing can be donated.  An
+         unannotated jit entry is a silent donation-coverage hole — the
+         drift that kept the chunk pipeline at zero donation from PR 2
+         through PR 12.
 =======  ==================================================================
 
 Suppression: append ``# seqlint: disable=SEQ00N`` to the offending line
@@ -134,6 +142,10 @@ _MODULE_CLASSES: dict[str, tuple[str, ...]] = {
     # discipline (SEQ008) even though they live under obs/.
     "obs/trace.py": (ROLE_SERVE,),
     "obs/flightrec.py": (ROLE_SERVE,),
+    # The donation-safety dataflow pass: pure host-side AST walking
+    # (explicit row because its plan is what SEQ011's annotations are
+    # cross-checked against — the pass and the rule land together).
+    "analysis/dataflow.py": (ROLE_HOST,),
     # -- directory defaults ------------------------------------------------
     # The AOT warm plane is host-side orchestration whose diagnostics
     # ride the event bus; its timers (compile walls) are measurements,
@@ -218,6 +230,10 @@ _SEQ010_OS_ATTRS = (
 _SUPPRESS_RE = re.compile(r"#\s*seqlint:\s*disable=([A-Z0-9, ]+)")
 _SUPPRESS_FILE_RE = re.compile(r"#\s*seqlint:\s*disable-file=([A-Z0-9, ]+)")
 
+#: SEQ011's explicit opt-out: the marker must carry a non-empty reason
+#: (a bare ``# nodonate:`` documents nothing and stays a finding).
+_NODONATE_RE = re.compile(r"#\s*nodonate:\s*(\S.*)?$")
+
 
 @dataclasses.dataclass(frozen=True)
 class LintFinding:
@@ -280,6 +296,9 @@ class _Linter(ast.NodeVisitor):
         self.rel = rel
         self.findings: list[LintFinding] = []
         self.per_line, self.file_level = _suppressions(source)
+        # SEQ011 reads the source text of multi-line jit assignments
+        # for the `# nodonate:` marker — AST nodes drop comments.
+        self._lines = source.splitlines()
         self.scopes: list[_Scope] = []
         # Every rule's scope derives from the one classification
         # registry — path predicates may not be re-derived ad hoc here
@@ -367,7 +386,61 @@ class _Linter(ast.NodeVisitor):
                 "registry; add it (traced / deterministic / instrumented "
                 "/ serve-plane / host) so the rule scopes cover it",
             )
+        for stmt in node.body:
+            self._check_jit_donation(stmt)
         self.generic_visit(node)
+
+    # -- SEQ011: module-level jit entries declare donation -----------------
+
+    @staticmethod
+    def _is_jit_call(value: ast.AST) -> bool:
+        """``jax.jit(...)`` or bare ``jit(...)`` — the module-level
+        entry-point shape analysis/dataflow.py plans donation for."""
+        if not isinstance(value, ast.Call):
+            return False
+        func = value.func
+        if isinstance(func, ast.Name):
+            return func.id == "jit"
+        return (
+            isinstance(func, ast.Attribute)
+            and func.attr == "jit"
+            and isinstance(func.value, ast.Name)
+            and func.value.id == "jax"
+        )
+
+    def _check_jit_donation(self, stmt: ast.stmt):
+        if not (
+            isinstance(stmt, ast.Assign)
+            and self._is_jit_call(stmt.value)
+        ):
+            return
+        if any(
+            kw.arg == "donate_argnums" for kw in stmt.value.keywords
+        ):
+            return
+        end = getattr(stmt, "end_lineno", stmt.lineno)
+        for text in self._lines[stmt.lineno - 1 : end]:
+            m = _NODONATE_RE.search(text)
+            if m is None:
+                continue
+            if m.group(1):
+                return  # marker with a reason: explicit opt-out
+            self._emit(
+                "SEQ011",
+                stmt,
+                "bare `# nodonate:` marker with no reason — say WHY "
+                "this jit entry cannot donate (aliasing hazard, "
+                "scalar-only operands, ...) so the opt-out is auditable",
+            )
+            return
+        self._emit(
+            "SEQ011",
+            stmt,
+            "module-level jax.jit assignment declares no donation "
+            "policy: wire donate_argnums=... from the DonationPlan "
+            "(analysis/dataflow.py) or mark the assignment "
+            "`# nodonate: <reason>`",
+        )
 
     # -- SEQ008: serve-plane shared state under its lock -------------------
 

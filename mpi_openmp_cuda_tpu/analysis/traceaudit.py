@@ -11,10 +11,12 @@ and 5 are about:
 * **Donation coverage** — every large input buffer that is NOT donated
   (``jax.jit``'s ``donate_argnums`` / ``tf.aliasing_output``) forces
   XLA to keep input and output alive simultaneously; on the chunk
-  pipeline that is the rows/chunks arrays every launch.  The audit
-  LISTS each un-donated large buffer per entry point — the honest
-  current state is zero donation, and the report says so rather than
-  silently passing (the acceptance bar).
+  pipeline that is the rows/chunks arrays every launch.  Each audited
+  body is lowered under the :class:`~.dataflow.DonationPlan`'s argnums
+  and the gate is ENFORCED: an un-donated large buffer fails the audit
+  unless the plan explicitly pins it live (scalar / below-threshold /
+  alias-hazard, or a function-local jit outside the plan's
+  module-level scope) — pinned rows are listed with their reason.
 * **Implicit transfers / widenings** — ``device_put`` equations in a
   supposedly device-resident body, and ``convert_element_type``
   equations that WIDEN (target itemsize > source): each widening in a
@@ -69,10 +71,12 @@ class EntryTraceReport:
     bucket: tuple  # (b, nc, l1p, l2p)
     n_args: int
     large_buffers: tuple  # BufferInfo rows (nbytes >= threshold)
-    undonated_large: tuple  # the subset with donated=False
+    undonated_large: tuple  # undonated AND not pinned by the plan
     convert_widenings: int
     device_puts: int
     pallas_calls: int
+    donate_argnums: tuple = ()  # the DonationPlan argnums lowered under
+    pinned_live: tuple = ()  # "describe — reason" rows the plan pins
 
     @property
     def donation_covered(self) -> bool:
@@ -151,15 +155,51 @@ def buffer_infos(fn, *args, donate_argnums=()) -> list:
     return infos
 
 
+def _plan_for(fn):
+    """The DonationPlan entry governing ``fn`` (a body callable or a
+    functools.partial of one), or None when the callable sits outside
+    the plan's module-level scope (function-local jits: the shard_map
+    per-shard fn, the pallas pair scorer)."""
+    from .dataflow import donation_plan
+
+    name = getattr(getattr(fn, "func", fn), "__name__", None)
+    return donation_plan().entry_for_body(name) if name else None
+
+
+def _split_undonated(large, entry_plan):
+    """Partition un-donated large buffers into (violations, pinned
+    rows): the plan's pinned argnums — and everything on an out-of-plan
+    entry — are listed with their reason instead of failing the gate."""
+    undonated = [i for i in large if not i.donated]
+    if entry_plan is None:
+        return (), tuple(
+            f"{i.describe()} — no module-level donation plan entry "
+            "(function-local jit)"
+            for i in undonated
+        )
+    pins = {p.argnum: p for p in entry_plan.pinned}
+    violations, pinned = [], []
+    for info in undonated:
+        pin = pins.get(info.index)
+        if pin is not None:
+            pinned.append(f"{info.describe()} — {pin.reason}")
+        else:
+            violations.append(info)
+    return tuple(violations), tuple(pinned)
+
+
 def trace_entry(
     contract, bucket, threshold: int = LARGE_BUFFER_BYTES
 ) -> EntryTraceReport:
     """Lower one :class:`~.contracts.EntryContract` at one audit bucket
+    — under the DonationPlan's argnums when the body has a plan entry —
     and collect its :class:`EntryTraceReport`."""
     b, nc, l1p, l2p = bucket
     fn, args = contract.make(b, nc, l1p, l2p)
+    entry_plan = _plan_for(fn)
+    donate = entry_plan.donate if entry_plan is not None else ()
     try:
-        infos = buffer_infos(fn, *args)
+        infos = buffer_infos(fn, *args, donate_argnums=donate)
         counts = walk_counts(fn, *args)
     except Exception as exc:  # noqa: BLE001 - re-raise with context
         raise TraceAuditError(
@@ -167,15 +207,18 @@ def trace_entry(
             f"l1p={l1p}, l2p={l2p}): {exc!r}"
         ) from exc
     large = tuple(i for i in infos if i.nbytes >= threshold)
+    violations, pinned = _split_undonated(large, entry_plan)
     return EntryTraceReport(
         entry=contract.name,
         bucket=tuple(bucket),
         n_args=len(infos),
         large_buffers=large,
-        undonated_large=tuple(i for i in large if not i.donated),
+        undonated_large=violations,
         convert_widenings=counts["convert_widenings"],
         device_puts=counts["device_puts"],
         pallas_calls=counts["pallas_calls"],
+        donate_argnums=tuple(donate),
+        pinned_live=pinned,
     )
 
 
@@ -200,6 +243,16 @@ def audit_entry_points(buckets=None, threshold: int = LARGE_BUFFER_BYTES):
                     "be device-resident — hoist the transfer to the "
                     "dispatch boundary (ops/dispatch.py)"
                 )
+            if rep.undonated_large:
+                rows = "; ".join(i.describe() for i in rep.undonated_large)
+                raise TraceAuditError(
+                    f"{rep.entry} at bucket {rep.bucket} has "
+                    f"{len(rep.undonated_large)} un-donated large "
+                    f"buffer(s) the DonationPlan neither donates nor pins "
+                    f"live: {rows} — extend analysis/dataflow.py's plan "
+                    "(donate it if provably dead, pin it with a reason if "
+                    "not) rather than relaxing this gate"
+                )
             reports.append(rep)
     return reports
 
@@ -219,8 +272,9 @@ def audit_schedule(problem, backend: str = "pallas") -> dict:
     _, sched = production_schedule(problem, backend)
     cfgs = kernel_configs(problem, backend, buckets=True)
     rows = []
-    total_undonated = 0
     total_large = 0
+    total_donated = 0
+    all_pinned: list = []
     for i, part in enumerate(sched):
         batch = part["batch"]
         body = part["body"]
@@ -241,9 +295,11 @@ def audit_schedule(problem, backend: str = "pallas") -> dict:
             jax.ShapeDtypeStruct((1, cb), np.int32),
             jax.ShapeDtypeStruct((27 * 27,), np.int32),
         )
+        entry_plan = _plan_for(body)
+        donate = entry_plan.donate if entry_plan is not None else ()
         try:
             counts = walk_counts(body, *args)
-            infos = buffer_infos(body, *args)
+            infos = buffer_infos(body, *args, donate_argnums=donate)
         except Exception as exc:  # noqa: BLE001 - re-raise with context
             raise TraceAuditError(
                 f"schedule bucket {i} (l1p={batch.l1p}, l2p={batch.l2p}, "
@@ -260,9 +316,19 @@ def audit_schedule(problem, backend: str = "pallas") -> dict:
                 "with the kernel restructuring"
             )
         large = [b for b in infos if b.nbytes >= LARGE_BUFFER_BYTES]
-        undonated = [b.describe() for b in large if not b.donated]
+        violations, pinned = _split_undonated(large, entry_plan)
+        if violations:
+            vrows = "; ".join(v.describe() for v in violations)
+            raise TraceAuditError(
+                f"schedule bucket {i} (l1p={batch.l1p}, l2p={batch.l2p}) "
+                f"has {len(violations)} un-donated large buffer(s) the "
+                f"DonationPlan neither donates nor pins live: {vrows} — "
+                "extend analysis/dataflow.py's plan rather than relaxing "
+                "this gate"
+            )
         total_large += len(large)
-        total_undonated += len(undonated)
+        total_donated += sum(1 for b in large if b.donated)
+        all_pinned.extend(pinned)
         rows.append(
             {
                 "bucket": i,
@@ -274,7 +340,11 @@ def audit_schedule(problem, backend: str = "pallas") -> dict:
                 "convert_widenings": counts["convert_widenings"],
                 "device_puts": counts["device_puts"],
                 "large_buffers": len(large),
-                "undonated_large_buffers": undonated,
+                "donate_argnums": list(donate),
+                "undonated_large_buffers": [
+                    v.describe() for v in violations
+                ],
+                "pinned_live": list(pinned),
             }
         )
         del lens_arr
@@ -288,7 +358,10 @@ def audit_schedule(problem, backend: str = "pallas") -> dict:
         "launches": int(sum(r["chunks"] for r in rows)),
         "donation": {
             "large_buffers": total_large,
-            "undonated_large_buffers": total_undonated,
-            "covered": total_undonated == 0,
+            "donated_large_buffers": total_donated,
+            "undonated_large_buffers": total_large - total_donated
+            - len(all_pinned),
+            "pinned_live": list(all_pinned),
+            "covered": total_large == total_donated + len(all_pinned),
         },
     }

@@ -86,7 +86,15 @@ class ChunkPipeline:
         synchronous rescore — MaterialisedRows keeps the promise
         contract for :meth:`materialise`.  ``links`` is the list of
         request ids riding this launch (serve mode; None in batch/
-        stream), recorded on the trace plane's launch span."""
+        stream), recorded on the trace plane's launch span.
+
+        Donation anchor: ``seq1_codes``/``codes`` stay HOST arrays all
+        the way down this ladder — every (re)dispatch re-stages fresh
+        device buffers at ``AlignmentScorer._score_local``, which is
+        what lets the jit entry points donate their operands.  Staging
+        here (above the retry boundary) would hand a retried attempt an
+        already-donated buffer; ``make donation-audit`` flags exactly
+        that (restage_paths / stage-above-retry)."""
         deg = self.degrader
         if self.breaker is not None and self.breaker.bypass_primary():
             # Breaker open: straight to the pinned degraded backend.
@@ -130,7 +138,8 @@ class ChunkPipeline:
     def materialise(self, promise, seq1_codes, codes, weights, budget):
         """Materialise under the chunk's shared budget (first attempt
         forces the promise, retries rescore synchronously), degrading
-        past exhaustion like :meth:`dispatch`."""
+        past exhaustion like :meth:`dispatch`.  Same donation anchor as
+        :meth:`dispatch`: operands are host arrays, retries re-stage."""
         deg = self.degrader
         first = [promise]
 

@@ -471,12 +471,15 @@ def validate_report(rec) -> None:
             if not isinstance(audit.get("buckets"), list):
                 problems.append("trace_audit.buckets: want a list")
             don = audit.get("donation")
-            if not isinstance(don, dict) or "undonated_large_buffers" not in (
-                don or {}
+            if (
+                not isinstance(don, dict)
+                or "undonated_large_buffers" not in don
+                or not isinstance(don.get("pinned_live"), list)
             ):
                 problems.append(
                     "trace_audit.donation: want an object with "
-                    f"undonated_large_buffers, got {don!r}"
+                    "undonated_large_buffers and a pinned_live list, "
+                    f"got {don!r}"
                 )
         if not isinstance(rec.get("entry_points"), list):
             problems.append(
@@ -587,6 +590,51 @@ def validate_report(rec) -> None:
                 problems.append(
                     "interleave.total_schedules: want an int, got "
                     f"{il.get('total_schedules')!r}"
+                )
+    elif kind == "donation-audit":
+        # scripts/donation_audit.py's donation-safety dataflow report.
+        plan = rec.get("plan")
+        if not isinstance(plan, dict) or not isinstance(
+            plan.get("entries"), list
+        ):
+            problems.append(
+                f"plan: want an object with an entries list, got {plan!r}"
+            )
+        else:
+            for i, e in enumerate(plan["entries"]):
+                if (
+                    not isinstance(e, dict)
+                    or not isinstance(e.get("wrapper"), str)
+                    or not isinstance(e.get("donate"), list)
+                    or not isinstance(e.get("pinned"), list)
+                ):
+                    problems.append(
+                        f"plan.entries[{i}]: want wrapper str plus "
+                        f"donate/pinned lists, got {e!r}"
+                    )
+        if not isinstance(rec.get("findings"), list):
+            problems.append(
+                f"findings: want a list, got {rec.get('findings')!r}"
+            )
+        if not isinstance(rec.get("restage_paths"), list):
+            problems.append(
+                "restage_paths: want a list, got "
+                f"{rec.get('restage_paths')!r}"
+            )
+        audit = rec.get("trace_audit")
+        if not isinstance(audit, dict):
+            problems.append(f"trace_audit: want an object, got {audit!r}")
+        else:
+            don = audit.get("donation")
+            if (
+                not isinstance(don, dict)
+                or "undonated_large_buffers" not in don
+                or not isinstance(don.get("pinned_live"), list)
+            ):
+                problems.append(
+                    "trace_audit.donation: want an object with "
+                    "undonated_large_buffers and a pinned_live list, "
+                    f"got {don!r}"
                 )
     elif kind == "aot-manifest":
         # aot/manifest.py's warm-set manifest.

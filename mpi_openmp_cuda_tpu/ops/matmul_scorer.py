@@ -38,6 +38,8 @@ three to each other.
 
 from __future__ import annotations
 
+import warnings
+
 import jax
 import jax.numpy as jnp
 from jax import lax
@@ -251,4 +253,13 @@ def score_chunks_mm_body(
     return lax.map(chunk_fn, (seq2_chunks, len2_chunks))
 
 
-score_chunks_mm = jax.jit(score_chunks_mm_body, static_argnames=("mm_precision",))
+# donate_argnums per the DonationPlan (analysis/dataflow.py) — see
+# ops/xla_scorer.py for the pin rationale; `make donation-audit`
+# cross-checks this literal against the proof.
+score_chunks_mm = jax.jit(
+    score_chunks_mm_body,
+    static_argnames=("mm_precision",),
+    donate_argnums=(0, 2),
+)
+
+warnings.filterwarnings("ignore", message="Some donated buffers were not usable")

@@ -145,6 +145,7 @@ multiplier that both arms pay).
 from __future__ import annotations
 
 import functools
+import warnings
 
 import jax
 import jax.numpy as jnp
@@ -1448,9 +1449,16 @@ def score_chunks_pallas_body(
     return out.reshape(nc, cb, 3)
 
 
+# donate_argnums per the DonationPlan (analysis/dataflow.py) — see
+# ops/xla_scorer.py for the pin rationale; `make donation-audit`
+# cross-checks this literal against the proof.
 score_chunks_pallas = jax.jit(
-    score_chunks_pallas_body, static_argnames=("feed", "sb", "l2s")
+    score_chunks_pallas_body,
+    static_argnames=("feed", "sb", "l2s"),
+    donate_argnums=(0, 2),
 )
+
+warnings.filterwarnings("ignore", message="Some donated buffers were not usable")
 
 
 @functools.lru_cache(maxsize=32)

@@ -475,6 +475,10 @@ class FleetWorker:
             self._zombie_done = True
 
     def _score_offer(self, offer: dict):
+        # np.asarray keeps these HOST-side: the donation-safety pass
+        # (analysis/dataflow.py) proves this root re-stages device
+        # buffers at _score_local on every retry, so the jit entry
+        # points may donate.  Don't "optimise" to jnp here.
         seq1 = np.asarray(offer["seq1"], dtype=np.int8)
         codes = [np.asarray(r, dtype=np.int8) for r in offer["rows"]]
         weights = [int(w) for w in offer["weights"]]

@@ -1,31 +1,39 @@
 #!/usr/bin/env python3
 """`make analyze` driver: run the full static-analysis gate on CPU.
 
-Eight passes (docs/ARCHITECTURE.md §9), in cheapest-first order so the
-common failure (a lint regression) reports before jax even imports:
+Nine analysis passes plus optional tooling (docs/ARCHITECTURE.md §9),
+in cheapest-first order so the common failure (a lint regression)
+reports before jax even imports:
 
 1. seqlint        — repo-specific AST rules over the package tree.
 2. lock graph     — whole-program lock-ordering + blocking-reachability
                     audit (analysis/lockgraph.py; golden drift gating
                     lives in scripts/concurrency_audit.py).
-3. VMEM audit     — exhaustive sweep of every kernel config the
+3. dataflow       — donation-safety def-use/liveness over every call
+                    site of the module-level jit entries, incl. the
+                    retry re-dispatch ladders (analysis/dataflow.py;
+                    golden drift gating lives in
+                    scripts/donation_audit.py).
+4. VMEM audit     — exhaustive sweep of every kernel config the
                     dispatch choosers can emit vs the per-core budget.
-4. cost model     — the same emittable space priced by the calibrated
+5. cost model     — the same emittable space priced by the calibrated
                     iteration model (analysis/costmodel.py): every
                     config must cost finite and positive, and the
                     default schedule must yield a prediction.
-5. contract audit — jax.eval_shape over every registered scorer entry
+6. contract audit — jax.eval_shape over every registered scorer entry
                     point (the shard_map wrapper needs a mesh, hence
                     the 8-virtual-device CPU backend forced below).
-6. trace audit    — lower every entry point and walk the jaxpr for
-                    host transfers, convert widenings, donation
-                    coverage, and pallas-launch structure
+7. trace audit    — lower every entry point and walk the jaxpr for
+                    host transfers, convert widenings, pallas-launch
+                    structure, and the ENFORCED donation gate: every
+                    un-donated large buffer must be donated by the
+                    DonationPlan or pinned live with a reason
                     (analysis/traceaudit.py; golden drift gating lives
                     in scripts/schedule_audit.py).
-7. interleave     — exhaustive small-scope exploration of the fleet
+8. interleave     — exhaustive small-scope exploration of the fleet
                     protocol's event interleavings against the §8.6
                     invariants (analysis/interleave.py).
-8. ruff / mypy    — only when installed (the container may not ship
+9. ruff / mypy    — only when installed (the container may not ship
                     them); the baselines live in pyproject.toml.
 
 EVERY pass runs regardless of earlier failures — an unexpected crash in
@@ -82,6 +90,30 @@ def _pass_lockgraph() -> str:
     )
 
 
+def _pass_dataflow() -> str:
+    from mpi_openmp_cuda_tpu.analysis.dataflow import run_or_raise
+
+    body = run_or_raise()
+    counts = body["counts"]
+    for e in body["plan"]["entries"]:
+        print(
+            f"  {e['module']}:{e['wrapper']} donate={tuple(e['donate'])} "
+            f"pinned={len(e['pinned'])} sites={len(e['call_sites'])}"
+        )
+    for r in body["restage_paths"]:
+        print(f"  restage {r['root']} => {r['leaf']} [ok]")
+    print(
+        f"clean: {counts['entries']} entries, "
+        f"{counts['donated_argnums']} donated argnums, "
+        f"{counts['pinned']} pinned, "
+        f"{counts['restage_paths']} restage paths proven, 0 findings"
+    )
+    return (
+        f"{counts['entries']} entries, {counts['donated_argnums']} "
+        f"donated, 0 findings"
+    )
+
+
 def _pass_vmem() -> str:
     from mpi_openmp_cuda_tpu.analysis import vmem
 
@@ -129,23 +161,23 @@ def _pass_contracts() -> str:
 def _pass_traceaudit() -> str:
     from mpi_openmp_cuda_tpu.analysis import traceaudit
 
+    # audit_entry_points raises on any un-donated large buffer the
+    # DonationPlan neither donates nor pins — the gate is enforced
+    # here, not just drift-pinned in the schedule-audit golden.
     reports = traceaudit.audit_entry_points()
-    undonated = 0
+    pinned = 0
     for rep in reports:
-        undonated += len(rep.undonated_large)
+        pinned += len(rep.pinned_live)
         print(
             f"  {rep.entry:<45s} bucket={str(rep.bucket):<22s} "
             f"pallas={rep.pallas_calls} widen={rep.convert_widenings} "
-            f"undonated_large={len(rep.undonated_large)}"
+            f"donate={rep.donate_argnums} pinned={len(rep.pinned_live)}"
         )
-    # Donation coverage is REPORTED, not asserted: the honest current
-    # state is zero donation, and the drift gate on the count lives in
-    # the schedule-audit golden.
     print(
-        f"clean: {len(reports)} lowers, 0 host transfers; "
-        f"{undonated} un-donated large buffers listed"
+        f"clean: {len(reports)} lowers, 0 host transfers, 0 un-donated "
+        f"large buffers ({pinned} pinned live with reasons)"
     )
-    return f"{len(reports)} lowers, 0 host transfers"
+    return f"{len(reports)} lowers, 0 host transfers, donation enforced"
 
 
 def _pass_interleave() -> str:
@@ -181,6 +213,7 @@ def _tool_pass(tool: str, argv: list[str]):
 PASSES = [
     ("seqlint", _pass_seqlint),
     ("lock graph", _pass_lockgraph),
+    ("dataflow", _pass_dataflow),
     ("vmem audit", _pass_vmem),
     ("cost model", _pass_costmodel),
     ("entry-point contracts", _pass_contracts),

@@ -1,0 +1,280 @@
+#!/usr/bin/env python3
+"""`make donation-audit` driver: the donation-safety gate on CPU.
+
+Two passes over the live tree, both deterministic, both golden-pinned:
+
+1. **Dataflow plan** (``analysis/dataflow.py``): whole-program AST
+   def-use/liveness for every array operand flowing into the
+   module-level jit entry points, across every call site including the
+   retry/degrade/rescue re-dispatch ladders.  Produces the
+   ``DonationPlan`` — per entry the provably-dead argnums to donate and
+   the pinned-live ones with reasons — and fails on any finding: an
+   operand not provably dead at some site, a re-dispatch path that
+   stages device buffers above the retry boundary, or
+   ``donate_argnums`` wiring that drifted from the proof.
+2. **Trace-audit enforcement** (``analysis/traceaudit.py``): every
+   registered entry point and the composed production schedule are
+   lowered UNDER the plan's argnums and the donation gate is enforced
+   — ``undonated_large_buffers == 0`` net of explicitly pinned-live
+   rows (each listed with its reason).
+
+The committed golden (``tests/golden/donation_plan.json``) pins the
+full plan: donate/pinned argnums per entry, the call-site inventory,
+the re-stage proof paths, and the schedule's donation coverage — so a
+NEW call site of a donated entry (however safe it looks) must be
+re-proved and committed, and a lost re-dispatch path (a vacuous proof)
+is drift, not silence.
+
+Exit 0 iff the plan has zero findings, both trace gates pass, the
+report is schema-valid, and nothing drifted from the golden.
+CPU-only, zero devices, a few seconds.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+# Force the CPU backend BEFORE jax initialises (the trace-audit pass
+# lowers the real entry points; same idiom as analyze.py).
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+GOLDEN_PATH = os.path.join(REPO, "tests", "golden", "donation_plan.json")
+
+
+def build_report() -> dict:
+    """The full enveloped donation-audit report: the dataflow plan plus
+    the enforced trace-audit donation sections."""
+    from mpi_openmp_cuda_tpu.analysis import TraceAuditError
+    from mpi_openmp_cuda_tpu.analysis.dataflow import audit_dataflow
+    from mpi_openmp_cuda_tpu.analysis.traceaudit import (
+        audit_entry_points,
+        audit_schedule,
+    )
+    from mpi_openmp_cuda_tpu.models.workload import input3_class_problem
+    from mpi_openmp_cuda_tpu.obs.metrics import wrap_report
+
+    body = audit_dataflow()
+    entry_rows = []
+    trace: dict = {"buckets": [], "donation": None}
+    try:
+        for rep in audit_entry_points():
+            entry_rows.append(
+                {
+                    "entry": rep.entry,
+                    "bucket": list(rep.bucket),
+                    "donate_argnums": list(rep.donate_argnums),
+                    "large_buffers": len(rep.large_buffers),
+                    "undonated_large_buffers": [
+                        i.describe() for i in rep.undonated_large
+                    ],
+                    "pinned_live": list(rep.pinned_live),
+                }
+            )
+        trace = audit_schedule(input3_class_problem())
+    except TraceAuditError as exc:
+        body["findings"] = list(body["findings"]) + [
+            {
+                "kind": "trace-gate",
+                "entry": "traceaudit",
+                "detail": str(exc),
+            }
+        ]
+    body["entry_points"] = entry_rows
+    body["trace_audit"] = trace
+    return wrap_report("donation-audit", body)
+
+
+def golden_view(report: dict) -> dict:
+    """The drift-gated subset: the whole plan (donate/pinned argnums,
+    call sites, re-stage paths), finding count, and the schedule's
+    donation coverage — static facts of the tree, no walls, no line
+    numbers (pins carry sites as module:qualname rows)."""
+    plan = report["plan"]
+    don = (report.get("trace_audit") or {}).get("donation") or {}
+    return {
+        "entries": [
+            {
+                "module": e["module"],
+                "wrapper": e["wrapper"],
+                "params": list(e["params"]),
+                "donate": list(e["donate"]),
+                "wired": e["wired"],
+                "pinned": [
+                    {
+                        "argnum": p["argnum"],
+                        "name": p["name"],
+                        "kind": p["kind"],
+                    }
+                    for p in e["pinned"]
+                ],
+                "call_sites": list(e["call_sites"]),
+            }
+            for e in plan["entries"]
+        ],
+        "restage_paths": sorted(
+            f"{r['root']} => {r['leaf']} [{'ok' if r['ok'] else 'STAGES'}]"
+            for r in report["restage_paths"]
+        ),
+        "findings": len(report["findings"]),
+        "schedule_donation": {
+            "large_buffers": don.get("large_buffers"),
+            "donated_large_buffers": don.get("donated_large_buffers"),
+            "undonated_large_buffers": don.get("undonated_large_buffers"),
+            "pinned_live": len(don.get("pinned_live") or []),
+            "covered": don.get("covered"),
+        },
+    }
+
+
+def diff_views(want: dict, got: dict) -> list[str]:
+    """Field-by-field drift rows (empty = match)."""
+    rows: list[str] = []
+    for key in sorted(set(want) | set(got)):
+        w, g = want.get(key), got.get(key)
+        if w != g:
+            rows.append(f"  {key}: golden {json.dumps(w)} != got {json.dumps(g)}")
+    return rows
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument(
+        "--update",
+        action="store_true",
+        help="rewrite the committed golden baseline from this run "
+        "(commit it together with the change that explains the drift)",
+    )
+    parser.add_argument(
+        "--out",
+        default=None,
+        help="also write the full enveloped report JSON to this path "
+        "(CI uploads it as the failure artifact)",
+    )
+    args = parser.parse_args()
+
+    from mpi_openmp_cuda_tpu.obs.metrics import validate_report
+
+    report = build_report()
+    failed = False
+
+    print("== schema ==")
+    try:
+        validate_report(report)
+        print("valid: kind=donation-audit")
+    except ValueError as exc:
+        print(f"FAIL: {exc}")
+        failed = True
+
+    print("\n== donation plan ==")
+    counts = report["counts"]
+    print(
+        f"entries={counts['entries']} donated={counts['donated_argnums']} "
+        f"pinned={counts['pinned']} restage_paths={counts['restage_paths']} "
+        f"findings={counts['findings']}"
+    )
+    for e in report["plan"]["entries"]:
+        print(
+            f"  {e['module']}:{e['wrapper']} donate={tuple(e['donate'])} "
+            f"wired={e['wired'] and tuple(e['wired'])}"
+        )
+        for p in e["pinned"]:
+            print(f"    pin arg{p['argnum']} {p['name']} [{p['kind']}]")
+        for s in e["call_sites"]:
+            print(f"    site {s}")
+    for r in report["restage_paths"]:
+        mark = "ok" if r["ok"] else "STAGES ABOVE RETRY"
+        print(f"  restage {r['root']} => {r['leaf']} [{mark}]")
+    for f in report["findings"]:
+        print(f"  FINDING [{f['kind']}] {f['entry']}: {f['detail']}")
+        failed = True
+
+    print("\n== trace enforcement ==")
+    for row in report["entry_points"]:
+        und = row["undonated_large_buffers"]
+        print(
+            f"  {row['entry']} {tuple(row['bucket'])}: "
+            f"donate={tuple(row['donate_argnums'])} "
+            f"large={row['large_buffers']} undonated={len(und)} "
+            f"pinned={len(row['pinned_live'])}"
+        )
+        for u in und:
+            print(f"    UNDONATED {u}")
+            failed = True
+        for p in row["pinned_live"]:
+            print(f"    pinned {p}")
+    don = (report.get("trace_audit") or {}).get("donation")
+    if don is None:
+        print("  FAIL: schedule trace audit did not complete")
+        failed = True
+    else:
+        print(
+            f"  schedule: large={don['large_buffers']} "
+            f"donated={don['donated_large_buffers']} "
+            f"undonated={don['undonated_large_buffers']} "
+            f"pinned={len(don['pinned_live'])} covered={don['covered']}"
+        )
+        if don["undonated_large_buffers"] != 0:
+            print(
+                "  FAIL: schedule has un-donated large buffers the plan "
+                "neither donates nor pins"
+            )
+            failed = True
+
+    if args.out:
+        with open(args.out, "w") as fh:
+            json.dump(report, fh, indent=1, sort_keys=True)
+            fh.write("\n")
+        print(f"\nreport written to {args.out}")
+
+    view = golden_view(report)
+    if args.update:
+        if failed:
+            print("\nrefusing --update: the run itself failed")
+            return 1
+        os.makedirs(os.path.dirname(GOLDEN_PATH), exist_ok=True)
+        with open(GOLDEN_PATH, "w") as fh:
+            json.dump(view, fh, indent=1, sort_keys=True)
+            fh.write("\n")
+        print(f"\ngolden updated: {GOLDEN_PATH}")
+        return 0
+
+    print("\n== golden drift ==")
+    if not os.path.exists(GOLDEN_PATH):
+        print(
+            f"FAIL: no committed golden at {GOLDEN_PATH} "
+            "(run scripts/donation_audit.py --update and commit it)"
+        )
+        return 1
+    with open(GOLDEN_PATH) as fh:
+        want = json.load(fh)
+    rows = diff_views(want, view)
+    if rows:
+        print(f"FAIL: {len(rows)} field(s) drifted from the golden:")
+        print("\n".join(rows))
+        print(
+            "either fix the regression, or regenerate deliberately with "
+            "scripts/donation_audit.py --update and commit the new "
+            "baseline with the change that explains it"
+        )
+        return 1
+    print("match: donation audit equals the committed golden")
+    if failed:
+        print("\ndonation-audit: FAIL")
+        return 1
+    print("\ndonation-audit: OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -181,6 +181,13 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "slow: multi-process / long-running integration tests"
     )
+    # pytest resets the warnings filters the scorer modules install at
+    # import time; re-silence the expected CPU-only fallout of the
+    # DonationPlan (unaliasable shapes are donated-but-unused on CPU).
+    config.addinivalue_line(
+        "filterwarnings",
+        "ignore:Some donated buffers were not usable",
+    )
     config.addinivalue_line(
         "markers",
         "no_chaos: asserts exact failure/attempt counts that an ambient "

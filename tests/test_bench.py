@@ -66,7 +66,18 @@ def test_bench_emits_contract_json_line():
                         "feed_roofline_tflops", "feed_roofline_kind",
                         "mfu_vs_feed_roofline",
                         "vpu_probe_arith_gelems", "vpu_floor_us",
-                        "wall_vs_vpu_floor", "formulation"}
+                        "wall_vs_vpu_floor", "formulation", "donation"}
+    # r6: every record carries the DonationPlan it ran under — the
+    # wired donate_argnums per entry and the committed pre-donation
+    # MFU baseline (BENCH_r05) the TPU record's delta is quoted against.
+    don = rec["donation"]
+    assert don["entries"] == {
+        "score_chunks": [0, 2],
+        "score_chunks_mm": [0, 2],
+        "score_chunks_pallas": [0, 2],
+    }
+    assert don["findings"] == 0
+    assert don["baseline_mfu_vs_feed_roofline"] == 0.217
     assert rec["e2e_first_run_s"] >= 0 and rec["e2e_warm_s"] >= 0
     # Cold start spans process start -> first result, so it bounds the
     # first in-process run from above; no SEQALIGN_PREWARM in this env.

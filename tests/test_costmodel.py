@@ -200,7 +200,10 @@ class TestScheduleAuditReportSchema:
             },
             "trace_audit": {
                 "buckets": [],
-                "donation": {"undonated_large_buffers": 4},
+                "donation": {
+                    "undonated_large_buffers": 0,
+                    "pinned_live": [],
+                },
             },
             "entry_points": [],
         }
@@ -225,6 +228,7 @@ class TestScheduleAuditReportSchema:
                 "predicted_mfu_vs_feed_roofline", "0.446"
             ),
             lambda b: b["trace_audit"].__setitem__("donation", {}),
+            lambda b: b["trace_audit"]["donation"].pop("pinned_live"),
         ],
     )
     def test_malformed_reports_rejected(self, mutate):
@@ -250,14 +254,16 @@ class TestScheduleTraceSlow:
         for b in trace["buckets"]:
             assert b["pallas_calls_per_chunk"] == 1
             assert b["device_puts"] == 0
-        # The acceptance bar: un-donated large buffers are LISTED.
+        # The acceptance bar flipped with the DonationPlan: every large
+        # chunk-pipeline buffer is donated, nothing pinned, gate covered.
         don = trace["donation"]
-        listed = [
-            row for b in trace["buckets"] for row in b["undonated_large_buffers"]
-        ]
-        assert len(listed) == don["undonated_large_buffers"] > 0
-        assert all("UNDONATED" in row for row in listed)
-        assert not don["covered"]
+        assert don["undonated_large_buffers"] == 0
+        assert don["donated_large_buffers"] == don["large_buffers"] > 0
+        assert don["pinned_live"] == []
+        assert don["covered"]
+        for b in trace["buckets"]:
+            assert b["undonated_large_buffers"] == []
+            assert b["donate_argnums"] == [0, 2]
 
 
 @pytest.mark.slow

@@ -1,0 +1,436 @@
+"""Donation-safety dataflow pass (`analysis/dataflow.py`) + the wired
+`donate_argnums` runtime behaviour it proves safe.
+
+Three layers, mirroring the pass's own contract:
+
+* the plan over the REAL tree: three entries, donate (0, 2) proved at
+  every call site, pins with reasons, all three re-dispatch roots
+  reaching the staging leaf, zero findings, wiring in sync;
+* seeded-violation packages: post-call reuse, staging hoisted out of a
+  loop, unresolvable operand provenance, device-local aliasing, wiring
+  drift, and a re-dispatch root that stages above the retry boundary —
+  each must pin/flag, never silently donate;
+* the runtime consequences: a donated aliasable chunk buffer really IS
+  deleted on CPU (reuse raises), retried chunks under `--faults`
+  re-stage to byte-identical goldens, and the fleet worker's
+  score-post path repeats cleanly with donation on.
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+import textwrap
+
+import numpy as np
+import pytest
+
+from conftest import run_cli_inproc as run_inproc
+from test_fixtures import fixture_path, golden
+
+from mpi_openmp_cuda_tpu.analysis import DataflowError, dataflow
+from mpi_openmp_cuda_tpu.obs.metrics import validate_report, wrap_report
+
+ENTRIES = {
+    ("ops/xla_scorer.py", "score_chunks"),
+    ("ops/matmul_scorer.py", "score_chunks_mm"),
+    ("ops/pallas_scorer.py", "score_chunks_pallas"),
+}
+
+
+@pytest.fixture(scope="module")
+def plan():
+    return dataflow.build_plan()
+
+
+# -- the plan over the real tree ---------------------------------------------
+
+
+class TestDonationPlan:
+    def test_three_entries_planned(self, plan):
+        assert {(e.module, e.wrapper) for e in plan.entries} == ENTRIES
+
+    def test_donate_argnums_proved_and_wired(self, plan):
+        for e in plan.entries:
+            assert e.params == (
+                "seq1ext", "len1", "seq2_chunks", "len2_chunks", "val_flat"
+            )
+            assert e.donate == (0, 2), e.wrapper
+            assert e.wired == (0, 2), e.wrapper
+
+    def test_pins_carry_reasons_and_sites(self, plan):
+        for e in plan.entries:
+            pins = {p.argnum: p for p in e.pinned}
+            assert set(pins) == {1, 3, 4}
+            assert pins[1].kind == "scalar"
+            assert pins[3].kind == "below-threshold"
+            assert pins[4].kind == "below-threshold"
+            for p in e.pinned:
+                assert p.reason
+                assert p.path  # the sites the decision covers
+
+    def test_call_sites_cover_dispatch_and_aot(self, plan):
+        for e in plan.entries:
+            assert "ops/dispatch.py:AlignmentScorer._score_local" in (
+                e.call_sites
+            )
+            assert "aot/compile.py:compile_entry" in e.call_sites
+
+    def test_restage_paths_proven(self, plan):
+        roots = {r["root"] for r in plan.restage_paths}
+        assert roots == {
+            "io/pipeline.py:ChunkPipeline.dispatch",
+            "io/pipeline.py:ChunkPipeline.materialise",
+            "serve/fleet.py:FleetWorker._score_offer",
+        }
+        for r in plan.restage_paths:
+            assert r["ok"], r
+            assert r["leaf"] == "ops/dispatch.py:AlignmentScorer._score_local"
+            # The retry ladders stage ONLY at the leaf: the whole path
+            # above it is host-side, so a retried chunk re-enters with
+            # host operands and cannot alias a donated buffer.
+            assert r["path"][-1].endswith("_score_local")
+
+    def test_zero_findings(self, plan):
+        assert plan.findings == ()
+
+    def test_plan_lookup_by_callable(self, plan):
+        from mpi_openmp_cuda_tpu.ops.matmul_scorer import (
+            score_chunks_mm_body,
+        )
+
+        part = functools.partial(score_chunks_mm_body, mm_precision=None)
+        assert plan.donate_for_callable(part) == (0, 2)
+        assert plan.donate_for_callable(lambda x: x) is None
+
+    def test_report_body_is_json_and_schema_valid(self, plan):
+        body = plan.to_body()
+        json.dumps(body)  # no dataclasses / tuples leaking through
+        body["entry_points"] = []
+        body["trace_audit"] = {
+            "donation": {"undonated_large_buffers": 0, "pinned_live": []}
+        }
+        validate_report(wrap_report("donation-audit", body))
+
+    def test_run_or_raise_clean(self):
+        body = dataflow.run_or_raise()
+        assert body["counts"]["findings"] == 0
+        assert body["counts"]["donated_argnums"] == 6
+
+    def test_bench_donation_record_quotes_baseline_delta(self):
+        import bench
+
+        rec = bench.donation_record(0.25)
+        assert rec["entries"] == {
+            "score_chunks": [0, 2],
+            "score_chunks_mm": [0, 2],
+            "score_chunks_pallas": [0, 2],
+        }
+        assert rec["findings"] == 0
+        assert rec["baseline_mfu_vs_feed_roofline"] == 0.217
+        assert rec["mfu_delta_vs_predonation"] == round(0.25 - 0.217, 3)
+        assert "mfu_delta_vs_predonation" not in bench.donation_record()
+
+
+class TestDonationAuditSchema:
+    def _body(self):
+        return {
+            "plan": {
+                "large_buffer_bytes": 16384,
+                "entries": [
+                    {
+                        "module": "ops/xla_scorer.py",
+                        "wrapper": "score_chunks",
+                        "body": "score_chunks_body",
+                        "params": [],
+                        "donate": [0, 2],
+                        "wired": [0, 2],
+                        "pinned": [],
+                        "call_sites": [],
+                    }
+                ],
+            },
+            "findings": [],
+            "restage_paths": [],
+            "counts": {},
+            "entry_points": [],
+            "trace_audit": {
+                "donation": {
+                    "undonated_large_buffers": 0,
+                    "pinned_live": [],
+                }
+            },
+        }
+
+    def test_valid_report_passes(self):
+        validate_report(wrap_report("donation-audit", self._body()))
+
+    @pytest.mark.parametrize(
+        "mutate",
+        [
+            lambda b: b.pop("plan"),
+            lambda b: b.pop("findings"),
+            lambda b: b.pop("restage_paths"),
+            lambda b: b.pop("trace_audit"),
+            lambda b: b["plan"].pop("entries"),
+            lambda b: b["plan"]["entries"][0].pop("donate"),
+            lambda b: b["trace_audit"]["donation"].pop("pinned_live"),
+            lambda b: b["trace_audit"].__setitem__("donation", {}),
+        ],
+    )
+    def test_malformed_reports_rejected(self, mutate):
+        body = self._body()
+        mutate(body)
+        with pytest.raises(ValueError, match="invalid run report"):
+            validate_report(wrap_report("donation-audit", body))
+
+
+# -- seeded-violation packages -----------------------------------------------
+
+
+_PRELUDE = """\
+    import jax
+    import jax.numpy as jnp
+
+    def body(a, b):
+        return a + b
+
+"""
+
+
+def _seeded_plan(tmp_path, source, roots=()):
+    root = tmp_path / "pkg"
+    root.mkdir(exist_ok=True)
+    (root / "mod.py").write_text(
+        textwrap.dedent(_PRELUDE) + textwrap.dedent(source)
+    )
+    return dataflow.build_plan(root, redispatch_roots=roots)
+
+
+class TestSeededHazards:
+    def test_clean_staging_donates_everything(self, tmp_path):
+        plan = _seeded_plan(tmp_path, """\
+            entry = jax.jit(body, donate_argnums=(0, 1))
+
+            def caller(x, y):
+                a = jnp.asarray(x)
+                b = jnp.asarray(y)
+                return entry(a, b)
+        """)
+        (e,) = plan.entries
+        assert e.donate == (0, 1)
+        assert e.pinned == ()
+        assert plan.findings == ()
+
+    def test_post_call_reuse_pins_with_blocking_path(self, tmp_path):
+        plan = _seeded_plan(tmp_path, """\
+            entry = jax.jit(body, donate_argnums=(0, 1))
+
+            def caller(x, y):
+                a = jnp.asarray(x)
+                b = jnp.asarray(y)
+                out = entry(a, b)
+                return out + a.sum()
+        """)
+        (e,) = plan.entries
+        assert e.donate == ()
+        assert all(p.kind == "alias-hazard" for p in e.pinned)
+        assert any("re-read" in row for p in e.pinned for row in p.path)
+        # The wiring claims (0, 1) but the proof refuses: drift finding.
+        assert any(f["kind"] == "wiring-drift" for f in plan.findings)
+        with pytest.raises(DataflowError, match="wiring-drift"):
+            dataflow.run_or_raise(tmp_path / "pkg")
+
+    def test_staging_hoisted_out_of_loop_is_live(self, tmp_path):
+        plan = _seeded_plan(tmp_path, """\
+            entry = jax.jit(body)
+
+            def caller(x, y):
+                a = jnp.asarray(x)
+                out = None
+                for _ in range(2):
+                    b = jnp.asarray(y)
+                    out = entry(a, b)
+                return out
+        """)
+        (e,) = plan.entries
+        assert e.donate == ()
+        assert any(
+            "loop" in row for p in e.pinned for row in p.path
+        )
+
+    def test_unknown_provenance_pins_only_that_argnum(self, tmp_path):
+        plan = _seeded_plan(tmp_path, """\
+            entry = jax.jit(body)
+
+            def caller(x, y):
+                return entry(x, jnp.asarray(y))
+        """)
+        (e,) = plan.entries
+        assert e.donate == (1,)  # the proven-fresh operand
+        (pin,) = e.pinned
+        assert pin.argnum == 0 and pin.kind == "alias-hazard"
+        assert any("no visible staging" in row for row in pin.path)
+
+    def test_asarray_of_device_local_is_aliasing_not_staging(
+        self, tmp_path
+    ):
+        plan = _seeded_plan(tmp_path, """\
+            entry = jax.jit(body)
+
+            def caller(x, y):
+                d = jnp.asarray(x)
+                return entry(jnp.asarray(d), jnp.asarray(y))
+        """)
+        (e,) = plan.entries
+        assert e.donate == (1,)
+        (pin,) = e.pinned
+        assert pin.argnum == 0
+        assert any("aliases instead of staging" in row for row in pin.path)
+
+    def test_restage_root_staging_above_leaf_is_flagged(self, tmp_path):
+        plan = _seeded_plan(
+            tmp_path,
+            """\
+            entry = jax.jit(body, donate_argnums=(0, 1))
+
+            def retry(x):
+                a = jnp.asarray(x)
+                return do(a)
+
+            def do(v):
+                return entry(jnp.asarray(v), jnp.asarray(v))
+            """,
+            roots=(("mod.py", "retry"),),
+        )
+        assert any(
+            f["kind"] == "stage-above-retry" for f in plan.findings
+        )
+
+    def test_missing_restage_root_fails_closed(self, tmp_path):
+        plan = _seeded_plan(
+            tmp_path,
+            """\
+            entry = jax.jit(body, donate_argnums=(0, 1))
+
+            def caller(x, y):
+                return entry(jnp.asarray(x), jnp.asarray(y))
+            """,
+            roots=(("mod.py", "gone"),),
+        )
+        assert any(
+            f["kind"] == "restage-root-missing" for f in plan.findings
+        )
+
+    def test_root_reaching_no_staging_site_is_vacuous(self, tmp_path):
+        plan = _seeded_plan(
+            tmp_path,
+            """\
+            entry = jax.jit(body, donate_argnums=(0, 1))
+
+            def caller(x, y):
+                return entry(jnp.asarray(x), jnp.asarray(y))
+
+            def idle():
+                return None
+            """,
+            roots=(("mod.py", "idle"),),
+        )
+        assert any(
+            f["kind"] == "restage-unproven" for f in plan.findings
+        )
+
+
+# -- runtime: donation really deletes, retries really re-stage ---------------
+
+
+class TestDonationRuntime:
+    def test_donated_chunk_buffer_deleted_and_reuse_raises(self):
+        # l2p == 3 makes rows (1, cb, 3) the same shape+dtype as the
+        # output (1, cb, 3): the one chunk geometry where even the CPU
+        # backend can alias the donation, so the deletion is REAL here,
+        # not just claimed at lowering.
+        import jax
+        import jax.numpy as jnp
+
+        from mpi_openmp_cuda_tpu.ops.xla_scorer import score_chunks
+
+        seq1ext = jnp.asarray(np.zeros(8 + 3 + 1, np.int32))
+        rows = jnp.asarray(np.ones((1, 4, 3), np.int32))
+        lens = jnp.asarray(np.full((1, 4), 2, np.int32))
+        val = jnp.asarray(np.zeros(27 * 27, np.int32))
+        out = score_chunks(seq1ext, jnp.int32(4), rows, lens, val)
+        jax.block_until_ready(out)
+        assert rows.is_deleted()
+        with pytest.raises(RuntimeError, match="deleted"):
+            np.asarray(rows)
+
+    def test_fresh_staging_scores_again_after_donation(self):
+        # The re-staging proof in miniature: the SAME host arrays score
+        # twice identically because every dispatch stages fresh device
+        # buffers — exactly what the dataflow pass guarantees for the
+        # retry ladders.
+        from mpi_openmp_cuda_tpu.ops.dispatch import AlignmentScorer
+
+        rng = np.random.default_rng(7)
+        seq1 = rng.integers(1, 27, size=60).astype(np.int8)
+        seqs = [
+            rng.integers(1, 27, size=int(n)).astype(np.int8)
+            for n in rng.integers(1, 30, size=6)
+        ]
+        weights = [1, -3, -5, -2]
+        scorer = AlignmentScorer("xla")
+        first = scorer.score_codes(seq1, seqs, weights)
+        second = scorer.score_codes(seq1, seqs, weights)
+        np.testing.assert_array_equal(np.asarray(first), np.asarray(second))
+
+    def test_retried_chunk_restages_byte_identical_goldens(self, capsys):
+        # Chaos tier: two injected chunk-scoring faults force retries
+        # of donated dispatches; the retried chunks must re-stage (the
+        # restage_paths proof) and the output must stay byte-identical.
+        out, err = run_inproc(
+            "--input", fixture_path("stress_small"),
+            "--retries", "3",
+            "--faults", "chunk_scoring:fail=2",
+            capsys=capsys,
+        )
+        assert out == golden("stress_small")
+        assert "retrying" in err
+
+    def test_fleet_score_post_repeats_under_donation(self):
+        # The fleet worker's score path (_score_offer) runs the REAL
+        # pipeline twice over the same host offer: donation must not
+        # poison the second pass (re-staging at _score_local).
+        from mpi_openmp_cuda_tpu.io.pipeline import ChunkPipeline
+        from mpi_openmp_cuda_tpu.ops.dispatch import AlignmentScorer
+        from mpi_openmp_cuda_tpu.ops.oracle import score_batch_oracle
+        from mpi_openmp_cuda_tpu.resilience.degrade import BackendDegrader
+        from mpi_openmp_cuda_tpu.resilience.policy import RetryPolicy
+        from mpi_openmp_cuda_tpu.resilience.rescue import MemoryBoard
+        from mpi_openmp_cuda_tpu.serve.fleet import FleetWorker
+
+        rng = np.random.default_rng(11)
+        seq1 = rng.integers(1, 27, size=40).astype(np.int8)
+        offer = {
+            "seq1": seq1.tolist(),
+            "rows": [
+                rng.integers(1, 27, size=int(n)).astype(np.int8).tolist()
+                for n in rng.integers(1, 20, size=4)
+            ],
+            "weights": [1, -3, -5, -2],
+        }
+        scorer = AlignmentScorer("xla")
+        policy = RetryPolicy(retries=1, backoff_base=0, log=lambda m: None)
+        deg = BackendDegrader(scorer, lambda b: scorer, enabled=False)
+        worker = FleetWorker(
+            MemoryBoard(), ChunkPipeline(policy, deg), policy
+        )
+        first = worker._score_offer(offer)
+        second = worker._score_offer(offer)
+        np.testing.assert_array_equal(first, second)
+        want = score_batch_oracle(
+            seq1,
+            [np.asarray(r, np.int8) for r in offer["rows"]],
+            offer["weights"],
+        )
+        assert [tuple(int(x) for x in r) for r in first] == want

@@ -711,3 +711,81 @@ class TestSeq010BlockingUnderLock:
                             fh.write(data)
             """,
         )
+
+
+class TestSeq011JitDonationPolicy:
+    def test_unannotated_module_level_jit(self, tmp_path):
+        findings = _lint_snippet(
+            tmp_path,
+            "ops/foo.py",
+            """
+            import jax
+
+            def score_chunks_body(x):
+                return x + 1
+
+            score_chunks = jax.jit(score_chunks_body)
+            """,
+        )
+        assert [f.code for f in findings] == ["SEQ011"]
+        assert "donation policy" in findings[0].message
+
+    def test_wired_donate_argnums_is_clean(self, tmp_path):
+        assert not _lint_snippet(
+            tmp_path,
+            "ops/foo.py",
+            """
+            import jax
+
+            def score_chunks_body(x):
+                return x + 1
+
+            score_chunks = jax.jit(score_chunks_body, donate_argnums=(0,))
+            """,
+        )
+
+    def test_nodonate_marker_with_reason_is_clean(self, tmp_path):
+        assert not _lint_snippet(
+            tmp_path,
+            "ops/foo.py",
+            """
+            import jax
+
+            def score_chunks_body(x):
+                return x + 1
+
+            score_chunks = jax.jit(
+                score_chunks_body
+            )  # nodonate: operands re-read by the caller after dispatch
+            """,
+        )
+
+    def test_bare_nodonate_marker_is_a_finding(self, tmp_path):
+        findings = _lint_snippet(
+            tmp_path,
+            "ops/foo.py",
+            """
+            import jax
+
+            def score_chunks_body(x):
+                return x + 1
+
+            score_chunks = jax.jit(score_chunks_body)  # nodonate:
+            """,
+        )
+        assert [f.code for f in findings] == ["SEQ011"]
+        assert "no reason" in findings[0].message
+
+    def test_function_local_jit_is_out_of_scope(self, tmp_path):
+        # SEQ011 polices the module-level entry points the DonationPlan
+        # proves; function-local jits are pinned by traceaudit instead.
+        assert not _lint_snippet(
+            tmp_path,
+            "ops/foo.py",
+            """
+            import jax
+
+            def make(entry_body):
+                return jax.jit(entry_body)
+            """,
+        )
