@@ -18,7 +18,7 @@ PY_CFLAGS  := $(shell $(PYCONFIG) --includes)
 PY_LDFLAGS := $(shell $(PYCONFIG) --ldflags --embed)
 INPUT      ?= /root/reference/input5.txt
 
-.PHONY: build run run2 runOn2 test chaos chaos-kill analyze schedule-audit concurrency-audit donation-audit metrics-smoke serve-smoke serve-chaos fleet-chaos aot-smoke trace-smoke bench bench-table bench-gather check clean
+.PHONY: build run run2 runOn2 test chaos chaos-kill analyze schedule-audit concurrency-audit donation-audit comms-audit metrics-smoke serve-smoke serve-chaos fleet-chaos aot-smoke trace-smoke bench bench-table bench-gather check clean
 
 build: final
 
@@ -126,6 +126,18 @@ concurrency-audit:
 # deliberately with scripts/donation_audit.py --update).  CPU-only.
 donation-audit:
 	JAX_PLATFORMS=cpu $(PYTHON) scripts/donation_audit.py
+
+# Collective-safety gate (docs/ARCHITECTURE.md §9): lower every
+# parallel/specs.py mesh form on the forced 8-virtual-device CPU
+# backend, inventory every collective (op, axes, payload bytes), prove
+# per-position ordering consistency (replica-divergent sequences fail
+# closed), gate resharding hygiene against the post-partitioning HLO,
+# cross-check the ring against ring_plan's R, and diff the inventory +
+# modelled ICI comms/scaling rows against the committed golden
+# (tests/golden/comms_audit.json; regenerate deliberately with
+# scripts/comms_audit.py --update).  CPU-only, zero real devices.
+comms-audit:
+	JAX_PLATFORMS=cpu $(PYTHON) scripts/comms_audit.py
 
 # Observability smoke gate (docs/ARCHITECTURE.md §10): one CLI run on
 # the tiny fixture with --metrics --metrics-out, then schema-validate

@@ -724,6 +724,32 @@ def donation_record(measured_mfu=None, baseline="BENCH_r05.json"):
     return donation
 
 
+def comms_record(problem, backend):
+    """Modelled comms next to measured MFU: the collective inventory
+    totals over the mesh specs the current device count can lower, plus
+    the ICI ``predicted_scaling_efficiency`` rows for the production
+    schedule — the numbers a future MULTICHIP_r*.json is audited
+    against.  CPU-only lowering plus host arithmetic — safe to call
+    without multi-chip hardware (a single-device box simply reports
+    zero audited entries)."""
+    from mpi_openmp_cuda_tpu.analysis.collectives import inventory_totals
+    from mpi_openmp_cuda_tpu.analysis.costmodel import schedule_cost_sheet
+
+    record = {"inventory": inventory_totals()}
+    sheet = schedule_cost_sheet(problem, backend)
+    comms = sheet.get("comms")
+    if comms is not None:
+        record["ici_link_gbytes_s"] = comms["ici_link_gbytes_s"]
+        record["ici_hop_latency_us"] = comms["ici_hop_latency_us"]
+        record["predicted_scaling_efficiency"] = {
+            f"{row['mesh']}x-{row['axis']}": row[
+                "predicted_scaling_efficiency"
+            ]
+            for row in comms["scaling"]
+        }
+    return record
+
+
 def main() -> None:
     # Respect an explicit JAX_PLATFORMS choice (TPU site hooks clobber it):
     # a CPU-forced bench (the pytest contract test) must actually run CPU.
@@ -984,6 +1010,16 @@ def main() -> None:
     except Exception as e:  # noqa: BLE001 - diagnostic only
         print(
             f"[bench] WARNING: donation section failed ({e})",
+            file=sys.stderr,
+        )
+    # Comms section (never fatal): the modelled collective inventory and
+    # scaling-efficiency rows ride every record so the r6+ benches carry
+    # modelled comms next to measured MFU.
+    try:
+        record["comms"] = comms_record(problem, backend)
+    except Exception as e:  # noqa: BLE001 - diagnostic only
+        print(
+            f"[bench] WARNING: comms section failed ({e})",
             file=sys.stderr,
         )
     pred_mfu = record.get("predicted_mfu_vs_feed_roofline")

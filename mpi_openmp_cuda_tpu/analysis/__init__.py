@@ -39,6 +39,14 @@ runnable on CPU-only CI (``make analyze``):
   ``RequestQueue``, ``FleetCoordinator``) under a virtual scheduler,
   exhaustively enumerating sleep-set-pruned interleavings to a depth
   bound and asserting the §8.6 protocol invariants on every schedule.
+* :mod:`.collectives` — a mesh-aware collective-safety pass over every
+  sharded entry point (each ``parallel/specs.py`` mesh form lowered on
+  the forced multi-device CPU backend): the per-device collective
+  inventory (op, axes, shape, dtype, payload bytes), fail-closed
+  ordering-consistency proofs (unregistered axes, replica-divergent
+  branches), resharding hygiene against the post-partitioning HLO, and
+  the ring-plan cross-check that ties the lowered programs to the ICI
+  comms model in :mod:`.costmodel`.
 * :mod:`.dataflow` — a whole-program donation-safety pass: def-use /
   liveness for every array operand flowing into the module-level jit
   entry points across all call sites (dispatch, pipeline, fleet, and
@@ -140,6 +148,16 @@ class DataflowError(SeqcheckError):
     like a stack trace."""
 
 
+class CollectiveAuditError(SeqcheckError):
+    """The collective-safety pass (analysis/collectives.py) found a
+    sharding-plane hazard: a collective over an unregistered mesh axis,
+    a replica-divergent collective sequence (the static signature of a
+    multi-host deadlock — fail closed), an implicit partitioner-inserted
+    reshard on a large intermediate, a large operand entering a sharded
+    program unplaced, or lowered ring structure that drifted from
+    ``ring_plan``'s analytic exchange count."""
+
+
 __all__ = [
     "SeqcheckError",
     "ContractViolation",
@@ -155,4 +173,5 @@ __all__ = [
     "LockGraphError",
     "InterleaveViolation",
     "DataflowError",
+    "CollectiveAuditError",
 ]

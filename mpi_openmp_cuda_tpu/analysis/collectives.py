@@ -1,0 +1,764 @@
+"""Collective-safety & comms-cost pass (the sixth analysis tier).
+
+The ``parallel/`` layer was the only tier of the system with zero
+static verification: no pass walked a sharded jaxpr, no model priced a
+byte moved over ICI, and a mis-sequenced collective would surface only
+as a multi-host hang in production.  Following the MPI-rical
+observation that distributed-parallelism errors are statically
+detectable from source (PAPERS.md, arXiv:2305.09438), this pass lowers
+every sharded entry point — each ``parallel/specs.py`` mesh-spec form
+at a representative bucket shape, through the SAME
+``BatchSharding._prepare`` / ``RingSharding._prepare`` derivations the
+production dispatch runs — on the forced multi-device CPU backend and
+proves four properties per program:
+
+1. **Collective inventory** (:func:`collective_inventory`): a recursive
+   jaxpr walk collects every ``psum`` / ``all_gather`` / ``ppermute`` /
+   ``all_to_all`` (and reduce-scatter variants) with its axis names,
+   operand shape, dtype, and payload bytes.  Collectives inside a
+   static-length ``scan`` carry the trip count; the inventory is the
+   per-device collective *sequence* in program order.
+2. **Ordering consistency**: every collective axis name must resolve to
+   a registered mesh axis (an unregistered axis is a typed finding),
+   and the per-position sequence must be provably identical across all
+   mesh positions.  Position-dependence is tracked per mesh axis — a
+   value is *varying* over the axes it was sharded in by
+   ``shard_map``'s ``in_names`` or derived from ``axis_index``; a
+   ``psum``/``all_gather`` over an axis makes its output uniform over
+   that axis again.  A collective under a ``cond`` whose predicate is
+   varying, or under any ``while_loop`` (dynamic trip count — equal
+   per-position sequence lengths cannot be proven), is the static
+   signature of a multi-host deadlock and **fails closed** as a
+   ``divergent-sequence`` finding.
+3. **Resharding hygiene**: the optimized post-partitioning HLO is
+   diffed against the explicit jaxpr inventory — an HLO collective kind
+   with a >= :data:`LARGE_RESHARD_BYTES` payload and no explicit
+   counterpart is an implicit all-gather/reshard the SPMD partitioner
+   inserted behind the program's back (``implicit-reshard``), and any
+   large operand entering a sharded program as a bare host array (no
+   committed ``jax.Array`` placement — a spec that "skipped" the
+   operand) is an ``unsharded-operand`` finding.
+4. **Ring-plan cross-check**: the ring entries' lowered ``ppermute``
+   count must equal ``ring_plan``'s analytic ``R`` — the same number
+   the ICI comms model (``analysis/costmodel.py``) prices, so the
+   modelled ``predicted_scaling_efficiency`` rows and the lowered
+   programs cannot drift apart.
+
+``scripts/comms_audit.py`` (``make comms-audit``) wraps the report in
+the run-report envelope and diffs inventory, ordering signatures, and
+the modelled comms fields against ``tests/golden/comms_audit.json``.
+CPU-only, zero real devices, a few seconds.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import itertools
+import json
+import re
+
+import numpy as np
+
+from . import CollectiveAuditError
+from .traceaudit import LARGE_BUFFER_BYTES
+
+#: Hygiene threshold: an un-annotated intermediate crossing the mesh at
+#: or above this size is a finding.  Deliberately the same bound the
+#: trace-audit donation gate uses for "large" buffers — one notion of
+#: large across the trace tier.
+LARGE_RESHARD_BYTES = LARGE_BUFFER_BYTES
+
+#: jaxpr primitive names that move bytes across the mesh.
+COLLECTIVE_PRIMS = frozenset(
+    {
+        "psum",
+        "pmax",
+        "pmin",
+        "ppermute",
+        "pshuffle",
+        "all_gather",
+        "all_to_all",
+        "reduce_scatter",
+        "psum_scatter",
+    }
+)
+
+#: Collectives whose output is *uniform* over the reduced/gathered axes
+#: (every member holds the same value afterwards) — the varying-axes
+#: tracking subtracts these axes; a ppermute/all_to_all output stays
+#: position-dependent.
+_UNIFORMIZING_PRIMS = frozenset(
+    {"psum", "pmax", "pmin", "all_gather"}
+)
+
+#: jaxpr primitive -> optimized-HLO instruction family, for the
+#: pre/post-partitioning hygiene diff.
+HLO_OF_PRIM = {
+    "psum": "all-reduce",
+    "pmax": "all-reduce",
+    "pmin": "all-reduce",
+    "ppermute": "collective-permute",
+    "pshuffle": "collective-permute",
+    "all_gather": "all-gather",
+    "all_to_all": "all-to-all",
+    "reduce_scatter": "reduce-scatter",
+    "psum_scatter": "reduce-scatter",
+}
+
+#: Optimized-HLO collective matcher: result dtype + dims + op family.
+#: Matches both sync ops and their ``-start`` async halves (``-done``
+#: carries no second collective).  The canonical parser — the test
+#: harness's ``conftest.collective_ops`` delegates here.
+_HLO_COLLECTIVE_RE = re.compile(
+    r"=\s*\(?\s*([a-z0-9]+)\[([\d,]*)\][^=]*?\s"
+    r"(all-gather|all-reduce|collective-permute|all-to-all|"
+    r"reduce-scatter|collective-broadcast)(-start)?\("
+)
+
+_HLO_DTYPE_BYTES = {
+    "pred": 1, "s8": 1, "u8": 1, "s16": 2, "u16": 2, "f16": 2,
+    "bf16": 2, "s32": 4, "u32": 4, "f32": 4, "s64": 8, "u64": 8,
+    "f64": 8,
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class CollectiveOp:
+    """One collective in a lowered program's per-device sequence."""
+
+    op: str  # jaxpr primitive name
+    axes: tuple[str, ...]  # mesh axis names it communicates over
+    shape: tuple[int, ...]  # first operand's (per-device) shape
+    dtype: str
+    payload_bytes: int  # summed over array operands, per invocation
+    count: int  # invocations (enclosing static scan lengths)
+
+    def row(self) -> dict:
+        return {
+            "op": self.op,
+            "axes": list(self.axes),
+            "shape": list(self.shape),
+            "dtype": self.dtype,
+            "payload_bytes": self.payload_bytes,
+            "count": self.count,
+        }
+
+    def describe(self) -> str:
+        axes = ",".join(self.axes) or "-"
+        return (
+            f"{self.op:<12s} axes={axes:<10s} "
+            f"{self.dtype}{list(self.shape)} "
+            f"payload={self.payload_bytes}B x{self.count}"
+        )
+
+
+def hlo_collectives(hlo_text: str) -> list[dict]:
+    """Every cross-device collective of an optimized-HLO dump:
+    ``{"op", "dtype", "elements", "bytes"}`` rows — the statically
+    auditable collective set of a compiled SPMD program, the TPU
+    analogue of reading the MPI calls off the reference's main.c."""
+    rows = []
+    for m in _HLO_COLLECTIVE_RE.finditer(hlo_text):
+        dims = [int(d) for d in m.group(2).split(",") if d]
+        elements = int(np.prod(dims)) if dims else 1
+        itemsize = _HLO_DTYPE_BYTES.get(m.group(1), 4)
+        rows.append(
+            {
+                "op": m.group(3),
+                "dtype": m.group(1),
+                "elements": elements,
+                "bytes": elements * itemsize,
+            }
+        )
+    return rows
+
+
+# -- the jaxpr walk ---------------------------------------------------------
+
+
+def _unwrap_jaxpr(val):
+    """The raw ``Jaxpr`` under a ClosedJaxpr/param value, else None."""
+    seen = 0
+    while hasattr(val, "jaxpr") and seen < 4:
+        val = val.jaxpr
+        seen += 1
+    return val if hasattr(val, "eqns") else None
+
+
+def _iter_sub_jaxprs(params: dict):
+    """Every raw sub-jaxpr reachable from an eqn's params (the
+    traceaudit recursion idiom, shared here)."""
+    for val in params.values():
+        items = val if isinstance(val, (tuple, list)) else (val,)
+        for item in items:
+            sub = _unwrap_jaxpr(item)
+            if sub is not None:
+                yield sub
+
+
+def _contains_collective(jaxpr) -> bool:
+    for eqn in jaxpr.eqns:
+        if eqn.primitive.name in COLLECTIVE_PRIMS:
+            return True
+        for sub in _iter_sub_jaxprs(eqn.params):
+            if _contains_collective(sub):
+                return True
+    return False
+
+
+def _uses_axis_index(jaxpr) -> bool:
+    for eqn in jaxpr.eqns:
+        if eqn.primitive.name == "axis_index":
+            return True
+        for sub in _iter_sub_jaxprs(eqn.params):
+            if _uses_axis_index(sub):
+                return True
+    return False
+
+
+def _collective_axes(params: dict) -> tuple[str, ...]:
+    """Mesh axis names a collective eqn communicates over.  ``psum``
+    spells them ``axes``, the rest ``axis_name``; positional (int)
+    vmap axes are not mesh axes and are skipped."""
+    axes = params.get("axes", params.get("axis_name", ()))
+    if axes is None:
+        axes = ()
+    if not isinstance(axes, (tuple, list)):
+        axes = (axes,)
+    return tuple(str(a) for a in axes if not isinstance(a, int))
+
+
+def _names_union(names) -> frozenset:
+    """Union of the axis names in one shard_map ``in_names``/
+    ``out_names`` dict ({dim: (axis, ...)})."""
+    out: set[str] = set()
+    for axes in (names or {}).values():
+        axes = axes if isinstance(axes, (tuple, list)) else (axes,)
+        out.update(str(a) for a in axes)
+    return frozenset(out)
+
+
+class _Walker:
+    """One program's inventory walk with per-axis position-dependence
+    tracking.  ``varying`` maps ``id(var)`` to the frozenset of mesh
+    axes the value differs over; uniform values are simply absent."""
+
+    def __init__(self, entry: str, registered: frozenset):
+        self.entry = entry
+        self.registered = registered
+        self.ops: list[CollectiveOp] = []
+        self.findings: list[dict] = []
+
+    def _finding(self, kind: str, detail: str):
+        self.findings.append(
+            {"kind": kind, "entry": self.entry, "detail": detail}
+        )
+
+    @staticmethod
+    def _ax(varying: dict, v) -> frozenset:
+        if hasattr(v, "val"):  # Literal: a host constant, uniform
+            return frozenset()
+        return varying.get(id(v), frozenset())
+
+    def _record(self, eqn, repeat: int):
+        axes = _collective_axes(eqn.params)
+        for a in axes:
+            if a not in self.registered:
+                self._finding(
+                    "unregistered-axis",
+                    f"{eqn.primitive.name} over axis {a!r}, which is not "
+                    f"a registered mesh axis "
+                    f"({sorted(self.registered)}): the collective would "
+                    "fail to resolve (or silently bind a different mesh) "
+                    "at dispatch",
+                )
+        shape: tuple[int, ...] = ()
+        dtype = "?"
+        payload = 0
+        for v in eqn.invars:
+            aval = getattr(v, "aval", None)
+            if aval is None or not getattr(aval, "shape", None) and not (
+                hasattr(aval, "dtype")
+            ):
+                continue
+            nbytes = int(np.prod(aval.shape, dtype=np.int64)) * int(
+                np.dtype(aval.dtype).itemsize
+            )
+            if not shape and not payload:
+                shape = tuple(int(d) for d in aval.shape)
+                dtype = str(np.dtype(aval.dtype))
+            payload += nbytes
+        self.ops.append(
+            CollectiveOp(
+                op=eqn.primitive.name,
+                axes=axes,
+                shape=shape,
+                dtype=dtype,
+                payload_bytes=payload,
+                count=repeat,
+            )
+        )
+
+    def walk(self, jaxpr, varying: dict, repeat: int = 1) -> list:
+        """Walk one raw jaxpr; returns the varying-axes sets of its
+        outvars.  ``varying`` seeds the invars (keyed by ``id``)."""
+        for eqn in jaxpr.eqns:
+            name = eqn.primitive.name
+            in_ax: frozenset = frozenset()
+            for v in eqn.invars:
+                in_ax |= self._ax(varying, v)
+
+            if name == "axis_index":
+                out_ax = in_ax | {str(eqn.params.get("axis_name"))}
+            elif name in COLLECTIVE_PRIMS:
+                self._record(eqn, repeat)
+                axes = frozenset(_collective_axes(eqn.params))
+                if name in _UNIFORMIZING_PRIMS:
+                    out_ax = in_ax - axes
+                else:
+                    out_ax = in_ax | axes
+            elif name == "cond":
+                out_ax = self._walk_cond(eqn, varying, repeat, in_ax)
+            elif name == "while":
+                out_ax = self._walk_while(eqn, varying, repeat, in_ax)
+            elif name == "scan":
+                out_ax = self._walk_scan(eqn, varying, repeat, in_ax)
+            elif name == "shard_map":
+                out_ax = self._walk_shard_map(eqn, varying, repeat)
+            else:
+                out_ax = self._walk_generic(eqn, varying, repeat, in_ax)
+
+            for v in eqn.outvars:
+                if out_ax:
+                    varying[id(v)] = out_ax
+        return [self._ax(varying, v) for v in jaxpr.outvars]
+
+    def _seed(self, sub, eqn_invars, varying, in_ax) -> dict:
+        """Seed a sub-jaxpr's invars: positional when the arities line
+        up, else conservatively the union of the caller's axes."""
+        inner: dict = {}
+        if len(sub.invars) == len(eqn_invars):
+            for iv, ov in zip(sub.invars, eqn_invars):
+                ax = self._ax(varying, ov)
+                if ax:
+                    inner[id(iv)] = ax
+        else:
+            for iv in sub.invars:
+                if in_ax:
+                    inner[id(iv)] = in_ax
+        return inner
+
+    def _walk_cond(self, eqn, varying, repeat, in_ax) -> frozenset:
+        branches = eqn.params.get("branches") or ()
+        subs = [_unwrap_jaxpr(b) for b in branches]
+        subs = [s for s in subs if s is not None]
+        pred_ax = self._ax(varying, eqn.invars[0])
+        if any(_uses_axis_index(s) for s in subs):
+            pred_ax = pred_ax  # predicate divergence is what matters
+        has_coll = any(_contains_collective(s) for s in subs)
+        if has_coll and pred_ax:
+            self._finding(
+                "divergent-sequence",
+                "collective inside a cond whose predicate varies over "
+                f"mesh axes {sorted(pred_ax)}: mesh positions would "
+                "take different branches and issue DIFFERENT collective "
+                "sequences — the static signature of a multi-host "
+                "deadlock (fail closed)",
+            )
+        out_ax = in_ax
+        for sub in subs:
+            inner = self._seed(sub, eqn.invars[1:], varying, in_ax)
+            for ax in self.walk(sub, inner, repeat):
+                out_ax |= ax
+        return out_ax | pred_ax
+
+    def _walk_while(self, eqn, varying, repeat, in_ax) -> frozenset:
+        subs = list(_iter_sub_jaxprs(eqn.params))
+        if any(_contains_collective(s) for s in subs):
+            self._finding(
+                "divergent-sequence",
+                "collective inside a while_loop: the trip count is "
+                "dynamic, so equal per-position collective-sequence "
+                "lengths cannot be proven statically (fail closed); "
+                "use a static-length scan or hoist the collective",
+            )
+        out_ax = in_ax
+        for sub in subs:
+            inner = {}
+            for iv in sub.invars:
+                if in_ax:
+                    inner[id(iv)] = in_ax
+            for ax in self.walk(sub, inner, repeat):
+                out_ax |= ax
+        return out_ax
+
+    def _walk_scan(self, eqn, varying, repeat, in_ax) -> frozenset:
+        length = eqn.params.get("length") or 1
+        out_ax = in_ax
+        for sub in _iter_sub_jaxprs(eqn.params):
+            inner = {}
+            for iv in sub.invars:
+                if in_ax:
+                    inner[id(iv)] = in_ax
+            for ax in self.walk(sub, inner, repeat * int(length)):
+                out_ax |= ax
+        return out_ax
+
+    def _walk_shard_map(self, eqn, varying, repeat) -> frozenset:
+        sub = _unwrap_jaxpr(eqn.params.get("jaxpr"))
+        in_names = eqn.params.get("in_names") or ()
+        out_names = eqn.params.get("out_names") or ()
+        if sub is None:
+            return frozenset()
+        inner: dict = {}
+        body_invars = sub.invars[-len(in_names):] if in_names else sub.invars
+        for iv, names in zip(body_invars, in_names):
+            ax = _names_union(names) | self._ax(varying, iv)
+            if ax:
+                inner[id(iv)] = ax
+        self.walk(sub, inner, repeat)
+        out_ax: frozenset = frozenset()
+        for names in out_names:
+            out_ax |= _names_union(names)
+        return out_ax
+
+    def _walk_generic(self, eqn, varying, repeat, in_ax) -> frozenset:
+        subs = list(_iter_sub_jaxprs(eqn.params))
+        if not subs:
+            return in_ax
+        out_ax = in_ax
+        for sub in subs:
+            inner = self._seed(sub, eqn.invars, varying, in_ax)
+            for ax in self.walk(sub, inner, repeat):
+                out_ax |= ax
+        return out_ax
+
+
+def collective_inventory(
+    fn, args, registered_axes, entry: str = "program"
+) -> tuple[list[CollectiveOp], list[dict]]:
+    """Trace ``fn(*args)`` and walk the jaxpr: the per-device collective
+    sequence in program order, plus the ordering findings (unregistered
+    axes, divergent branches — see the module docstring).  ``fn`` may be
+    a jitted wrapper; the walk recurses through pjit/shard_map/control-
+    flow sub-jaxprs."""
+    import jax
+
+    closed = jax.make_jaxpr(fn)(*args)
+    walker = _Walker(entry, frozenset(str(a) for a in registered_axes))
+    walker.walk(closed.jaxpr, {})
+    return walker.ops, walker.findings
+
+
+def ordering_signature(ops: list[CollectiveOp]) -> str:
+    """Stable digest of one per-device collective sequence: op, axes,
+    shape, dtype, payload, count — in program order."""
+    blob = json.dumps([op.row() for op in ops], sort_keys=True)
+    return hashlib.sha256(blob.encode()).hexdigest()[:16]
+
+
+def mesh_positions(mesh) -> list[tuple[int, ...]]:
+    """Every coordinate of the mesh, in axis order."""
+    sizes = [int(mesh.shape[a]) for a in mesh.axis_names]
+    return list(itertools.product(*[range(s) for s in sizes]))
+
+
+def operand_placement(
+    entry: str, args, threshold: int = LARGE_RESHARD_BYTES
+) -> list[dict]:
+    """Hygiene gate on a sharded program's operands: every array at or
+    above ``threshold`` must enter as a committed ``jax.Array`` (a
+    ``NamedSharding`` placement from ``_put_global``) — a bare host
+    array is an operand the spec *skipped*, which the partitioner will
+    reshard implicitly on every dispatch."""
+    import jax
+
+    findings = []
+    for i, a in enumerate(args):
+        nbytes = int(getattr(a, "nbytes", 0) or 0)
+        if nbytes < threshold:
+            continue
+        if not isinstance(a, jax.Array):
+            findings.append(
+                {
+                    "kind": "unsharded-operand",
+                    "entry": entry,
+                    "detail": (
+                        f"operand {i} ({type(a).__name__}, {nbytes} B) "
+                        "enters the sharded program as a bare host "
+                        "array — the sharding spec skipped it, so the "
+                        "partitioner reshards it implicitly on every "
+                        "dispatch; place it with _put_global / "
+                        "jax.device_put(NamedSharding(...))"
+                    ),
+                }
+            )
+    return findings
+
+
+def reshard_hygiene(
+    entry: str,
+    hlo_text: str,
+    ops: list[CollectiveOp],
+    threshold: int = LARGE_RESHARD_BYTES,
+) -> tuple[list[dict], list[dict]]:
+    """Diff the post-partitioning HLO collectives against the explicit
+    jaxpr inventory.  Returns ``(hlo_rows, findings)``: an HLO
+    collective *kind* with a >= ``threshold`` payload and no explicit
+    jaxpr counterpart is an implicit reshard the partitioner inserted
+    (an un-annotated intermediate crossing the mesh).  Counts are not
+    compared — async splitting and fusion legitimately reshape them;
+    the kind set plus the large-payload gate is the stable contract."""
+    explicit_kinds = {HLO_OF_PRIM.get(op.op) for op in ops}
+    rows = hlo_collectives(hlo_text)
+    findings = []
+    for row in rows:
+        if row["bytes"] >= threshold and row["op"] not in explicit_kinds:
+            findings.append(
+                {
+                    "kind": "implicit-reshard",
+                    "entry": entry,
+                    "detail": (
+                        f"partitioner inserted a {row['op']} of "
+                        f"{row['bytes']} B ({row['dtype']}, "
+                        f"{row['elements']} elements) with no explicit "
+                        "collective in the program — an un-annotated "
+                        "intermediate is crossing the mesh; annotate "
+                        "the sharding (in_specs/out_specs) or move the "
+                        "exchange into an explicit parallel/ collective"
+                    ),
+                }
+            )
+    return rows, findings
+
+
+# -- the entry-point audit --------------------------------------------------
+
+#: Every mesh-spec grammar form (parallel/specs.py), audited through
+#: the real strategy ``_prepare`` derivations at the representative
+#: bucket shape below.  Values: devices the spec needs.
+AUDIT_SPECS: dict[str, int] = {
+    "2": 2,
+    "batch:2": 2,
+    "seq:4": 4,
+    "2x2": 4,
+}
+
+#: Representative bucket shape: Seq1 of 150 chars (l1p = 256 after the
+#: 128-lane round-up, so the ring path takes R >= 2 neighbour
+#: exchanges) and six Seq2 rows topping out at 100 (l2p = 128).
+_REP_LEN1 = 150
+_REP_LEN2S = (100, 60, 40, 100, 25, 7)
+_REP_WEIGHTS = (2, 2, 1, 10)
+
+
+def _representative_batch():
+    from ..ops.dispatch import pad_problem
+    from ..ops.values import value_table
+
+    rng = np.random.default_rng(14)
+    seq1 = rng.integers(1, 27, size=_REP_LEN1).astype(np.int32)
+    seq2s = [
+        rng.integers(1, 27, size=n).astype(np.int32) for n in _REP_LEN2S
+    ]
+    batch = pad_problem(seq1, seq2s)
+    val_flat = value_table(_REP_WEIGHTS).astype(np.int32).reshape(-1)
+    return batch, val_flat
+
+
+def audit_program(
+    entry: str, fn, args, mesh, *, compile_hlo: bool = True
+) -> tuple[dict, list[dict]]:
+    """Audit one prepared sharded program: inventory + ordering +
+    hygiene.  Returns ``(entry_row, findings)``."""
+    registered = tuple(str(a) for a in mesh.axis_names)
+    ops, findings = collective_inventory(
+        fn, args, registered, entry=entry
+    )
+    findings = list(findings)
+    findings.extend(operand_placement(entry, args))
+    hlo_rows: list[dict] = []
+    if compile_hlo:
+        hlo_text = fn.lower(*args).compile().as_text()
+        hlo_rows, hygiene = reshard_hygiene(entry, hlo_text, ops)
+        findings.extend(hygiene)
+    divergent = any(f["kind"] == "divergent-sequence" for f in findings)
+    sig = ordering_signature(ops)
+    positions = mesh_positions(mesh)
+    row = {
+        "entry": entry,
+        "mesh_axes": {
+            str(a): int(mesh.shape[a]) for a in mesh.axis_names
+        },
+        "collectives": [op.row() for op in ops],
+        "payload_bytes": sum(op.payload_bytes * op.count for op in ops),
+        "signature": sig,
+        "positions": len(positions),
+        "per_position": [
+            {"position": list(p), "signature": sig} for p in positions
+        ],
+        "consistent": not divergent,
+        "hlo_collectives": [
+            {"op": r["op"], "bytes": r["bytes"]} for r in hlo_rows
+        ],
+    }
+    return row, findings
+
+
+def audit_spec_entries(
+    *, compile_hlo: bool = True, max_devices: int | None = None
+) -> tuple[list[dict], list[dict]]:
+    """Lower every ``AUDIT_SPECS`` mesh form through the production
+    ``_prepare`` derivations at the representative bucket shape and
+    audit each program.  ``max_devices`` skips the specs this process
+    cannot mesh (bench on a single real chip); the driver paths force
+    8 virtual CPU devices and cover all of them."""
+    import jax
+
+    from ..parallel.specs import build_sharding
+
+    avail = len(jax.devices())
+    if max_devices is not None:
+        avail = min(avail, max_devices)
+    batch, val_flat = _representative_batch()
+    entries: list[dict] = []
+    findings: list[dict] = []
+    for spec, need in AUDIT_SPECS.items():
+        if need > avail:
+            continue
+        strategy = build_sharding(spec)
+        fn, args, _ = strategy._prepare(batch, val_flat, backend="xla")
+        entry = f"{type(strategy).__name__}[{spec}]"
+        row, found = audit_program(
+            entry, fn, args, strategy.mesh, compile_hlo=compile_hlo
+        )
+        row["spec"] = spec
+        entries.append(row)
+        findings.extend(found)
+    return entries, findings
+
+
+def ring_crosscheck(entries: list[dict]) -> tuple[list[dict], list[dict]]:
+    """Pin the lowered ring entries to ``ring_plan``'s analytic ``R``:
+    the count the ICI comms model prices.  Drift between the plan
+    arithmetic and the lowered program is a ``ring-plan-drift``
+    finding — the scaling-efficiency rows would be pricing a program
+    that no longer exists."""
+    from ..parallel.ring import ring_plan
+
+    batch, _ = _representative_batch()
+    rows: list[dict] = []
+    findings: list[dict] = []
+    for e in entries:
+        sp = e["mesh_axes"].get("seq", 1)
+        if sp <= 1:
+            continue
+        _, r_planned = ring_plan(batch.l1p, batch.l2p, sp, pallas=False)
+        permutes = sum(
+            op["count"]
+            for op in e["collectives"]
+            if op["op"] == "ppermute"
+        )
+        gathers = sum(
+            op["count"]
+            for op in e["collectives"]
+            if op["op"] == "all_gather"
+        )
+        ok = permutes == r_planned and gathers == 1
+        rows.append(
+            {
+                "entry": e["entry"],
+                "planned_r": int(r_planned),
+                "lowered_ppermutes": int(permutes),
+                "lowered_all_gathers": int(gathers),
+                "match": ok,
+            }
+        )
+        if not ok:
+            findings.append(
+                {
+                    "kind": "ring-plan-drift",
+                    "entry": e["entry"],
+                    "detail": (
+                        f"ring_plan says R={r_planned} neighbour "
+                        f"exchanges + 1 candidate all_gather, the "
+                        f"lowered program performs {permutes} + "
+                        f"{gathers}: the comms model and the program "
+                        "have drifted apart (parallel/ring.py vs "
+                        "analysis/costmodel.py)"
+                    ),
+                }
+            )
+    return rows, findings
+
+
+def audit_collectives(*, compile_hlo: bool = True) -> dict:
+    """The full comms-audit body: per-spec entries, findings, the ring
+    cross-check, and the modelled ICI comms/scaling sheet for the
+    production schedule (``analysis/costmodel.py``)."""
+    from ..models.workload import input3_class_problem
+    from .costmodel import schedule_cost_sheet
+
+    entries, findings = audit_spec_entries(compile_hlo=compile_hlo)
+    ring_rows, ring_findings = ring_crosscheck(entries)
+    findings = findings + ring_findings
+    sheet = schedule_cost_sheet(input3_class_problem(), "pallas")
+    comms = sheet.get("comms")
+    return {
+        "entries": entries,
+        "ring_crosscheck": ring_rows,
+        "findings": findings,
+        "comms": comms,
+        "counts": {
+            "entries": len(entries),
+            "collectives": sum(
+                sum(op["count"] for op in e["collectives"])
+                for e in entries
+            ),
+            "payload_bytes": sum(e["payload_bytes"] for e in entries),
+            "findings": len(findings),
+        },
+    }
+
+
+def inventory_totals(*, max_devices: int | None = None) -> dict:
+    """Never-fatal summary for ``bench.py comms_record``: inventory
+    totals over the specs the current device count can mesh (a single
+    real chip audits nothing and reports zero entries — the CPU audit
+    paths force 8 virtual devices and cover all specs)."""
+    entries, findings = audit_spec_entries(
+        compile_hlo=False, max_devices=max_devices
+    )
+    return {
+        "entries": len(entries),
+        "collectives": sum(
+            sum(op["count"] for op in e["collectives"]) for e in entries
+        ),
+        "payload_bytes": sum(e["payload_bytes"] for e in entries),
+        "findings": len(findings),
+    }
+
+
+def run_or_raise() -> dict:
+    """Driver entry (``scripts/analyze.py``): run the audit, raise
+    :class:`CollectiveAuditError` naming every finding, return the
+    body when clean."""
+    body = audit_collectives()
+    if body["findings"]:
+        rows = "\n  ".join(
+            f"[{f['kind']}] {f['entry']}: {f['detail']}"
+            for f in body["findings"]
+        )
+        raise CollectiveAuditError(
+            f"collective audit: {len(body['findings'])} finding(s):\n"
+            f"  {rows}"
+        )
+    if not any(e["collectives"] for e in body["entries"]):
+        raise CollectiveAuditError(
+            "collective audit inventoried ZERO collectives across every "
+            "sharded entry point — the ring path should contribute R "
+            "ppermutes + 1 all_gather; the walk or the entry derivations "
+            "have drifted (analysis/collectives.py)"
+        )
+    return body

@@ -26,6 +26,13 @@ Three products:
   warm first (ROADMAP item 5).
 * :func:`predicted_mfu_vs_feed_roofline` — the single scalar for
   bench.py's record.
+* :func:`ici_collective_wall_s` + the sheet's ``comms`` section — the
+  ICI comms model (ring-algorithm bytes x link bandwidth + hop
+  latency): a comms roofline next to the feed roofline, priced into
+  ``predicted_scaling_efficiency`` rows for 2x/4x/8x meshes on both
+  partition axes, which the collective audit
+  (``analysis/collectives.py``) golden-pins and MULTICHIP_r*.json can
+  later be audited against.
 
 Model scope (documented, deliberately): the kernel wall is the
 calibrated per-iteration model (log-err 0.025–0.038 vs measured kernel
@@ -62,6 +69,50 @@ LAUNCH_OVERHEAD_S = 2.0e-6
 
 #: Traffic the value table contributes per launch (27*27 int32).
 _VAL_BYTES = 27 * 27 * 4
+
+#: Nominal per-link ICI bandwidth (one direction of one ring link) and
+#: per-hop latency for the comms model.  Deliberate model constants in
+#: the :data:`LAUNCH_OVERHEAD_S` tradition — NOT fitted to a measured
+#: multi-chip record, so the modelled ``predicted_scaling_efficiency``
+#: stays an independent prediction MULTICHIP_r*.json can be audited
+#: against.  45 GB/s is the order of one v4/v5e ICI link direction.
+ICI_LINK_GBYTES_S = 45.0
+ICI_HOP_LATENCY_S = 1.0e-6
+
+#: Mesh sizes the scaling sheet prices (ISSUE 14: 2x/4x/8x).
+SCALING_MESH_SIZES = (2, 4, 8)
+
+
+def ici_collective_wall_s(
+    op: str, payload_bytes: int, axis_size: int
+) -> float:
+    """Modelled wall of one collective over an ``axis_size``-member ring
+    (the ICI topology both the TPU interconnect and ``parallel/ring.py``
+    assume): standard ring-algorithm costs in bytes x link bandwidth
+    plus hop latency.
+
+    - ``ppermute``: one neighbour hop — ``b/bw + hop``.
+    - ``all_gather``: N-1 ring steps each moving the payload —
+      ``(N-1) * (b/bw + hop)``.
+    - ``psum`` (all-reduce): reduce-scatter + all-gather —
+      ``2(N-1)/N * b/bw + 2(N-1) * hop``.
+    - ``all_to_all`` / ``reduce_scatter``: ``(N-1)/N * b/bw +
+      (N-1) * hop``.
+    """
+    if axis_size <= 1:
+        return 0.0
+    bw = ICI_LINK_GBYTES_S * 1e9
+    n = axis_size
+    b = float(payload_bytes)
+    if op in ("ppermute", "pshuffle"):
+        return b / bw + ICI_HOP_LATENCY_S
+    if op == "all_gather":
+        return (n - 1) * (b / bw + ICI_HOP_LATENCY_S)
+    if op in ("psum", "pmax", "pmin"):
+        return 2 * (n - 1) / n * b / bw + 2 * (n - 1) * ICI_HOP_LATENCY_S
+    if op in ("all_to_all", "reduce_scatter", "psum_scatter"):
+        return (n - 1) / n * b / bw + (n - 1) * ICI_HOP_LATENCY_S
+    raise CostModelError(f"no ICI cost rule for collective {op!r}")
 
 
 def _lens_hist(lens) -> tuple:
@@ -241,6 +292,59 @@ def _bucket_bytes_moved(cfg, est_a_bytes: int) -> int:
     return est_a_bytes + rows + lens + out + seq1ext + _VAL_BYTES
 
 
+def _scaling_rows(
+    cfg_costs: list, total_model_s: float, total_launches: int,
+    backend: str,
+) -> list[dict]:
+    """``predicted_scaling_efficiency`` rows for 2x/4x/8x meshes, one
+    per (mesh size, partition axis).  Batch partitioning shards each
+    chunk's rows across devices (``parallel/sharding.py``): compute
+    divides by N, every device still walks the full launch sequence,
+    comms is zero.  Seq partitioning is
+    the ring (``parallel/ring.py``): compute divides by N, but every
+    bucket pays ``ring_plan``'s R neighbour exchanges plus the
+    candidate all_gather per chunk — priced by
+    :func:`ici_collective_wall_s`, the comms roofline next to the feed
+    roofline.  Efficiency is ``T1 / (N * T_N)``."""
+    from ..parallel.ring import ring_plan
+
+    t1 = total_model_s + total_launches * LAUNCH_OVERHEAD_S
+    rows = []
+    for n in SCALING_MESH_SIZES:
+        # -- batch axis: rows shard over devices, no collectives --
+        tn = total_model_s / n + total_launches * LAUNCH_OVERHEAD_S
+        rows.append(
+            {
+                "mesh": n,
+                "axis": "batch",
+                "comms_wall_us": 0.0,
+                "predicted_wall_us": round(tn * 1e6, 3),
+                "predicted_scaling_efficiency": round(t1 / (n * tn), 3),
+            }
+        )
+        # -- seq axis: the ring pays R ppermutes + a candidate gather --
+        comms_s = 0.0
+        for cfg, _ in cfg_costs:
+            bs, r = ring_plan(
+                cfg.l1p, cfg.l2p, n, pallas=(backend == "pallas")
+            )
+            comms_s += cfg.n_chunks * (
+                r * ici_collective_wall_s("ppermute", bs * 4, n)
+                + ici_collective_wall_s("all_gather", cfg.cb * 4 * 4, n)
+            )
+        tn = total_model_s / n + total_launches * LAUNCH_OVERHEAD_S + comms_s
+        rows.append(
+            {
+                "mesh": n,
+                "axis": "seq",
+                "comms_wall_us": round(comms_s * 1e6, 3),
+                "predicted_wall_us": round(tn * 1e6, 3),
+                "predicted_scaling_efficiency": round(t1 / (n * tn), 3),
+            }
+        )
+    return rows
+
+
 def schedule_cost_sheet(problem, backend: str = "pallas") -> dict:
     """Price ``problem``'s composed production bucket schedule.
 
@@ -270,6 +374,7 @@ def schedule_cost_sheet(problem, backend: str = "pallas") -> dict:
             "totals": None,
             "predicted_mfu_vs_feed_roofline": None,
             "hot_configs": [],
+            "comms": None,
         }
 
     feed = cfgs[0].feed
@@ -280,6 +385,7 @@ def schedule_cost_sheet(problem, backend: str = "pallas") -> dict:
     total_bytes = 0
     total_launches = 0
     total_model_s = 0.0
+    cfg_costs: list = []
     by_key: dict[tuple, dict] = {}
     for cfg in cfgs:
         nbn, nbi = cfg.l1p // _BLK, cfg.l2p // _BLK
@@ -332,6 +438,7 @@ def schedule_cost_sheet(problem, backend: str = "pallas") -> dict:
         total_bytes += b_bytes
         total_launches += cfg.n_chunks
         total_model_s += b_model_s
+        cfg_costs.append((cfg, b_model_s))
         agg = by_key.setdefault(
             cfg.cache_key,
             {
@@ -394,6 +501,13 @@ def schedule_cost_sheet(problem, backend: str = "pallas") -> dict:
             total_flops / predicted_wall_s / (roof * 1e12), 3
         ),
         "hot_configs": hot_rows,
+        "comms": {
+            "ici_link_gbytes_s": ICI_LINK_GBYTES_S,
+            "ici_hop_latency_us": round(ICI_HOP_LATENCY_S * 1e6, 3),
+            "scaling": _scaling_rows(
+                cfg_costs, total_model_s, total_launches, backend
+            ),
+        },
     }
 
 

@@ -69,6 +69,15 @@ SEQ011   every module-level ``jax.jit(...)`` assignment declares its
          unannotated jit entry is a silent donation-coverage hole — the
          drift that kept the chunk pipeline at zero donation from PR 2
          through PR 12.
+SEQ012   raw ``jax.lax`` collectives (``psum`` / ``ppermute`` /
+         ``all_gather`` / ``all_to_all`` and friends) are legal only in
+         the ``parallel/`` layer — elsewhere they must route through
+         the ``parallel/`` wrappers so the collective-safety audit
+         (``analysis/collectives.py``) inventories every byte that
+         crosses the mesh.  Even inside ``parallel/``, every collective
+         call must pass an explicit ``axis_name=`` keyword: a
+         positional or implicit axis evades the audit's axis-resolution
+         check and is exactly how an unregistered-axis hazard ships.
 =======  ==================================================================
 
 Suppression: append ``# seqlint: disable=SEQ00N`` to the offending line
@@ -104,6 +113,7 @@ ROLE_INSTRUMENTED = "instrumented"  # SEQ006: stderr rides the event bus
 ROLE_SERVE = "serve-plane"  # SEQ007 waits + SEQ008 shared-state lock
 ROLE_WAIT_HOME = "serve-clock-home"  # the one legal blocking-wait seam
 ROLE_ENV_HOME = "env-home"  # the one legal os.environ reader
+ROLE_COLLECTIVE_HOME = "collective-home"  # SEQ012: raw lax collectives legal
 ROLE_HOST = "host"  # plain host-side module; only SEQ002/SEQ004 apply
 
 #: EXHAUSTIVE classification of the package tree.  Exact file entries
@@ -119,7 +129,11 @@ _MODULE_CLASSES: dict[str, tuple[str, ...]] = {
     "utils/platform.py": (ROLE_ENV_HOME, ROLE_INSTRUMENTED),
     "utils/journal.py": (ROLE_DETERMINISTIC, ROLE_INSTRUMENTED),
     "ops/dispatch.py": (ROLE_TRACED, ROLE_INSTRUMENTED),
-    "parallel/distributed.py": (ROLE_TRACED, ROLE_INSTRUMENTED),
+    "parallel/distributed.py": (
+        ROLE_TRACED,
+        ROLE_INSTRUMENTED,
+        ROLE_COLLECTIVE_HOME,
+    ),
     "io/pipeline.py": (ROLE_INSTRUMENTED,),
     "serve/clock.py": (ROLE_WAIT_HOME,),
     "serve/queue.py": (ROLE_SERVE, ROLE_DETERMINISTIC),
@@ -146,13 +160,18 @@ _MODULE_CLASSES: dict[str, tuple[str, ...]] = {
     # (explicit row because its plan is what SEQ011's annotations are
     # cross-checked against — the pass and the rule land together).
     "analysis/dataflow.py": (ROLE_HOST,),
+    # The collective-safety pass: host-side jaxpr walking over the
+    # sharded entry points (explicit row because its inventory is what
+    # SEQ012's routing rule protects — the pass and the rule land
+    # together; it WALKS collectives, it never issues one).
+    "analysis/collectives.py": (ROLE_HOST,),
     # -- directory defaults ------------------------------------------------
     # The AOT warm plane is host-side orchestration whose diagnostics
     # ride the event bus; its timers (compile walls) are measurements,
     # not decisions, so SEQ005 does not apply.
     "aot/": (ROLE_INSTRUMENTED,),
     "ops/": (ROLE_TRACED,),
-    "parallel/": (ROLE_TRACED,),
+    "parallel/": (ROLE_TRACED, ROLE_COLLECTIVE_HOME),
     "resilience/": (ROLE_DETERMINISTIC, ROLE_INSTRUMENTED),
     "serve/": (ROLE_SERVE,),
     "analysis/": (ROLE_HOST,),
@@ -226,6 +245,13 @@ _SEQ010_OS_ATTRS = (
     "replace", "fsync", "link", "unlink", "makedirs", "rename",
     "remove", "rmdir", "listdir", "walk",
 )
+
+#: SEQ012's collective set — keep in sync with
+#: ``analysis.collectives.COLLECTIVE_PRIMS`` (the jaxpr-level mirror).
+_COLLECTIVE_NAMES = {
+    "psum", "pmax", "pmin", "ppermute", "pshuffle", "all_gather",
+    "all_to_all", "reduce_scatter", "psum_scatter",
+}
 
 _SUPPRESS_RE = re.compile(r"#\s*seqlint:\s*disable=([A-Z0-9, ]+)")
 _SUPPRESS_FILE_RE = re.compile(r"#\s*seqlint:\s*disable-file=([A-Z0-9, ]+)")
@@ -311,6 +337,7 @@ class _Linter(ast.NodeVisitor):
         self.in_deterministic = ROLE_DETERMINISTIC in roles
         self.in_instrumented = ROLE_INSTRUMENTED in roles
         self.in_serve = ROLE_SERVE in roles
+        self.in_collective_home = ROLE_COLLECTIVE_HOME in roles
         # SEQ010 lexical state: the guard attrs of each enclosing class,
         # the local guard names of each enclosing function, and the
         # stack of guards currently held by enclosing `with` bodies.
@@ -758,6 +785,40 @@ class _Linter(ast.NodeVisitor):
                     "environment read outside utils/platform.py; add the "
                     "variable to the env registry (utils.platform) and "
                     "use its typed accessor",
+                )
+
+        # SEQ012: raw lax collectives outside parallel/, implicit axes.
+        coll_name = None
+        if isinstance(func, ast.Attribute) and func.attr in _COLLECTIVE_NAMES:
+            base = func.value
+            if (isinstance(base, ast.Name) and base.id == "lax") or (
+                isinstance(base, ast.Attribute)
+                and base.attr == "lax"
+                and isinstance(base.value, ast.Name)
+                and base.value.id == "jax"
+            ):
+                coll_name = func.attr
+        elif isinstance(func, ast.Name) and func.id in _COLLECTIVE_NAMES:
+            coll_name = func.id
+        if coll_name is not None:
+            if not self.in_collective_home:
+                self._emit(
+                    "SEQ012",
+                    node,
+                    f"raw jax.lax collective `{coll_name}` outside the "
+                    "parallel/ layer; route through the parallel/ "
+                    "wrappers (ring/sharding strategies) so the "
+                    "collective-safety audit (analysis/collectives.py) "
+                    "inventories every byte crossing the mesh",
+                )
+            elif not any(kw.arg == "axis_name" for kw in node.keywords):
+                self._emit(
+                    "SEQ012",
+                    node,
+                    f"collective `{coll_name}` without an explicit "
+                    "axis_name= keyword; a positional/implicit axis "
+                    "evades the audit's axis-resolution check — name "
+                    "the mesh axis (axis_name=SEQ_AXIS / BATCH_AXIS)",
                 )
 
         # SEQ005: wall-clock in deterministic paths.

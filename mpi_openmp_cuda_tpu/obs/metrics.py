@@ -636,6 +636,65 @@ def validate_report(rec) -> None:
                     "undonated_large_buffers and a pinned_live list, "
                     f"got {don!r}"
                 )
+    elif kind == "comms-audit":
+        # scripts/comms_audit.py's collective-safety & comms-cost report.
+        entries = rec.get("entries")
+        if not isinstance(entries, list) or not entries:
+            problems.append(
+                f"entries: want a non-empty list, got {entries!r}"
+            )
+        else:
+            for i, e in enumerate(entries):
+                if (
+                    not isinstance(e, dict)
+                    or not isinstance(e.get("spec"), str)
+                    or not isinstance(e.get("collectives"), list)
+                    or not isinstance(e.get("signature"), str)
+                    or not isinstance(e.get("consistent"), bool)
+                    or not isinstance(e.get("positions"), int)
+                ):
+                    problems.append(
+                        f"entries[{i}]: want spec/signature strs, a "
+                        "collectives list, consistent bool, positions "
+                        f"int, got {e!r}"
+                    )
+        if not isinstance(rec.get("findings"), list):
+            problems.append(
+                f"findings: want a list, got {rec.get('findings')!r}"
+            )
+        counts = rec.get("counts")
+        if not isinstance(counts, dict) or not all(
+            isinstance(counts.get(k), int)
+            for k in ("entries", "collectives", "payload_bytes", "findings")
+        ):
+            problems.append(
+                "counts: want entries/collectives/payload_bytes/findings "
+                f"ints, got {counts!r}"
+            )
+        comms = rec.get("comms")
+        if not isinstance(comms, dict) or not isinstance(
+            comms.get("scaling"), list
+        ):
+            problems.append(
+                f"comms: want an object with a scaling list, got {comms!r}"
+            )
+        else:
+            for i, row in enumerate(comms["scaling"]):
+                if (
+                    not isinstance(row, dict)
+                    or not isinstance(row.get("mesh"), int)
+                    or not isinstance(row.get("axis"), str)
+                    or not _is_finite_num(row.get("comms_wall_us"))
+                    or not _is_finite_num(row.get("predicted_wall_us"))
+                    or not _is_finite_num(
+                        row.get("predicted_scaling_efficiency")
+                    )
+                ):
+                    problems.append(
+                        f"comms.scaling[{i}]: want mesh int, axis str, "
+                        "finite comms_wall_us/predicted_wall_us/"
+                        f"predicted_scaling_efficiency, got {row!r}"
+                    )
     elif kind == "aot-manifest":
         # aot/manifest.py's warm-set manifest.
         fp = rec.get("fingerprint")

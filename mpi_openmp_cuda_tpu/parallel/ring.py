@@ -225,7 +225,7 @@ def _ring_fn(mesh, bs, l2p, cb, mode: tuple = ("gather",)):
         win = lax.dynamic_update_slice(win, blk, (0,))
         perm = [(j, (j - 1) % sp) for j in range(sp)]
         for r in range(1, r_steps + 1):
-            blk = lax.ppermute(blk, SEQ_AXIS, perm)
+            blk = lax.ppermute(blk, axis_name=SEQ_AXIS, perm=perm)
             win = lax.dynamic_update_slice(win, blk, (r * bs,))
 
         bl = rows.shape[0]
@@ -305,7 +305,7 @@ def _ring_fn(mesh, bs, l2p, cb, mode: tuple = ("gather",)):
             ).reshape(bl, 4)
 
         # -- global combine: tiny all_gather of one candidate per device --
-        gathered = lax.all_gather(cand, SEQ_AXIS)  # [sp, bl, 4]
+        gathered = lax.all_gather(cand, axis_name=SEQ_AXIS)  # [sp, bl, 4]
         scores = gathered[:, :, 0]
         gi = jnp.argmax(scores, axis=0)  # first-hit: lowest block wins ties
         best = jnp.take_along_axis(

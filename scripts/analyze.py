@@ -1,7 +1,7 @@
 #!/usr/bin/env python3
 """`make analyze` driver: run the full static-analysis gate on CPU.
 
-Nine analysis passes plus optional tooling (docs/ARCHITECTURE.md §9),
+Ten analysis passes plus optional tooling (docs/ARCHITECTURE.md §9),
 in cheapest-first order so the common failure (a lint regression)
 reports before jax even imports:
 
@@ -33,7 +33,14 @@ reports before jax even imports:
 8. interleave     — exhaustive small-scope exploration of the fleet
                     protocol's event interleavings against the §8.6
                     invariants (analysis/interleave.py).
-9. ruff / mypy    — only when installed (the container may not ship
+9. collectives    — lower every parallel/specs.py mesh form on the
+                    forced multi-device CPU backend, inventory every
+                    collective, prove per-position ordering consistency
+                    (divergent sequences fail closed), gate resharding
+                    hygiene, and cross-check the ring against ring_plan
+                    (analysis/collectives.py; golden drift gating lives
+                    in scripts/comms_audit.py).
+10. ruff / mypy   — only when installed (the container may not ship
                     them); the baselines live in pyproject.toml.
 
 EVERY pass runs regardless of earlier failures — an unexpected crash in
@@ -210,6 +217,41 @@ def _tool_pass(tool: str, argv: list[str]):
     return run
 
 
+def _pass_collectives() -> str:
+    from mpi_openmp_cuda_tpu.analysis.collectives import run_or_raise
+
+    body = run_or_raise()
+    for e in body["entries"]:
+        axes = ",".join(f"{a}={n}" for a, n in e["mesh_axes"].items())
+        print(
+            f"  {e['entry']:<24s} mesh({axes}) "
+            f"collectives={sum(op['count'] for op in e['collectives'])} "
+            f"payload={e['payload_bytes']}B sig={e['signature']} "
+            f"positions={e['positions']} consistent={e['consistent']}"
+        )
+    for r in body["ring_crosscheck"]:
+        print(
+            f"  ring {r['entry']}: R={r['planned_r']} "
+            f"ppermutes={r['lowered_ppermutes']} "
+            f"all_gathers={r['lowered_all_gathers']} [ok]"
+        )
+    counts = body["counts"]
+    for row in (body["comms"] or {}).get("scaling", ()):
+        print(
+            f"  scaling mesh={row['mesh']} axis={row['axis']:<6s} "
+            f"eff={row['predicted_scaling_efficiency']}"
+        )
+    print(
+        f"clean: {counts['entries']} sharded entries, "
+        f"{counts['collectives']} collectives "
+        f"({counts['payload_bytes']} payload bytes), 0 findings"
+    )
+    return (
+        f"{counts['entries']} entries, {counts['collectives']} "
+        f"collectives, 0 findings"
+    )
+
+
 PASSES = [
     ("seqlint", _pass_seqlint),
     ("lock graph", _pass_lockgraph),
@@ -219,6 +261,7 @@ PASSES = [
     ("entry-point contracts", _pass_contracts),
     ("trace audit", _pass_traceaudit),
     ("interleave", _pass_interleave),
+    ("collectives", _pass_collectives),
     ("ruff", _tool_pass("ruff", ["ruff", "check", "mpi_openmp_cuda_tpu"])),
     ("mypy", _tool_pass("mypy", ["mypy", "mpi_openmp_cuda_tpu"])),
 ]

@@ -1,0 +1,231 @@
+#!/usr/bin/env python3
+"""`make comms-audit` driver: the collective-safety gate on CPU.
+
+``analysis/collectives.py`` lowers every ``parallel/specs.py`` mesh
+form (batch sharding, the seq ring, the 2x2 hybrid) at a
+representative bucket shape on the forced 8-virtual-device CPU backend
+and proves, per program:
+
+1. **Collective inventory** — every psum/all_gather/ppermute/all_to_all
+   with axis names, operand shape, dtype, and payload bytes, in
+   per-device program order.
+2. **Ordering consistency** — every axis resolves to a registered mesh
+   axis; the per-position collective sequence is provably identical
+   across all mesh positions (a collective under a replica-divergent
+   branch or a dynamic while_loop fails closed: that is the static
+   signature of a multi-host deadlock).
+3. **Resharding hygiene** — the post-partitioning HLO is diffed against
+   the explicit inventory: a large partitioner-inserted collective with
+   no explicit counterpart, or a large operand entering unplaced, is a
+   finding.
+4. **Ring cross-check** — the lowered ring performs exactly
+   ``ring_plan``'s R neighbour exchanges + 1 candidate all_gather, the
+   same counts the ICI comms model prices into the
+   ``predicted_scaling_efficiency`` rows.
+
+The committed golden (``tests/golden/comms_audit.json``) pins the full
+inventory, the per-position ordering signatures, the ring cross-check,
+and the modelled comms/scaling rows for 2x/4x/8x meshes — so a new
+collective, a reordered exchange, or a comms-model change must be
+committed deliberately, and MULTICHIP_r*.json can later be audited
+against the pinned predictions.
+
+Exit 0 iff the audit has zero findings, the inventory is non-empty,
+the report is schema-valid, and nothing drifted from the golden.
+CPU-only, zero real devices, a few seconds.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+# Force the multi-device CPU backend BEFORE jax initialises (the audit
+# lowers the real sharded entry points; same idiom as analyze.py).
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+GOLDEN_PATH = os.path.join(REPO, "tests", "golden", "comms_audit.json")
+
+
+def build_report() -> dict:
+    """The full enveloped comms-audit report."""
+    from mpi_openmp_cuda_tpu.analysis.collectives import audit_collectives
+    from mpi_openmp_cuda_tpu.obs.metrics import wrap_report
+
+    return wrap_report("comms-audit", audit_collectives())
+
+
+def golden_view(report: dict) -> dict:
+    """The drift-gated subset: per-spec inventory + ordering signatures,
+    the ring cross-check, finding count, and the modelled comms/scaling
+    rows — static facts of the tree (the model constants are deliberate
+    constants, so the modelled numbers are pinnable)."""
+    return {
+        "entries": [
+            {
+                "spec": e["spec"],
+                "mesh_axes": e["mesh_axes"],
+                "collectives": list(e["collectives"]),
+                "payload_bytes": e["payload_bytes"],
+                "signature": e["signature"],
+                "positions": e["positions"],
+                "consistent": e["consistent"],
+            }
+            for e in report["entries"]
+        ],
+        "ring_crosscheck": list(report["ring_crosscheck"]),
+        "findings": len(report["findings"]),
+        "comms": report["comms"],
+    }
+
+
+def diff_views(want: dict, got: dict) -> list[str]:
+    """Field-by-field drift rows (empty = match)."""
+    rows: list[str] = []
+    for key in sorted(set(want) | set(got)):
+        w, g = want.get(key), got.get(key)
+        if w != g:
+            rows.append(f"  {key}: golden {json.dumps(w)} != got {json.dumps(g)}")
+    return rows
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument(
+        "--update",
+        action="store_true",
+        help="rewrite the committed golden baseline from this run "
+        "(commit it together with the change that explains the drift)",
+    )
+    parser.add_argument(
+        "--out",
+        default=None,
+        help="also write the full enveloped report JSON to this path "
+        "(CI uploads it as the failure artifact)",
+    )
+    args = parser.parse_args()
+
+    from mpi_openmp_cuda_tpu.obs.metrics import validate_report
+
+    report = build_report()
+    failed = False
+
+    print("== schema ==")
+    try:
+        validate_report(report)
+        print("valid: kind=comms-audit")
+    except ValueError as exc:
+        print(f"FAIL: {exc}")
+        failed = True
+
+    print("\n== collective inventory ==")
+    counts = report["counts"]
+    print(
+        f"entries={counts['entries']} collectives={counts['collectives']} "
+        f"payload_bytes={counts['payload_bytes']} "
+        f"findings={counts['findings']}"
+    )
+    for e in report["entries"]:
+        axes = ",".join(f"{a}={n}" for a, n in e["mesh_axes"].items())
+        print(
+            f"  {e['entry']} mesh({axes}) sig={e['signature']} "
+            f"positions={e['positions']} consistent={e['consistent']}"
+        )
+        for op in e["collectives"]:
+            op_axes = ",".join(op["axes"]) or "-"
+            print(
+                f"    {op['op']:<12s} axes={op_axes:<6s} "
+                f"{op['dtype']}{op['shape']} "
+                f"payload={op['payload_bytes']}B x{op['count']}"
+            )
+        for row in e["hlo_collectives"]:
+            print(f"    hlo {row['op']} {row['bytes']}B")
+    if not any(e["collectives"] for e in report["entries"]):
+        print("  FAIL: zero collectives inventoried (ring path missing)")
+        failed = True
+    for r in report["ring_crosscheck"]:
+        mark = "ok" if r["match"] else "DRIFT"
+        print(
+            f"  ring {r['entry']}: planned R={r['planned_r']} lowered "
+            f"ppermutes={r['lowered_ppermutes']} "
+            f"all_gathers={r['lowered_all_gathers']} [{mark}]"
+        )
+    for f in report["findings"]:
+        print(f"  FINDING [{f['kind']}] {f['entry']}: {f['detail']}")
+        failed = True
+
+    print("\n== modelled comms (ICI) ==")
+    comms = report["comms"]
+    if comms is None:
+        print("  FAIL: production schedule priced off-kernel (no comms)")
+        failed = True
+    else:
+        print(
+            f"  link={comms['ici_link_gbytes_s']} GB/s "
+            f"hop={comms['ici_hop_latency_us']} us"
+        )
+        for row in comms["scaling"]:
+            print(
+                f"  mesh={row['mesh']} axis={row['axis']:<6s} "
+                f"comms={row['comms_wall_us']:>8.3f}us "
+                f"wall={row['predicted_wall_us']:>8.3f}us "
+                f"eff={row['predicted_scaling_efficiency']:5.3f}"
+            )
+
+    if args.out:
+        with open(args.out, "w") as fh:
+            json.dump(report, fh, indent=1, sort_keys=True)
+            fh.write("\n")
+        print(f"\nreport written to {args.out}")
+
+    view = golden_view(report)
+    if args.update:
+        if failed:
+            print("\nrefusing --update: the run itself failed")
+            return 1
+        os.makedirs(os.path.dirname(GOLDEN_PATH), exist_ok=True)
+        with open(GOLDEN_PATH, "w") as fh:
+            json.dump(view, fh, indent=1, sort_keys=True)
+            fh.write("\n")
+        print(f"\ngolden updated: {GOLDEN_PATH}")
+        return 0
+
+    print("\n== golden drift ==")
+    if not os.path.exists(GOLDEN_PATH):
+        print(
+            f"FAIL: no committed golden at {GOLDEN_PATH} "
+            "(run scripts/comms_audit.py --update and commit it)"
+        )
+        return 1
+    with open(GOLDEN_PATH) as fh:
+        want = json.load(fh)
+    rows = diff_views(want, view)
+    if rows:
+        print(f"FAIL: {len(rows)} field(s) drifted from the golden:")
+        print("\n".join(rows))
+        print(
+            "either fix the regression, or regenerate deliberately with "
+            "scripts/comms_audit.py --update and commit the new "
+            "baseline with the change that explains it"
+        )
+        return 1
+    print("match: comms audit equals the committed golden")
+    if failed:
+        print("\ncomms-audit: FAIL")
+        return 1
+    print("\ncomms-audit: OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -83,21 +83,47 @@ def collective_ops(hlo_text: str) -> list[tuple[str, int]]:
     """``(op, result_elements)`` for every cross-device collective in an
     optimized-HLO dump — the statically-auditable collective set of a
     compiled SPMD program, the TPU analogue of reading the MPI calls off
-    ``/root/reference/main.c:149-197``.  Matches both sync ops and their
-    ``-start`` async halves (``-done`` carries no second collective).
-    Used by the collective-structure tests (VERDICT r4 item 1)."""
-    import re
+    ``/root/reference/main.c:149-197``.  Delegates to the canonical
+    parser in ``analysis/collectives.py`` (the comms-audit pass), so the
+    collective-structure tests (VERDICT r4 item 1) and the audit read
+    HLO through ONE regex."""
+    from mpi_openmp_cuda_tpu.analysis.collectives import hlo_collectives
 
-    ops = []
-    for m in re.finditer(
-        r"=\s*(\(?\s*[a-z0-9]+\[([\d,]*)\])[^=]*?\s"
-        r"(all-gather|all-reduce|collective-permute|all-to-all|"
-        r"reduce-scatter|collective-broadcast)(-start)?\(",
-        hlo_text,
-    ):
-        dims = [int(d) for d in m.group(2).split(",") if d]
-        ops.append((m.group(3), int(np.prod(dims)) if dims else 1))
-    return ops
+    return [(row["op"], row["elements"]) for row in hlo_collectives(hlo_text)]
+
+
+@pytest.fixture
+def multidevice_subprocess():
+    """Run a Python snippet in a subprocess whose jax is forced to 4
+    virtual CPU devices — the tier that proves ring/shard_map collective
+    paths actually EXECUTE on >1 device instead of degenerating to the
+    1-device identity (the in-process 8-device forcing above covers
+    lowering; this covers execution with a device count the specs under
+    test ask for, in a process whose XLA_FLAGS the suite has not already
+    spent).  Returns ``run(code) -> CompletedProcess`` with stdout/err
+    captured; the caller asserts on the marker lines its snippet
+    prints."""
+    import subprocess
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+    def run(code: str, devices: int = 4):
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["XLA_FLAGS"] = (
+            f"--xla_force_host_platform_device_count={devices}"
+        )
+        env["TPU_SEQALIGN_COMPILE_CACHE"] = "off"
+        return subprocess.run(
+            [sys.executable, "-c", code],
+            cwd=repo,
+            env=env,
+            capture_output=True,
+            text=True,
+            timeout=300,
+        )
+
+    return run
 
 
 def run_cli_inproc(*args, capsys, rc_want=0):

@@ -66,7 +66,8 @@ def test_bench_emits_contract_json_line():
                         "feed_roofline_tflops", "feed_roofline_kind",
                         "mfu_vs_feed_roofline",
                         "vpu_probe_arith_gelems", "vpu_floor_us",
-                        "wall_vs_vpu_floor", "formulation", "donation"}
+                        "wall_vs_vpu_floor", "formulation", "donation",
+                        "comms"}
     # r6: every record carries the DonationPlan it ran under — the
     # wired donate_argnums per entry and the committed pre-donation
     # MFU baseline (BENCH_r05) the TPU record's delta is quoted against.
@@ -78,6 +79,15 @@ def test_bench_emits_contract_json_line():
     }
     assert don["findings"] == 0
     assert don["baseline_mfu_vs_feed_roofline"] == 0.217
+    # PR 14: the record prices the interconnect too — the collective
+    # inventory of every sharded entry plus the modelled 2x/4x/8x
+    # scaling-efficiency rows (ratios in (0, 1]) from the ICI model.
+    comms = rec["comms"]
+    assert comms["inventory"]["entries"] >= 4
+    assert comms["inventory"]["collectives"] >= 1
+    effs = comms["predicted_scaling_efficiency"]
+    assert {"2x-batch", "2x-seq", "8x-seq"} <= set(effs)
+    assert all(0.0 < v <= 1.0 for v in effs.values())
     assert rec["e2e_first_run_s"] >= 0 and rec["e2e_warm_s"] >= 0
     # Cold start spans process start -> first result, so it bounds the
     # first in-process run from above; no SEQALIGN_PREWARM in this env.

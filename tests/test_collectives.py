@@ -1,0 +1,534 @@
+"""Collective-safety pass tests (analysis/collectives.py).
+
+Each check is exercised against a seeded hazard package it must catch
+(unregistered axis, replica-divergent sequence, implicit reshard on a
+large intermediate, a spec-skipped operand, ring-plan drift), plus the
+real tree pinned at zero findings with the ring cross-check holding,
+the golden cross-checked, and one subprocess tier where the ring
+collectives actually EXECUTE on 4 devices."""
+
+from __future__ import annotations
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from mpi_openmp_cuda_tpu.analysis import CollectiveAuditError
+from mpi_openmp_cuda_tpu.analysis import collectives as C
+
+GOLDEN = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "golden", "comms_audit.json"
+)
+
+
+def _mesh(**axes):
+    import jax
+    from jax.sharding import Mesh
+
+    n = int(np.prod(list(axes.values())))
+    devs = np.array(jax.devices()[:n]).reshape(tuple(axes.values()))
+    return Mesh(devs, tuple(axes))
+
+
+@pytest.fixture(scope="module")
+def real_audit():
+    """One full-tree audit shared by the pin/cross-check tests."""
+    return C.audit_collectives()
+
+
+class TestHloParser:
+    HLO = """
+      %ag = f32[8,128]{1,0} all-gather(f32[2,128]{1,0} %p0), replica_groups={}
+      %ar = bf16[64]{0} all-reduce-start(bf16[64]{0} %x), to_apply=%sum
+      %cp = s32[256]{0} collective-permute(s32[256]{0} %blk), source_target_pairs={{0,1}}
+      %add = f32[8,128]{1,0} add(f32[8,128]{1,0} %ag, f32[8,128]{1,0} %ag)
+    """
+
+    def test_ops_dtypes_and_bytes(self):
+        rows = C.hlo_collectives(self.HLO)
+        assert [r["op"] for r in rows] == [
+            "all-gather", "all-reduce", "collective-permute",
+        ]
+        assert rows[0] == {
+            "op": "all-gather", "dtype": "f32",
+            "elements": 8 * 128, "bytes": 8 * 128 * 4,
+        }
+        assert rows[1]["bytes"] == 64 * 2  # bf16
+        assert rows[2]["bytes"] == 256 * 4
+
+    def test_conftest_delegates_here(self):
+        from conftest import collective_ops
+
+        assert collective_ops(self.HLO) == [
+            ("all-gather", 1024), ("all-reduce", 64),
+            ("collective-permute", 256),
+        ]
+
+
+class TestInventoryWalk:
+    def test_shard_map_collectives_inventoried(self):
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+        from jax.sharding import PartitionSpec as P
+
+        from mpi_openmp_cuda_tpu.parallel.compat import shard_map
+
+        mesh = _mesh(seq=4)
+
+        def local(x):
+            x = lax.ppermute(
+                x, axis_name="seq", perm=[(j, (j + 1) % 4) for j in range(4)]
+            )
+            return lax.psum(x, axis_name="seq")
+
+        fn = jax.jit(
+            shard_map(
+                local, mesh=mesh, in_specs=(P("seq"),), out_specs=P(),
+                check_vma=False,
+            )
+        )
+        x = jnp.zeros((8, 16), jnp.float32)
+        ops, findings = C.collective_inventory(fn, (x,), ("seq",))
+        assert findings == []
+        assert [op.op for op in ops] == ["ppermute", "psum"]
+        assert ops[0].axes == ("seq",) and ops[1].axes == ("seq",)
+        # per-device operand: 2x16 f32 = 128 B
+        assert ops[0].payload_bytes == 2 * 16 * 4
+        assert ops[0].count == 1
+
+    def test_scan_multiplies_count(self):
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+        from jax.sharding import PartitionSpec as P
+
+        from mpi_openmp_cuda_tpu.parallel.compat import shard_map
+
+        mesh = _mesh(seq=4)
+
+        def local(x):
+            def step(c, _):
+                return lax.psum(c, axis_name="seq"), None
+
+            out, _ = lax.scan(step, x, None, length=5)
+            return out
+
+        fn = jax.jit(
+            shard_map(
+                local, mesh=mesh, in_specs=(P("seq"),), out_specs=P(),
+                check_vma=False,
+            )
+        )
+        ops, findings = C.collective_inventory(
+            fn, (jnp.zeros((4, 8)),), ("seq",)
+        )
+        assert findings == []
+        assert [op.op for op in ops] == ["psum"]
+        assert ops[0].count == 5
+
+    def test_signature_is_order_sensitive(self):
+        a = C.CollectiveOp("psum", ("seq",), (4,), "int32", 16, 1)
+        b = C.CollectiveOp("ppermute", ("seq",), (4,), "int32", 16, 1)
+        assert C.ordering_signature([a, b]) != C.ordering_signature([b, a])
+        assert C.ordering_signature([a, b]) == C.ordering_signature([a, b])
+
+
+class TestSeededHazards:
+    def test_unregistered_axis(self):
+        """A collective over an axis the mesh never registered."""
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+        from jax.sharding import PartitionSpec as P
+
+        from mpi_openmp_cuda_tpu.parallel.compat import shard_map
+
+        mesh = _mesh(seq=4)
+
+        def local(x):
+            return lax.psum(x, axis_name="seq")
+
+        fn = jax.jit(
+            shard_map(
+                local, mesh=mesh, in_specs=(P("seq"),), out_specs=P(),
+                check_vma=False,
+            )
+        )
+        # Audit against a mesh whose registered axes do NOT include
+        # "seq" — the dispatch-time mismatch the check models.
+        ops, findings = C.collective_inventory(
+            fn, (jnp.zeros((4, 8)),), ("batch",)
+        )
+        kinds = [f["kind"] for f in findings]
+        assert kinds == ["unregistered-axis"]
+        assert "seq" in findings[0]["detail"]
+
+    def test_divergent_cond_fails_closed(self):
+        """A collective under a branch on axis_index: positions would
+        issue different sequences — the deadlock signature."""
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+        from jax.sharding import PartitionSpec as P
+
+        from mpi_openmp_cuda_tpu.parallel.compat import shard_map
+
+        mesh = _mesh(seq=4)
+
+        def local(x):
+            i = lax.axis_index("seq")
+            return lax.cond(
+                i == 0,
+                lambda v: lax.psum(v, axis_name="seq"),
+                lambda v: v,
+                x,
+            )
+
+        fn = jax.jit(
+            shard_map(
+                local, mesh=mesh, in_specs=(P("seq"),), out_specs=P("seq"),
+                check_vma=False,
+            )
+        )
+        ops, findings = C.collective_inventory(
+            fn, (jnp.zeros((4, 8)),), ("seq",)
+        )
+        kinds = [f["kind"] for f in findings]
+        assert "divergent-sequence" in kinds
+        assert "deadlock" in findings[0]["detail"]
+
+    def test_uniform_cond_is_clean(self):
+        """The same cond on a REPLICATED predicate is fine: every
+        position takes the same branch."""
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+        from jax.sharding import PartitionSpec as P
+
+        from mpi_openmp_cuda_tpu.parallel.compat import shard_map
+
+        mesh = _mesh(seq=4)
+
+        def local(flag, x):
+            return lax.cond(
+                flag[0] > 0,
+                lambda v: lax.psum(v, axis_name="seq"),
+                lambda v: v * 2.0,
+                x,
+            )
+
+        fn = jax.jit(
+            shard_map(
+                local, mesh=mesh, in_specs=(P(), P("seq")),
+                out_specs=P("seq"), check_vma=False,
+            )
+        )
+        ops, findings = C.collective_inventory(
+            fn, (jnp.ones((1,)), jnp.zeros((4, 8))), ("seq",)
+        )
+        assert findings == []
+        assert [op.op for op in ops] == ["psum"]
+
+    def test_collective_under_while_fails_closed(self):
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+        from jax.sharding import PartitionSpec as P
+
+        from mpi_openmp_cuda_tpu.parallel.compat import shard_map
+
+        mesh = _mesh(seq=4)
+
+        def local(x):
+            return lax.while_loop(
+                lambda c: jnp.sum(c) < 100.0,
+                lambda c: lax.psum(c, axis_name="seq") + 1.0,
+                x,
+            )
+
+        fn = jax.jit(
+            shard_map(
+                local, mesh=mesh, in_specs=(P("seq"),), out_specs=P("seq"),
+                check_vma=False,
+            )
+        )
+        ops, findings = C.collective_inventory(
+            fn, (jnp.zeros((4, 8)),), ("seq",)
+        )
+        assert [f["kind"] for f in findings] == ["divergent-sequence"]
+        assert "while" in findings[0]["detail"]
+
+    def test_implicit_reshard_on_large_intermediate(self):
+        """A >= 16 KiB sharded->replicated jit with NO explicit
+        collective: the partitioner's inserted all-gather is the
+        implicit-reshard finding."""
+        import jax
+        from jax.sharding import NamedSharding
+        from jax.sharding import PartitionSpec as P
+
+        mesh = _mesh(x=8)
+        sharded = NamedSharding(mesh, P("x"))
+        replicated = NamedSharding(mesh, P())
+        fn = jax.jit(
+            lambda a: a * 2.0,
+            in_shardings=(sharded,),
+            out_shardings=replicated,
+        )
+        arr = jax.device_put(
+            np.zeros((1024, 64), np.float32), sharded
+        )  # 256 KiB
+        row, findings = C.audit_program("seeded", fn, (arr,), mesh)
+        kinds = [f["kind"] for f in findings]
+        assert kinds == ["implicit-reshard"]
+        assert "all-gather" in findings[0]["detail"]
+        assert row["collectives"] == []  # nothing explicit in the jaxpr
+
+    def test_annotated_counterpart_not_flagged(self):
+        """The same traffic EXPLICIT in the program (shard_map
+        all_gather) is inventory, not a finding."""
+        import jax
+        from jax import lax
+        from jax.sharding import NamedSharding
+        from jax.sharding import PartitionSpec as P
+
+        from mpi_openmp_cuda_tpu.parallel.compat import shard_map
+
+        mesh = _mesh(x=8)
+        sharded = NamedSharding(mesh, P("x"))
+
+        def local(a):
+            return lax.all_gather(a, axis_name="x", tiled=True)
+
+        fn = jax.jit(
+            shard_map(
+                local, mesh=mesh, in_specs=(P("x"),), out_specs=P(),
+                check_vma=False,
+            )
+        )
+        arr = jax.device_put(np.zeros((1024, 64), np.float32), sharded)
+        row, findings = C.audit_program("seeded", fn, (arr,), mesh)
+        assert findings == []
+        assert [op["op"] for op in row["collectives"]] == ["all_gather"]
+
+    def test_spec_skipped_operand(self):
+        """A large operand entering as a bare host array — the spec
+        skipped it, so every dispatch pays an implicit reshard."""
+        findings = C.operand_placement(
+            "seeded", (np.zeros((1024, 64), np.float32), np.int32(3))
+        )
+        assert [f["kind"] for f in findings] == ["unsharded-operand"]
+        assert "operand 0" in findings[0]["detail"]
+
+    def test_small_host_operand_is_fine(self):
+        assert C.operand_placement("s", (np.zeros(8, np.int32),)) == []
+
+    def test_ring_plan_drift(self):
+        """A lowered ring whose exchange count disagrees with
+        ring_plan's R is drift, not silence."""
+        entry = {
+            "entry": "RingSharding[seq:4]",
+            "mesh_axes": {"seq": 4},
+            "collectives": [
+                {"op": "ppermute", "count": 1},
+                {"op": "all_gather", "count": 1},
+            ],
+        }
+        rows, findings = C.ring_crosscheck([entry])
+        assert rows[0]["match"] is False
+        assert [f["kind"] for f in findings] == ["ring-plan-drift"]
+
+    def test_run_or_raise_names_findings(self, monkeypatch):
+        def fake_audit(**kw):
+            return {
+                "entries": [],
+                "findings": [
+                    {"kind": "unregistered-axis", "entry": "e", "detail": "d"}
+                ],
+                "counts": {},
+                "comms": None,
+            }
+
+        monkeypatch.setattr(C, "audit_collectives", fake_audit)
+        with pytest.raises(CollectiveAuditError, match="unregistered-axis"):
+            C.run_or_raise()
+
+    def test_run_or_raise_rejects_empty_inventory(self, monkeypatch):
+        def fake_audit(**kw):
+            return {
+                "entries": [{"entry": "e", "collectives": []}],
+                "findings": [],
+                "counts": {},
+                "comms": None,
+            }
+
+        monkeypatch.setattr(C, "audit_collectives", fake_audit)
+        with pytest.raises(CollectiveAuditError, match="ZERO collectives"):
+            C.run_or_raise()
+
+
+class TestRealTree:
+    def test_zero_findings(self, real_audit):
+        assert real_audit["findings"] == []
+
+    def test_every_spec_form_audited(self, real_audit):
+        assert sorted(e["spec"] for e in real_audit["entries"]) == sorted(
+            C.AUDIT_SPECS
+        )
+
+    def test_ring_inventory_nonempty_and_crosschecked(self, real_audit):
+        ring = [
+            e for e in real_audit["entries"]
+            if e["mesh_axes"].get("seq", 1) > 1
+        ]
+        assert ring, "no ring entries audited"
+        for e in ring:
+            assert any(
+                op["op"] == "ppermute" for op in e["collectives"]
+            ), e["entry"]
+        assert real_audit["ring_crosscheck"], "ring cross-check empty"
+        assert all(r["match"] for r in real_audit["ring_crosscheck"])
+
+    def test_positions_consistent(self, real_audit):
+        for e in real_audit["entries"]:
+            assert e["consistent"] is True
+            assert e["positions"] == int(
+                np.prod(list(e["mesh_axes"].values()))
+            )
+            sigs = {p["signature"] for p in e["per_position"]}
+            assert sigs == {e["signature"]}
+
+    def test_scaling_rows_finite_for_2_4_8(self, real_audit):
+        rows = real_audit["comms"]["scaling"]
+        assert sorted({r["mesh"] for r in rows}) == [2, 4, 8]
+        assert {r["axis"] for r in rows} == {"batch", "seq"}
+        for r in rows:
+            assert 0.0 < r["predicted_scaling_efficiency"] <= 1.0
+            assert np.isfinite(r["predicted_wall_us"])
+            assert r["comms_wall_us"] >= 0.0
+            if r["axis"] == "seq":
+                assert r["comms_wall_us"] > 0.0
+
+    def test_golden_cross_check(self, real_audit):
+        """The committed golden pins this tree's inventory, signatures,
+        ring cross-check, and modelled comms rows."""
+        with open(GOLDEN) as fh:
+            golden = json.load(fh)
+        got = {
+            (e["spec"], e["signature"], e["consistent"])
+            for e in real_audit["entries"]
+        }
+        want = {
+            (e["spec"], e["signature"], e["consistent"])
+            for e in golden["entries"]
+        }
+        assert got == want
+        assert golden["findings"] == 0
+        assert golden["ring_crosscheck"] == real_audit["ring_crosscheck"]
+        assert golden["comms"] == real_audit["comms"]
+
+    def test_report_schema_valid(self, real_audit):
+        from mpi_openmp_cuda_tpu.obs.metrics import (
+            validate_report,
+            wrap_report,
+        )
+
+        validate_report(wrap_report("comms-audit", real_audit))
+
+
+class TestIciModel:
+    def test_ppermute_single_hop(self):
+        from mpi_openmp_cuda_tpu.analysis.costmodel import (
+            ICI_HOP_LATENCY_S,
+            ICI_LINK_GBYTES_S,
+            ici_collective_wall_s,
+        )
+
+        b = 1 << 20
+        want = b / (ICI_LINK_GBYTES_S * 1e9) + ICI_HOP_LATENCY_S
+        assert ici_collective_wall_s("ppermute", b, 4) == pytest.approx(want)
+
+    def test_all_gather_scales_with_ring(self):
+        from mpi_openmp_cuda_tpu.analysis.costmodel import (
+            ici_collective_wall_s,
+        )
+
+        t4 = ici_collective_wall_s("all_gather", 1 << 20, 4)
+        t8 = ici_collective_wall_s("all_gather", 1 << 20, 8)
+        assert t8 == pytest.approx(t4 * 7 / 3)
+
+    def test_single_device_is_free(self):
+        from mpi_openmp_cuda_tpu.analysis.costmodel import (
+            ici_collective_wall_s,
+        )
+
+        assert ici_collective_wall_s("psum", 1 << 30, 1) == 0.0
+
+    def test_unknown_op_raises(self):
+        from mpi_openmp_cuda_tpu.analysis import CostModelError
+        from mpi_openmp_cuda_tpu.analysis.costmodel import (
+            ici_collective_wall_s,
+        )
+
+        with pytest.raises(CostModelError):
+            ici_collective_wall_s("broadcast", 1, 4)
+
+    def test_sheet_off_kernel_has_no_comms(self):
+        from mpi_openmp_cuda_tpu.analysis.costmodel import (
+            schedule_cost_sheet,
+        )
+        from mpi_openmp_cuda_tpu.models.workload import (
+            input3_class_problem,
+        )
+
+        import dataclasses
+
+        # > the f32 exactness ceiling: every bucket routes off-kernel.
+        wide = dataclasses.replace(
+            input3_class_problem(), weights=[40000, 7, 1, 2]
+        )
+        sheet = schedule_cost_sheet(wide, "pallas")
+        assert sheet["feed"] is None
+        assert sheet["comms"] is None
+
+
+class TestMultiDeviceExecution:
+    def test_ring_collectives_execute_on_four_devices(
+        self, multidevice_subprocess
+    ):
+        """The ring path actually RUNS its ppermute/all_gather sequence
+        on 4 devices and agrees with the batch-sharded path — not the
+        1-device identity degeneration."""
+        code = """
+import numpy as np
+import jax
+assert jax.device_count() == 4, jax.device_count()
+from mpi_openmp_cuda_tpu.ops.dispatch import pad_problem
+from mpi_openmp_cuda_tpu.ops.values import value_table
+from mpi_openmp_cuda_tpu.parallel.specs import build_sharding
+
+rng = np.random.default_rng(14)
+seq1 = rng.integers(1, 27, size=150).astype(np.int32)
+seq2s = [rng.integers(1, 27, size=n).astype(np.int32)
+         for n in (100, 60, 40, 25)]
+batch = pad_problem(seq1, seq2s)
+val = value_table((2, 2, 1, 10)).astype(np.int32).reshape(-1)
+
+ring = build_sharding("seq:4")
+got = ring.score(batch, val, backend="xla")
+ref = build_sharding("batch:2").score(batch, val, backend="xla")
+assert np.array_equal(got, ref), (got, ref)
+
+fn, args, _ = ring._prepare(batch, val, backend="xla")
+hlo = fn.lower(*args).compile().as_text()
+from mpi_openmp_cuda_tpu.analysis.collectives import hlo_collectives
+ops = [r["op"] for r in hlo_collectives(hlo)]
+assert "collective-permute" in ops, ops
+assert "all-gather" in ops, ops
+print("RING-EXECUTED", sorted(set(ops)))
+"""
+        proc = multidevice_subprocess(code)
+        assert proc.returncode == 0, proc.stderr
+        assert "RING-EXECUTED" in proc.stdout

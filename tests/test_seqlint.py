@@ -789,3 +789,94 @@ class TestSeq011JitDonationPolicy:
                 return jax.jit(entry_body)
             """,
         )
+
+
+class TestSeq012Collectives:
+    def test_raw_lax_collective_outside_parallel(self, tmp_path):
+        findings = _lint_snippet(
+            tmp_path,
+            "ops/foo.py",
+            """
+            from jax import lax
+
+            def combine_host(x):
+                return lax.ppermute(x, axis_name="seq", perm=[(0, 1)])
+            """,
+        )
+        assert [f.code for f in findings] == ["SEQ012"]
+        assert "parallel/" in findings[0].message
+
+    def test_jax_lax_dotted_form_outside_parallel(self, tmp_path):
+        findings = _lint_snippet(
+            tmp_path,
+            "obs/foo.py",
+            """
+            import jax
+
+            def reduce_all(x):
+                return jax.lax.psum(x, axis_name="batch")
+            """,
+        )
+        assert [f.code for f in findings] == ["SEQ012"]
+
+    def test_bare_imported_name_outside_parallel(self, tmp_path):
+        findings = _lint_snippet(
+            tmp_path,
+            "io/foo.py",
+            """
+            from jax.lax import all_gather
+
+            def widen(x):
+                return all_gather(x, axis_name="seq")
+            """,
+        )
+        assert [f.code for f in findings] == ["SEQ012"]
+
+    def test_keyword_axis_inside_parallel_is_clean(self, tmp_path):
+        assert not _lint_snippet(
+            tmp_path,
+            "parallel/foo.py",
+            """
+            from jax import lax
+
+            def exchange(x, perm):
+                return lax.ppermute(x, axis_name="seq", perm=perm)
+            """,
+        )
+
+    def test_positional_axis_inside_parallel_is_a_finding(self, tmp_path):
+        findings = _lint_snippet(
+            tmp_path,
+            "parallel/foo.py",
+            """
+            from jax import lax
+
+            def exchange(x, perm):
+                return lax.ppermute(x, "seq", perm=perm)
+            """,
+        )
+        assert [f.code for f in findings] == ["SEQ012"]
+        assert "axis_name" in findings[0].message
+
+    def test_suppression_honoured(self, tmp_path):
+        assert not _lint_snippet(
+            tmp_path,
+            "ops/foo.py",
+            """
+            from jax import lax
+
+            def combine_host(x):
+                return lax.psum(x, axis_name="b")  # seqlint: disable=SEQ012
+            """,
+        )
+
+    def test_collectives_pass_is_classified_host(self):
+        # The audit pass WALKS collectives (it never issues one), so it
+        # lives outside the collective-home role on purpose.
+        roles = seqlint.module_roles("pkg/analysis/collectives.py")
+        assert roles == (seqlint.ROLE_HOST,)
+
+    def test_name_sets_stay_in_sync(self):
+        from mpi_openmp_cuda_tpu.analysis.collectives import COLLECTIVE_PRIMS
+
+        assert seqlint._COLLECTIVE_NAMES == set(COLLECTIVE_PRIMS)
