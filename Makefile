@@ -18,7 +18,7 @@ PY_CFLAGS  := $(shell $(PYCONFIG) --includes)
 PY_LDFLAGS := $(shell $(PYCONFIG) --ldflags --embed)
 INPUT      ?= /root/reference/input5.txt
 
-.PHONY: build run run2 runOn2 test chaos chaos-kill analyze schedule-audit concurrency-audit donation-audit comms-audit metrics-smoke serve-smoke serve-chaos fleet-chaos aot-smoke trace-smoke bench bench-table bench-gather check clean
+.PHONY: build run run2 runOn2 test chaos chaos-kill analyze schedule-audit concurrency-audit donation-audit comms-audit ranges-audit metrics-smoke serve-smoke serve-chaos fleet-chaos aot-smoke trace-smoke bench bench-table bench-gather check clean
 
 build: final
 
@@ -138,6 +138,19 @@ donation-audit:
 # scripts/comms_audit.py --update).  CPU-only, zero real devices.
 comms-audit:
 	JAX_PLATFORMS=cpu $(PYTHON) scripts/comms_audit.py
+
+# Numeric-exactness gate (docs/ARCHITECTURE.md §9): abstract
+# interpretation in an interval domain over every scoring jaxpr —
+# re-derive every hand numeric bound (max_exact_value, the 2^19
+# rowpack gate, the 2^31 argmax packing, the feed ceilings) and diff
+# each against its wired source, certify every entry contract and
+# every production-bucket body exact at its envelope, map the signed
+# int16 envelope (the BLOSUM/PAM prerequisite), and diff the cert
+# against the committed golden (tests/golden/ranges_cert.json;
+# regenerate deliberately with scripts/ranges_audit.py --update).
+# CPU-only, zero devices, a few seconds.
+ranges-audit:
+	JAX_PLATFORMS=cpu $(PYTHON) scripts/ranges_audit.py
 
 # Observability smoke gate (docs/ARCHITECTURE.md §10): one CLI run on
 # the tiny fixture with --metrics --metrics-out, then schema-validate

@@ -724,6 +724,28 @@ def donation_record(measured_mfu=None, baseline="BENCH_r05.json"):
     return donation
 
 
+def ranges_record(problem, backend):
+    """The value-range cert's headline numbers next to measured MFU:
+    every hand constant re-derived and matching, every certified row
+    exact, and the signed-envelope survivor count (the BLOSUM/PAM
+    prerequisite).  Pure CPU abstract interpretation — safe to call
+    without hardware; a regression must show up as a bench-visible
+    number, not only as an audit failure."""
+    from mpi_openmp_cuda_tpu.analysis.ranges import build_cert
+
+    cert = build_cert(problem, backend)
+    counts = cert["counts"]
+    return {
+        "constants_ok": counts["constants_ok"],
+        "constants": counts["constants"],
+        "entries_exact": counts["entries_exact"],
+        "entries": counts["entries"],
+        "production_buckets": counts["production_buckets"],
+        "signed_survivors": counts["signed_survivors"],
+        "findings": counts["findings"],
+    }
+
+
 def comms_record(problem, backend):
     """Modelled comms next to measured MFU: the collective inventory
     totals over the mesh specs the current device count can lower, plus
@@ -1020,6 +1042,16 @@ def main() -> None:
     except Exception as e:  # noqa: BLE001 - diagnostic only
         print(
             f"[bench] WARNING: comms section failed ({e})",
+            file=sys.stderr,
+        )
+    # Ranges section (never fatal): the numeric-exactness cert rides
+    # every record so a widened accumulator or a drifted hand constant
+    # lands next to the MFU number it would silently corrupt.
+    try:
+        record["ranges"] = ranges_record(problem, backend)
+    except Exception as e:  # noqa: BLE001 - diagnostic only
+        print(
+            f"[bench] WARNING: ranges section failed ({e})",
             file=sys.stderr,
         )
     pred_mfu = record.get("predicted_mfu_vs_feed_roofline")

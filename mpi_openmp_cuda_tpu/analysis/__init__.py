@@ -53,6 +53,15 @@ runnable on CPU-only CI (``make analyze``):
   the retry/degrade/rescue re-dispatch ladders), emitting the
   machine-checked ``DonationPlan`` that the ``donate_argnums`` wiring
   and traceaudit's enforced donation gate are derived from.
+* :mod:`.ranges` — a value-range certifier: abstract interpretation
+  over every scoring jaxpr in an interval domain (one-hot and
+  congruence refinements, widening-to-fixpoint loops, ``pallas_call``
+  kernel recursion), seeded from the entry contracts' input envelopes.
+  It re-derives every hand numeric bound (``max_exact_value``, the
+  2^19 rowpack gate, the 2^31 argmax packing) and diffs each against
+  its wired source in ``ops/bounds.py`` — drift, a lossy narrowing, an
+  overflow-capable accumulator, or an unknown primitive (fail closed)
+  is a typed finding in the emitted ``RangeCert``.
 
 Everything raises a :class:`SeqcheckError` subclass with a message
 naming the violated bound and the fix, so a CI failure is actionable
@@ -158,6 +167,15 @@ class CollectiveAuditError(SeqcheckError):
     ``ring_plan``'s analytic exchange count."""
 
 
+class RangeCertError(SeqcheckError):
+    """The value-range certifier (analysis/ranges.py) could not certify
+    the scoring tree: a hand constant drifted from its machine-derived
+    value, an accumulator's proved interval escapes its exactness
+    window, a ``convert_element_type`` narrows away live range, or an
+    unknown primitive made the analysis fail closed.  The message names
+    the entry/bucket (or constant row) and the interval evidence."""
+
+
 __all__ = [
     "SeqcheckError",
     "ContractViolation",
@@ -174,4 +192,5 @@ __all__ = [
     "InterleaveViolation",
     "DataflowError",
     "CollectiveAuditError",
+    "RangeCertError",
 ]

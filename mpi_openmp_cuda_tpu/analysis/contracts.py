@@ -103,13 +103,15 @@ def check_rowpack(feed: str, l2p: int, l2s: int | None, maxv: int) -> None:
             f"(L2P == {_LANE}), got L2P={l2p}: multi-block buckets walk "
             "blocks per pair and cannot share tiles (dispatch.choose_rowpack)"
         )
+    from ..ops.bounds import ROWPACK_EPILOGUE_LIMIT
+
     legal = pack_classes(feed, maxv)
     if l2s not in legal:
-        if 3 * l2s * maxv >= 1 << 19:
+        if 3 * l2s * maxv >= ROWPACK_EPILOGUE_LIMIT:
             raise RowpackViolation(
                 f"rowpack class l2s={l2s} breaches the packed int32 "
                 f"epilogue gate for feed {feed!r}: 3*{l2s}*{maxv} = "
-                f"{3 * l2s * maxv} >= 2^19 = {1 << 19}, so the packed "
+                f"{3 * l2s * maxv} >= 2^19 = {ROWPACK_EPILOGUE_LIMIT}, so the packed "
                 f"argmax key would collide. Legal classes for max|v|={maxv}: "
                 f"{legal or '() — packing disabled at this magnitude'} "
                 "(dispatch.pack_classes)"
@@ -132,11 +134,13 @@ def check_superblock(nbn: int, sb: int | None) -> None:
             f"nbn={nbn}: the kernel grid needs nbn % sb == 0 "
             f"(divisors of {nbn} are legal; pallas_scorer.choose_superblock)"
         )
-    if sb > 24:
+    from ..ops.bounds import SUPERBLOCK_CAP
+
+    if sb > SUPERBLOCK_CAP:
         raise SuperblockViolation(
             f"superblock sb={sb} exceeds the packed argmax key bound "
-            "sb <= 24 (key bits klb <= 12 keep (t1+gdec)*2^klb+key inside "
-            "int32; pallas_scorer._superblock)"
+            f"sb <= {SUPERBLOCK_CAP} (key bits klb <= 12 keep "
+            "(t1+gdec)*2^klb+key inside int32; pallas_scorer._superblock)"
         )
 
 

@@ -1,0 +1,2019 @@
+"""Value-range certifier: abstract interpretation over the scoring jaxprs.
+
+Every exactness promise the TPU port makes — f32 prefix partials below
+2^24, HIGHEST-matmul operands below 2^16, the packed argmax inside
+int32 — used to rest on hand-derived constants asserted at dispatch
+time.  This pass *proves* them: each entry point (the five
+``contracts.ENTRY_CONTRACTS`` plus every resolved production-bucket
+body) is lowered to a jaxpr and abstractly interpreted in an interval
+domain seeded from the contract's input envelopes (sequence codes in
+[0, 26], lengths in [0, L], weights in [-maxv, maxv]).  The transfer
+functions cover the scoring vocabulary:
+
+* arithmetic (add/sub/mul/div/min/max/clamp/select) on exact integer
+  endpoints, with a **sentinel band**: constants at or below
+  ``-(2^29)`` (the kernels' masked-lane floors ``-2^40``, ``-(2^30)``,
+  ``-(2^31 - 1)``, ``INT32_MIN``) are tracked out-of-band, so one
+  masked lane does not smear the live score interval;
+* ``dot_general`` with the accumulator bound ``K * max|a| * max|b|``
+  and a **one-hot refinement**: operands built from ``codes == iota``
+  are partition-of-unity along the compared axis, so contracting over
+  that axis bounds the result by the OTHER operand's range — exactly
+  the hand argument for ``V = onehot(seq2) @ (val @ onehot(seq1).T)``;
+* ``convert_element_type`` as a containment check — the target dtype's
+  window (exact-integer window for floats) must contain the operand's
+  live band, else a typed ``lossy-narrowing`` finding; sentinel bands
+  discharge to the full target window (they are masked by construction
+  and the window covers every wrap/saturate outcome);
+* ``scan`` / ``while`` by bounded abstract iteration when a static trip
+  bound is visible (the lowered ``fori_loop`` pattern), falling back to
+  widening-to-fixpoint; float loop carries are recorded as
+  accumulators;
+* ``pallas_call`` by recursing into the kernel jaxpr: refs become
+  join-cells, the grid is a fixpoint over the cell state, and the
+  in-kernel ``get``/``swap`` state primitives read/update the cells;
+* a **congruence refinement** (value = stride * q + r) threaded through
+  ``mul``-by-constant and ``add``, proving the packed-argmax decode
+  (``// 4096`` and ``& 4095``) lossless;
+* unknown primitives fail closed: the result is the dtype's full
+  window and an ``unknown-primitive`` finding is emitted.
+
+The emitted ``RangeCert`` carries, per entry/bucket/envelope, the
+proved accumulator interval against the dtype and f32 exact-integer
+windows plus a verdict, then *re-derives* every hand constant
+(``max_exact_value(l2p)``, the 4095/32767 ceilings, the 2^19 rowpack
+gate, the 4096 argmax radix and its 2^31 bound, the feed thresholds)
+and diffs each against its wired value in ``ops/bounds.py`` — drift is
+a ``constant-drift`` finding.  A ``signed_weights`` section runs the
+same entries under the full int16 envelope ``[-32768, 32767]`` and
+documents which paths survive negative weights (the ROADMAP item 4
+BLOSUM/PAM prerequisite).  ``scripts/ranges_audit.py`` diffs the cert
+against ``tests/golden/ranges_cert.json``; ``run_or_raise`` backs the
+``make analyze`` pass.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+
+from . import RangeCertError
+
+#: Bands wholly at or below this are "sentinel": deliberate out-of-band
+#: masked-lane floors, not live scores.  Every kernel sentinel (-2^40,
+#: -(2^30), -(2^31 - 1), INT32_MIN) sits below it, and every live score
+#: (bounded by l2p * max|v| <= 2048 * 32767 < 2^27) sits far above.
+_SENTINEL_FLOOR = -(1 << 29)
+
+#: Loops with a visible static trip bound at or below this are iterated
+#: abstractly step by step (exact accumulation bounds); longer or
+#: unbounded loops take widening-to-fixpoint.
+_MAX_TRIP_UNROLL = 512
+
+#: Hard budget on abstractly evaluated equations per entry row — a
+#: runaway recursion aborts the row instead of hanging the audit.
+_EQN_BUDGET = 2_000_000
+
+_INF = math.inf
+
+
+# --------------------------------------------------------------------------
+# Interval domain
+# --------------------------------------------------------------------------
+
+
+def _mulc(a, b):
+    """inf-safe product with 0 * inf == 0."""
+    if a == 0 or b == 0:
+        return 0
+    return a * b
+
+
+@dataclasses.dataclass(frozen=True)
+class Interval:
+    """A closed interval with exact (Python int / float) endpoints."""
+
+    lo: float
+    hi: float
+
+    def join(self, o: "Interval") -> "Interval":
+        return Interval(min(self.lo, o.lo), max(self.hi, o.hi))
+
+    def add(self, o: "Interval") -> "Interval":
+        return Interval(self.lo + o.lo, self.hi + o.hi)
+
+    def sub(self, o: "Interval") -> "Interval":
+        return Interval(self.lo - o.hi, self.hi - o.lo)
+
+    def neg(self) -> "Interval":
+        return Interval(-self.hi, -self.lo)
+
+    def mul(self, o: "Interval") -> "Interval":
+        cands = [
+            _mulc(self.lo, o.lo),
+            _mulc(self.lo, o.hi),
+            _mulc(self.hi, o.lo),
+            _mulc(self.hi, o.hi),
+        ]
+        return Interval(min(cands), max(cands))
+
+    def max_(self, o: "Interval") -> "Interval":
+        return Interval(max(self.lo, o.lo), max(self.hi, o.hi))
+
+    def min_(self, o: "Interval") -> "Interval":
+        return Interval(min(self.lo, o.lo), min(self.hi, o.hi))
+
+    def scale_sum(self, n: int) -> "Interval":
+        """Bound on a sum of up to ``n`` terms each drawn from self
+        (prefix-sum semantics: any count from 0 to n)."""
+        return Interval(min(0, _mulc(n, self.lo)), max(0, _mulc(n, self.hi)))
+
+    def contains(self, o: "Interval") -> bool:
+        return self.lo <= o.lo and o.hi <= self.hi
+
+    def max_abs(self) -> float:
+        return max(abs(self.lo), abs(self.hi))
+
+    def is_const(self) -> bool:
+        return self.lo == self.hi
+
+
+def _iv(lo, hi) -> Interval:
+    return Interval(lo, hi)
+
+
+# --------------------------------------------------------------------------
+# dtype windows
+# --------------------------------------------------------------------------
+
+#: mantissa bits INCLUDING the implicit leading bit: integers with
+#: |x| <= 2^bits are exactly representable.
+_MANTISSA_BITS = {
+    "float64": 53,
+    "float32": 24,
+    "bfloat16": 8,
+    "float16": 11,
+}
+
+_FLOAT_MAX = {
+    "float64": 1.7976931348623157e308,
+    "float32": 3.4028234663852886e38,
+    "bfloat16": 3.3895313892515355e38,
+    "float16": 65504.0,
+}
+
+
+def dtype_window(dtype) -> Interval:
+    """The representable window of a dtype (ints: exact integer bounds;
+    floats: finite range; bool: [0, 1])."""
+    import numpy as np
+
+    name = str(np.dtype(dtype)) if str(dtype) != "bfloat16" else "bfloat16"
+    if name == "bool":
+        return _iv(0, 1)
+    if name in _FLOAT_MAX:
+        m = _FLOAT_MAX[name]
+        return _iv(-m, m)
+    info = np.iinfo(np.dtype(dtype))
+    return _iv(int(info.min), int(info.max))
+
+
+def exact_window(dtype) -> Interval:
+    """The window in which integer VALUES survive this dtype exactly:
+    for floats the 2^mantissa exact-integer window (2^24 for f32 — the
+    window every accumulation verdict is checked against), for ints the
+    full representable range."""
+    import numpy as np
+
+    name = str(np.dtype(dtype)) if str(dtype) != "bfloat16" else "bfloat16"
+    if name in _MANTISSA_BITS:
+        m = 1 << _MANTISSA_BITS[name]
+        return _iv(-m, m)
+    return dtype_window(dtype)
+
+
+def _is_float(dtype) -> bool:
+    name = str(dtype)
+    return name.startswith(("float", "bfloat"))
+
+
+def _is_int(dtype) -> bool:
+    name = str(dtype)
+    return name.startswith(("int", "uint"))
+
+
+# --------------------------------------------------------------------------
+# Abstract values
+# --------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class AbsVal:
+    """Abstract value: a live interval band, an optional sentinel band
+    (entirely at or below ``_SENTINEL_FLOOR``), and refinements — axes
+    along which AT MOST ONE element is nonzero (``onehot``; a partition
+    of unity when the bands also sit in [0, 1]), the iota axis (value
+    == index), and a congruence (value = stride*q + r, r in ``rem``)
+    for packed encodings."""
+
+    iv: Interval | None
+    sent: Interval | None = None
+    onehot: frozenset = frozenset()
+    iota_axis: int | None = None
+    stride: int | None = None
+    rem: Interval | None = None
+    #: identity tag linking a broadcast ``reduce_max``/``reduce_min``
+    #: back to its operand, so ``eq(x, broadcast(reduce_max(x)))`` is
+    #: recognisable as a mask with AT LEAST ONE hit per reduced slice.
+    origin: tuple | None = dataclasses.field(default=None, compare=False)
+    #: axes along which at least one element provably comes from
+    #: ``pick``'s interval (set on ``where(argmax_mask, v, default)``) —
+    #: lets reduce_min/reduce_max ignore the never-chosen default.
+    hasone: frozenset = dataclasses.field(default=frozenset(), compare=False)
+    pick: Interval | None = dataclasses.field(default=None, compare=False)
+
+    def bands(self):
+        out = []
+        if self.iv is not None:
+            out.append(self.iv)
+        if self.sent is not None:
+            out.append(self.sent)
+        return out
+
+    def flat(self) -> Interval:
+        """Live and sentinel merged — for transfer rules where the
+        separation carries no benefit."""
+        bs = self.bands()
+        if not bs:
+            return _iv(0, 0)
+        out = bs[0]
+        for b in bs[1:]:
+            out = out.join(b)
+        return out
+
+    def join(self, o: "AbsVal") -> "AbsVal":
+        stride, rem = None, None
+        if self.stride is not None and self.stride == o.stride:
+            stride = self.stride
+            rem = self.rem.join(o.rem) if (self.rem and o.rem) else None
+            if rem is None or not _iv(0, stride - 1).contains(rem):
+                stride, rem = None, None
+        return _mk(
+            self.bands() + o.bands(),
+            onehot=self.onehot & o.onehot,
+            iota_axis=self.iota_axis if self.iota_axis == o.iota_axis else None,
+            stride=stride,
+            rem=rem,
+        )
+
+
+def _mk(intervals, onehot=frozenset(), iota_axis=None, stride=None, rem=None):
+    live, sent = None, None
+    for it in intervals:
+        if it.hi <= _SENTINEL_FLOOR:
+            sent = it if sent is None else sent.join(it)
+        else:
+            live = it if live is None else live.join(it)
+    return AbsVal(live, sent, onehot, iota_axis, stride, rem)
+
+
+def _const_val(x) -> AbsVal:
+    import numpy as np
+
+    arr = np.asarray(x)
+    if arr.size == 0:
+        return AbsVal(_iv(0, 0))
+    if arr.dtype == bool:
+        return AbsVal(_iv(int(arr.min()), int(arr.max())))
+    lo, hi = arr.min(), arr.max()
+    if np.issubdtype(arr.dtype, np.integer):
+        return _mk([_iv(int(lo), int(hi))])
+    return _mk([_iv(float(lo), float(hi))])
+
+
+def _top(aval) -> AbsVal:
+    inner = getattr(aval, "inner_aval", aval)
+    return AbsVal(dtype_window(inner.dtype))
+
+
+class _RefCell:
+    """Mutable join-cell standing for one pallas ref: ``get`` reads the
+    cell, ``swap``/``addupdate`` join into it.  Cell identity flows
+    through nested jaxprs like any other abstract value."""
+
+    __slots__ = ("val", "aval")
+
+    def __init__(self, val, aval):
+        self.val = val
+        self.aval = aval
+
+
+@dataclasses.dataclass(frozen=True)
+class RangeFinding:
+    """One typed certifier finding."""
+
+    kind: str  # unknown-primitive | lossy-narrowing | int-overflow |
+    #            float-overflow | exactness-regression | constant-drift
+    where: str
+    detail: str
+
+    def to_dict(self) -> dict:
+        return {"kind": self.kind, "where": self.where, "detail": self.detail}
+
+
+# --------------------------------------------------------------------------
+# The interpreter
+# --------------------------------------------------------------------------
+
+_SHAPE_PASSTHRU = {
+    "copy",
+    "copy_p",
+    "rev",
+    "stop_gradient",
+    "real",
+    "reduce_precision",
+    "optimization_barrier",
+    "roll",
+    "tpu_roll",
+}
+
+_CMP = {"eq", "ne", "lt", "le", "gt", "ge"}
+
+
+class _Interp:
+    """One abstract interpretation run over a closed jaxpr tree."""
+
+    def __init__(self, where: str):
+        self.where = where
+        self.findings: list[RangeFinding] = []
+        self.unknown: set[str] = set()
+        self.float_accs: list[tuple[str, Interval]] = []
+        self.int_accs: list[tuple[str, Interval]] = []
+        self.widened = False
+        self.decodes_proved = 0
+        self.sentinel_casts = 0
+        self.axis_sizes: dict = {}
+        self.stack: list[str] = []
+        self._budget = _EQN_BUDGET
+
+    # -- plumbing ----------------------------------------------------------
+
+    def _find(self, kind: str, detail: str) -> None:
+        where = self.where
+        if self.stack:
+            where += " @ " + "/".join(self.stack)
+        self.findings.append(RangeFinding(kind, where, detail))
+
+    def _run_tagged(self, tag, jaxpr, consts, ins):
+        self.stack.append(tag)
+        try:
+            return self.run(jaxpr, consts, ins)
+        finally:
+            self.stack.pop()
+
+    def _read(self, env, v):
+        from jax.core import Literal
+
+        if isinstance(v, Literal):
+            return _const_val(v.val)
+        return env[v]
+
+    def run(self, jaxpr, consts, invals):
+        """Interpret a (raw) jaxpr given constvar and invar values."""
+        env = {}
+        for var, c in zip(jaxpr.constvars, consts):
+            env[var] = c if isinstance(c, (AbsVal, _RefCell)) else _const_val(c)
+        for var, v in zip(jaxpr.invars, invals):
+            env[var] = v
+        for eqn in jaxpr.eqns:
+            self._budget -= 1
+            if self._budget <= 0:
+                raise RangeCertError(
+                    f"{self.where}: abstract interpretation exceeded the "
+                    f"{_EQN_BUDGET} equation budget — a loop failed to "
+                    "converge; widen analysis/ranges.py's loop handling"
+                )
+            outs = self._eval_eqn(eqn, [self._read(env, v) for v in eqn.invars])
+            for var, out in zip(eqn.outvars, outs):
+                env[var] = self._check_window(eqn, var, out)
+        return [self._read(env, v) for v in jaxpr.outvars]
+
+    def _check_window(self, eqn, var, out):
+        """Clamp raw result bands to the output dtype's window; a live
+        band that escapes it is an overflow finding (ints can wrap,
+        floats can lose everything)."""
+        if isinstance(out, _RefCell):
+            return out
+        aval = getattr(var, "aval", None)
+        if aval is None or not hasattr(aval, "dtype"):
+            return out
+        dt = getattr(aval, "dtype", None)
+        if dt is None or not (_is_int(dt) or _is_float(dt)):
+            return out
+        win = dtype_window(dt)
+        if out.iv is not None and not win.contains(out.iv):
+            kind = "int-overflow" if _is_int(dt) else "float-overflow"
+            self._find(
+                kind,
+                f"{eqn.primitive.name} -> {dt}: proved interval "
+                f"[{out.iv.lo}, {out.iv.hi}] escapes the representable "
+                f"window [{win.lo}, {win.hi}]",
+            )
+            out = dataclasses.replace(
+                out,
+                iv=_iv(max(out.iv.lo, win.lo), min(out.iv.hi, win.hi)),
+                stride=None,
+                rem=None,
+            )
+        return out
+
+    def _sub_jaxpr(self, params, *keys):
+        for k in keys:
+            sub = params.get(k)
+            if sub is None:
+                continue
+            if hasattr(sub, "jaxpr"):  # ClosedJaxpr
+                return sub.jaxpr, list(sub.consts)
+            if hasattr(sub, "eqns"):  # raw Jaxpr
+                return sub, []
+        return None, None
+
+    # -- equation dispatch -------------------------------------------------
+
+    def _eval_eqn(self, eqn, ins):
+        name = eqn.primitive.name
+        params = eqn.params
+        out_aval = eqn.outvars[0].aval if eqn.outvars else None
+
+        handler = getattr(self, "_p_" + name.replace("-", "_"), None)
+        if handler is not None:
+            return handler(eqn, ins)
+
+        if name in _SHAPE_PASSTHRU:
+            a = ins[0]
+            return [
+                dataclasses.replace(a, iota_axis=None, stride=None, rem=None)
+            ] * len(eqn.outvars)
+        if name in _CMP:
+            return [self._cmp(name, eqn, ins)]
+
+        jx, consts = self._sub_jaxpr(
+            params, "jaxpr", "call_jaxpr", "fun_jaxpr", "cond_jaxpr"
+        )
+        if jx is not None and len(jx.invars) == len(ins):
+            return self.run(jx, consts, ins)
+
+        # Fail closed: dtype-window top for every output + typed finding.
+        self.unknown.add(name)
+        self._find(
+            "unknown-primitive",
+            f"no transfer function for primitive {name!r}: result assumed "
+            "to span its dtype window (fail closed) — teach "
+            "analysis/ranges.py this primitive",
+        )
+        return [_top(v.aval) for v in eqn.outvars]
+
+    # -- comparisons / logicals -------------------------------------------
+
+    def _cmp(self, name, eqn, ins):
+        a, b = ins
+        onehot = frozenset()
+        hasone = frozenset()
+        if name == "eq":
+            if a.iota_axis is not None and b.iota_axis is None:
+                onehot = frozenset({a.iota_axis})
+            elif b.iota_axis is not None and a.iota_axis is None:
+                onehot = frozenset({b.iota_axis})
+            # eq(x, broadcast(reduce_max(x))): the max is attained, so
+            # each slice along the reduced axes has at least one hit
+            # (keepdims / [None, :] broadcasts put the residual axes
+            # back at their original positions, making the reduced-axis
+            # indices valid in the mask's frame).
+            for x, y in ((a, b), (b, a)):
+                o = y.origin
+                if o is not None and o[0] == "rmax" and o[1] == id(x):
+                    hasone = frozenset(o[2])
+                    break
+        return AbsVal(_iv(0, 1), onehot=onehot, hasone=hasone)
+
+    # -- elementwise arithmetic -------------------------------------------
+
+    def _binop(self, ins, f):
+        a, b = ins
+        return _mk([f(x, y) for x in a.bands() for y in b.bands()])
+
+    def _p_add(self, eqn, ins):
+        a, b = ins
+        out = self._binop(ins, Interval.add)
+        stride, rem = self._cong_add(a, b)
+        return [dataclasses.replace(out, stride=stride, rem=rem)]
+
+    def _p_sub(self, eqn, ins):
+        return [self._binop(ins, Interval.sub)]
+
+    def _p_mul(self, eqn, ins):
+        a, b = ins
+        out = self._binop(ins, Interval.mul)
+        stride, rem = None, None
+        dt = eqn.outvars[0].aval.dtype
+        if _is_int(dt):
+            for x, y in ((a, b), (b, a)):
+                fy = y.flat()
+                if fy.is_const() and fy.lo == int(fy.lo) and fy.lo > 1:
+                    stride, rem = int(fy.lo), _iv(0, 0)
+                    break
+        return [dataclasses.replace(out, stride=stride, rem=rem)]
+
+    def _cong_add(self, a, b):
+        """stride/rem of a sum: a packed value plus a bounded key keeps
+        its stride when the combined remainder still fits one field."""
+        for x, y in ((a, b), (b, a)):
+            if x.stride is None or y.iv is None:
+                continue
+            xr = x.rem if x.rem is not None else _iv(0, 0)
+            yr = y.rem if (y.stride == x.stride and y.rem is not None) else y.iv
+            if y.stride not in (None, x.stride):
+                continue
+            rem = xr.add(yr)
+            if _iv(0, x.stride - 1).contains(rem):
+                return x.stride, rem
+        return None, None
+
+    def _p_neg(self, eqn, ins):
+        return [_mk([b.neg() for b in ins[0].bands()])]
+
+    def _p_abs(self, eqn, ins):
+        f = ins[0].flat()
+        lo = 0 if f.lo <= 0 <= f.hi else min(abs(f.lo), abs(f.hi))
+        return [AbsVal(_iv(lo, f.max_abs()))]
+
+    def _p_sign(self, eqn, ins):
+        return [AbsVal(_iv(-1, 1))]
+
+    def _p_max(self, eqn, ins):
+        a, b = ins
+        out = self._binop(ins, Interval.max_)
+        stride, rem = None, None
+        if a.stride is not None and a.stride == b.stride and a.rem and b.rem:
+            stride, rem = a.stride, a.rem.join(b.rem)
+        return [dataclasses.replace(out, stride=stride, rem=rem)]
+
+    def _p_min(self, eqn, ins):
+        return [self._binop(ins, Interval.min_)]
+
+    def _p_div(self, eqn, ins):
+        a, b = ins
+        fb = b.flat()
+        if fb.lo <= 0 <= fb.hi:
+            return [_top(eqn.outvars[0].aval)]
+        fa = a.flat()
+        cands = []
+        for x in (fa.lo, fa.hi):
+            for y in (fb.lo, fb.hi):
+                q = x / y
+                cands += [math.floor(q), math.ceil(q)]
+        if a.stride is not None and fb.is_const() and fb.lo == a.stride:
+            self.decodes_proved += 1
+        return [AbsVal(_iv(min(cands), max(cands)))]
+
+    def _p_rem(self, eqn, ins):
+        a, b = ins
+        fb = b.flat()
+        if fb.lo > 0:
+            d = fb.hi - 1
+            lo = 0 if a.flat().lo >= 0 else -d
+            return [AbsVal(_iv(lo, d))]
+        return [_top(eqn.outvars[0].aval)]
+
+    def _p_floor(self, eqn, ins):
+        f = ins[0].flat()
+        return [AbsVal(_iv(math.floor(f.lo), math.floor(f.hi)))]
+
+    def _p_ceil(self, eqn, ins):
+        f = ins[0].flat()
+        return [AbsVal(_iv(math.ceil(f.lo), math.ceil(f.hi)))]
+
+    def _p_round(self, eqn, ins):
+        f = ins[0].flat()
+        return [AbsVal(_iv(math.floor(f.lo), math.ceil(f.hi)))]
+
+    def _p_integer_pow(self, eqn, ins):
+        y = eqn.params["y"]
+        f = ins[0].flat()
+        if y % 2 == 0:
+            return [AbsVal(_iv(0, f.max_abs() ** y))]
+        return [AbsVal(_iv(f.lo**y, f.hi**y))]
+
+    def _p_square(self, eqn, ins):
+        f = ins[0].flat()
+        lo = 0 if f.lo <= 0 <= f.hi else min(f.lo**2, f.hi**2)
+        return [AbsVal(_iv(lo, f.max_abs() ** 2))]
+
+    def _p_clamp(self, eqn, ins):
+        lo_op, x, hi_op = ins
+        fl, fx, fh = lo_op.flat(), x.flat(), hi_op.flat()
+        lo = min(max(fx.lo, fl.lo), fh.hi)
+        hi = min(max(fx.hi, fl.lo), fh.hi)
+        return [AbsVal(_iv(lo, hi))]
+
+    # -- bitwise / shifts --------------------------------------------------
+
+    def _p_and(self, eqn, ins):
+        a, b = ins
+        dt = eqn.outvars[0].aval.dtype
+        if str(dt) == "bool":
+            oh = a.onehot | b.onehot
+            return [AbsVal(_iv(0, 1), onehot=oh)]
+        for x, y in ((a, b), (b, a)):
+            fy = y.flat()
+            if fy.is_const() and fy.lo >= 0:
+                mask = int(fy.lo)
+                if x.stride is not None and x.stride == mask + 1:
+                    # Packed-field extraction: x = stride*q + r, and the
+                    # mask keeps exactly r — the decode is lossless.
+                    self.decodes_proved += 1
+                    r = x.rem if x.rem is not None else _iv(0, mask)
+                    return [AbsVal(r)]
+                return [AbsVal(_iv(0, mask))]
+        fa, fb = a.flat(), b.flat()
+        if fa.lo >= 0 and fb.lo >= 0:
+            return [AbsVal(_iv(0, min(fa.hi, fb.hi)))]
+        return [_top(eqn.outvars[0].aval)]
+
+    def _p_or(self, eqn, ins):
+        a, b = ins
+        dt = eqn.outvars[0].aval.dtype
+        if str(dt) == "bool":
+            return [AbsVal(_iv(0, 1))]
+        fa, fb = a.flat(), b.flat()
+        if fa.lo >= 0 and fb.lo >= 0:
+            hi = max(fa.hi, fb.hi)
+            bits = int(hi).bit_length() if hi == int(hi) else 63
+            return [AbsVal(_iv(0, (1 << bits) - 1))]
+        return [_top(eqn.outvars[0].aval)]
+
+    def _p_xor(self, eqn, ins):
+        return self._p_or(eqn, ins)
+
+    def _p_not(self, eqn, ins):
+        dt = eqn.outvars[0].aval.dtype
+        if str(dt) == "bool":
+            return [AbsVal(_iv(0, 1))]
+        return [_top(eqn.outvars[0].aval)]
+
+    def _p_shift_left(self, eqn, ins):
+        a, b = ins
+        fb = b.flat()
+        if fb.is_const() and fb.lo >= 0:
+            k = 1 << int(fb.lo)
+            out = _mk([x.mul(_iv(k, k)) for x in a.bands()])
+            return [dataclasses.replace(out, stride=k, rem=_iv(0, 0))]
+        return [_top(eqn.outvars[0].aval)]
+
+    def _shift_right(self, eqn, ins):
+        a, b = ins
+        fb = b.flat()
+        if fb.is_const() and fb.lo >= 0:
+            k = 1 << int(fb.lo)
+            if a.stride is not None and a.stride == k:
+                self.decodes_proved += 1
+            f = a.flat()
+            return [AbsVal(_iv(math.floor(f.lo / k), math.floor(f.hi / k)))]
+        return [_top(eqn.outvars[0].aval)]
+
+    def _p_shift_right_arithmetic(self, eqn, ins):
+        return self._shift_right(eqn, ins)
+
+    def _p_shift_right_logical(self, eqn, ins):
+        if ins[0].flat().lo >= 0:
+            return self._shift_right(eqn, ins)
+        return [_top(eqn.outvars[0].aval)]
+
+    # -- shape ops (tag-aware) --------------------------------------------
+
+    def _remap(self, a, mapping):
+        """Remap axis tags through an old-axis -> new-axis mapping."""
+        onehot = frozenset(
+            mapping[ax] for ax in a.onehot if mapping.get(ax) is not None
+        )
+        hasone = frozenset(
+            mapping[ax] for ax in a.hasone if mapping.get(ax) is not None
+        )
+        iota = mapping.get(a.iota_axis) if a.iota_axis is not None else None
+        return dataclasses.replace(
+            a,
+            onehot=onehot,
+            iota_axis=iota,
+            stride=a.stride,
+            rem=a.rem,
+            hasone=hasone,
+            pick=a.pick if hasone else None,
+        )
+
+    def _p_broadcast_in_dim(self, eqn, ins):
+        bd = eqn.params["broadcast_dimensions"]
+        mapping = {i: d for i, d in enumerate(bd)}
+        return [self._remap(ins[0], mapping)]
+
+    def _p_reshape(self, eqn, ins):
+        old = tuple(eqn.invars[0].aval.shape)
+        new = tuple(eqn.outvars[0].aval.shape)
+        old_core = [(i, d) for i, d in enumerate(old) if d != 1]
+        new_core = [(i, d) for i, d in enumerate(new) if d != 1]
+        if [d for _, d in old_core] == [d for _, d in new_core]:
+            mapping = {oi: ni for (oi, _), (ni, _) in zip(old_core, new_core)}
+            return [self._remap(ins[0], mapping)]
+        return [
+            dataclasses.replace(
+                ins[0], onehot=frozenset(), iota_axis=None
+            )
+        ]
+
+    def _p_squeeze(self, eqn, ins):
+        dims = set(eqn.params["dimensions"])
+        old = range(len(eqn.invars[0].aval.shape))
+        mapping, j = {}, 0
+        for i in old:
+            if i in dims:
+                mapping[i] = None
+            else:
+                mapping[i] = j
+                j += 1
+        return [self._remap(ins[0], mapping)]
+
+    def _p_expand_dims(self, eqn, ins):
+        dims = set(eqn.params["dimensions"])
+        n_out = len(eqn.outvars[0].aval.shape)
+        mapping, i = {}, 0
+        for j in range(n_out):
+            if j not in dims:
+                mapping[i] = j
+                i += 1
+        return [self._remap(ins[0], mapping)]
+
+    def _p_transpose(self, eqn, ins):
+        perm = eqn.params["permutation"]
+        mapping = {old: new for new, old in enumerate(perm)}
+        # A permuted layout invalidates the frame the rmax origin's
+        # reduced-axis indices were recorded in.
+        return [dataclasses.replace(self._remap(ins[0], mapping), origin=None)]
+
+    def _p_slice(self, eqn, ins):
+        a = ins[0]
+        starts = eqn.params["start_indices"]
+        iota = a.iota_axis
+        if iota is not None and starts[iota] != 0:
+            a = dataclasses.replace(a, iota_axis=None)
+        # Slicing can cut away the guaranteed-hit lane.
+        return [dataclasses.replace(a, hasone=frozenset(), pick=None)]
+
+    def _p_dynamic_slice(self, eqn, ins):
+        return [
+            dataclasses.replace(
+                ins[0], iota_axis=None, hasone=frozenset(), pick=None
+            )
+        ]
+
+    def _p_dynamic_update_slice(self, eqn, ins):
+        return [ins[0].join(ins[1])]
+
+    def _p_concatenate(self, eqn, ins):
+        out = ins[0]
+        for o in ins[1:]:
+            out = out.join(o)
+        return [dataclasses.replace(out, iota_axis=None, stride=None, rem=None)]
+
+    def _p_pad(self, eqn, ins):
+        a, padval = ins
+        out = a.join(padval)
+        keep_onehot = a.onehot if padval.flat() == _iv(0, 0) else frozenset()
+        return [
+            dataclasses.replace(
+                out, onehot=keep_onehot, iota_axis=None, stride=None, rem=None
+            )
+        ]
+
+    def _p_iota(self, eqn, ins):
+        dim = eqn.params["dimension"]
+        shape = eqn.params["shape"]
+        return [AbsVal(_iv(0, max(shape[dim] - 1, 0)), iota_axis=dim)]
+
+    def _p_select_n(self, eqn, ins):
+        cond, cases = ins[0], ins[1:]
+        bands = [b for c in cases for b in c.bands()]
+        nonzero = []
+        onehot = None
+        for c in cases:
+            if c.iv is not None and c.iv == _iv(0, 0) and c.sent is None:
+                continue  # a literal zero branch keeps partitions intact
+            nonzero.append(c)
+            onehot = c.onehot if onehot is None else (onehot & c.onehot)
+        if len(nonzero) <= 1 and cond.onehot:
+            # where(onehot_mask, x, 0): at most one lane along the
+            # mask's axes survives — the select result inherits the
+            # at-most-one-nonzero structure whatever x's values are.
+            onehot = (onehot or frozenset()) | cond.onehot
+        hasone, pick = frozenset(), None
+        if cond.hasone and len(cases) == 2:
+            # where(argmax_mask, v, default): at least one lane along
+            # the mask's axes holds a v-element — min/max reductions
+            # over those axes may ignore the default.
+            hasone, pick = cond.hasone, cases[1].flat()
+        stride, rem = None, None
+        strides = {c.stride for c in cases}
+        if len(strides) == 1 and None not in strides:
+            stride = strides.pop()
+            rem = None
+            for c in cases:
+                r = c.rem if c.rem is not None else _iv(0, stride - 1)
+                rem = r if rem is None else rem.join(r)
+        out = _mk(
+            bands,
+            onehot=onehot or frozenset(),
+            stride=stride,
+            rem=rem,
+        )
+        if hasone:
+            out = dataclasses.replace(out, hasone=hasone, pick=pick)
+        return [out]
+
+    def _p_gather(self, eqn, ins):
+        return [
+            dataclasses.replace(
+                ins[0],
+                onehot=frozenset(),
+                iota_axis=None,
+                stride=None,
+                rem=None,
+            )
+        ]
+
+    def _p_scatter(self, eqn, ins):
+        return [ins[0].join(ins[-1])]
+
+    _p_scatter_add = _p_scatter
+
+    def _p_convert_element_type(self, eqn, ins):
+        a = ins[0]
+        src = eqn.invars[0].aval.dtype
+        dst = eqn.outvars[0].aval.dtype
+        if _is_float(src) and _is_float(dst):
+            sm = _MANTISSA_BITS.get(str(src), 53)
+            dm = _MANTISSA_BITS.get(str(dst), 53)
+            if dm >= sm and dtype_window(dst).contains(
+                dtype_window(src)
+            ):
+                # Same-or-wider float: every value crosses losslessly
+                # (the exact-integer window only gates INTEGER-valued
+                # data entering a float pipeline, i.e. int -> float and
+                # narrowing float casts).
+                return [a]
+        dwin = dtype_window(dst)
+        xwin = exact_window(dst)
+        out_bands = []
+        for band in a.bands():
+            target = xwin if _is_float(dst) else dwin
+            if target.contains(band):
+                out_bands.append(band)
+            elif band.hi <= _SENTINEL_FLOOR:
+                # Masked-lane sentinel discharged through a cast: the
+                # true cast result is wrap/saturate garbage on lanes the
+                # program provably discards; the full target window
+                # covers every outcome, so this stays finding-free but
+                # is counted in the cert row.
+                self.sentinel_casts += 1
+                out_bands.append(dwin)
+            else:
+                self._find(
+                    "lossy-narrowing",
+                    f"convert_element_type {src} -> {dst}: operand band "
+                    f"[{band.lo}, {band.hi}] escapes the target "
+                    f"{'exact-integer ' if _is_float(dst) else ''}window "
+                    f"[{target.lo}, {target.hi}] — values would round or "
+                    "wrap",
+                )
+                out_bands.append(dwin)
+        if not out_bands:
+            out_bands = [_iv(0, 0)]
+        return [
+            _mk(
+                out_bands,
+                onehot=a.onehot,
+                iota_axis=a.iota_axis,
+                stride=a.stride,
+                rem=a.rem,
+            )
+        ]
+
+    # -- reductions & contractions ----------------------------------------
+
+    def _axes_count(self, eqn) -> int:
+        n = 1
+        shape = eqn.invars[0].aval.shape
+        for ax in eqn.params["axes"]:
+            n *= shape[ax]
+        return n
+
+    def _p_reduce_sum(self, eqn, ins):
+        a = ins[0]
+        axes = eqn.params["axes"]
+        shape = eqn.invars[0].aval.shape
+        f = a.flat()
+        hot = set(a.onehot) & set(axes)
+        if hot:
+            # At most one nonzero lane along each onehot axis: the sum
+            # collapses those axes to a single term (join zero for the
+            # all-masked slice).
+            n = 1
+            for ax in axes:
+                if ax not in hot:
+                    n *= shape[ax]
+            out = f.scale_sum(n) if n > 1 else _iv(min(0, f.lo), max(0, f.hi))
+        else:
+            n = self._axes_count(eqn)
+            out = _iv(_mulc(n, f.lo), _mulc(n, f.hi))
+        if n > 1:
+            # A one-hot-collapsed "sum" (n == 1) is an extraction, not
+            # an accumulation: no rounding is introduced beyond what the
+            # operand's own producers were already checked for.
+            self._record_acc(eqn, out)
+        # Surviving onehot axes renumber past the removed ones.
+        keep = frozenset(
+            ax - sum(1 for r in axes if r < ax)
+            for ax in a.onehot
+            if ax not in axes
+        )
+        return [_mk([out], onehot=keep)]
+
+    def _p_reduce_max(self, eqn, ins):
+        a = ins[0]
+        axes = tuple(eqn.params["axes"])
+        if set(axes) & a.hasone and a.pick is not None:
+            # At least one reduced lane holds a pick-element, so the
+            # max can't sink below pick.lo — the never-chosen default
+            # (e.g. the -1 miss marker) drops out.
+            f = a.flat()
+            return [AbsVal(_iv(max(f.lo, a.pick.lo), f.hi))]
+        return [
+            dataclasses.replace(
+                a,
+                onehot=frozenset(),
+                iota_axis=None,
+                origin=("rmax", id(a), axes),
+                hasone=frozenset(),
+                pick=None,
+            )
+        ]
+
+    def _p_reduce_min(self, eqn, ins):
+        a = ins[0]
+        axes = tuple(eqn.params["axes"])
+        if set(axes) & a.hasone and a.pick is not None:
+            # Dual: the min can't rise above pick.hi — the BIG-row miss
+            # default never survives the reduction.
+            f = a.flat()
+            return [AbsVal(_iv(f.lo, min(f.hi, a.pick.hi)))]
+        return [
+            dataclasses.replace(
+                a,
+                onehot=frozenset(),
+                iota_axis=None,
+                origin=("rmax", id(a), axes),
+                hasone=frozenset(),
+                pick=None,
+            )
+        ]
+
+    def _p_reduce_and(self, eqn, ins):
+        return [AbsVal(_iv(0, 1))]
+
+    _p_reduce_or = _p_reduce_and
+
+    def _p_argmax(self, eqn, ins):
+        shape = eqn.invars[0].aval.shape
+        n = 1
+        for ax in eqn.params["axes"]:
+            n *= shape[ax]
+        return [AbsVal(_iv(0, max(n - 1, 0)))]
+
+    _p_argmin = _p_argmax
+
+    def _p_cumsum(self, eqn, ins):
+        ax = eqn.params["axis"]
+        n = eqn.invars[0].aval.shape[ax]
+        f = ins[0].flat()
+        out = f.scale_sum(n).join(f)
+        self._record_acc(eqn, out)
+        return [_mk([out])]
+
+    def _p_cummax(self, eqn, ins):
+        return [dataclasses.replace(ins[0], onehot=frozenset(), iota_axis=None)]
+
+    _p_cummin = _p_cummax
+
+    def _record_acc(self, eqn, interval: Interval) -> None:
+        dt = eqn.outvars[0].aval.dtype
+        label = f"{eqn.primitive.name}:{tuple(eqn.outvars[0].aval.shape)}"
+        if _is_float(dt):
+            self.float_accs.append((label, interval))
+        elif _is_int(dt):
+            self.int_accs.append((label, interval))
+
+    def _p_dot_general(self, eqn, ins):
+        a, b = ins
+        (lc, rc), _ = eqn.params["dimension_numbers"]
+        lsh = eqn.invars[0].aval.shape
+        k = 1
+        for d in lc:
+            k *= lsh[d]
+        fa, fb = a.flat(), b.flat()
+        unit = _iv(0, 1)
+        lhs_onehot = len(lc) == 1 and lc[0] in a.onehot
+        rhs_onehot = len(rc) == 1 and rc[0] in b.onehot
+        if lhs_onehot and unit.contains(fa):
+            # Partition of unity contracted away: a convex selection of
+            # the other operand's entries.
+            out = _iv(min(0, fb.lo), max(0, fb.hi))
+        elif rhs_onehot and unit.contains(fb):
+            out = _iv(min(0, fa.lo), max(0, fa.hi))
+        elif lhs_onehot or rhs_onehot:
+            # At most one nonzero term in the contraction.
+            p = fa.mul(fb)
+            out = _iv(min(0, p.lo), max(0, p.hi))
+        else:
+            p = fa.mul(fb)
+            out = _iv(_mulc(k, p.lo), _mulc(k, p.hi))
+        self._record_acc(eqn, out)
+        return [_mk([out])]
+
+    # -- control flow ------------------------------------------------------
+
+    def _p_cond(self, eqn, ins):
+        branches = eqn.params["branches"]
+        operands = ins[1:]
+        outs = None
+        for br in branches:
+            res = self.run(br.jaxpr, list(br.consts), list(operands))
+            if outs is None:
+                outs = res
+            else:
+                outs = [self._join_any(x, y) for x, y in zip(outs, res)]
+        return outs
+
+    def _join_any(self, x, y):
+        if isinstance(x, _RefCell) or isinstance(y, _RefCell):
+            return x  # refs are aliased cells, not joinable values
+        return x.join(y)
+
+    def _loop_fixpoint(self, body, consts, pre, carry0, xs, trip):
+        """Abstractly iterate a loop body whose invars are ``[*pre,
+        *carry, *xs]``.  ``trip`` bounds the dynamic iteration count
+        when known (result = prefix-join over 0..trip steps, exact for
+        accumulate-by-add carries); None means unknown —
+        join-until-stable with widening."""
+        acc = list(carry0)
+        cur = list(carry0)
+        ys_join = None
+        rounds = trip if (trip is not None and trip <= _MAX_TRIP_UNROLL) else (
+            _MAX_TRIP_UNROLL
+        )
+        widen_at = rounds if trip is not None else 8
+        for it in range(rounds):
+            outs = self.run(body, consts, pre + cur + xs)
+            ncarry = outs[: len(carry0)]
+            ys = outs[len(carry0):]
+            if ys_join is None:
+                ys_join = list(ys)
+            else:
+                ys_join = [self._join_any(a, b) for a, b in zip(ys_join, ys)]
+            nxt = []
+            stable = True
+            for c, n in zip(cur, ncarry):
+                if isinstance(c, _RefCell) or isinstance(n, _RefCell):
+                    nxt.append(n)
+                    continue
+                if it >= widen_at:
+                    n = self._widen(c, n)
+                    self.widened = True
+                j = n if trip is not None else c.join(n)
+                if j != c:
+                    stable = False
+                nxt.append(j)
+            acc = [self._join_any(a, b) for a, b in zip(acc, nxt)]
+            cur = nxt
+            if stable:
+                break
+        result = acc if trip is not None else cur
+        return result, (ys_join if ys_join is not None else [])
+
+    def _widen(self, old, new):
+        if old.iv is None or new.iv is None:
+            return new
+        lo, hi = new.iv.lo, new.iv.hi
+        if lo < old.iv.lo:
+            lo = -_INF
+        if hi > old.iv.hi:
+            hi = _INF
+        return dataclasses.replace(
+            new, iv=_iv(lo, hi), stride=None, rem=None
+        )
+
+    def _p_while(self, eqn, ins):
+        p = eqn.params
+        cn, bn = p["cond_nconsts"], p["body_nconsts"]
+        cond, body = p["cond_jaxpr"], p["body_jaxpr"]
+        cond_consts = ins[:cn]
+        body_consts = ins[cn: cn + bn]
+        carry0 = ins[cn + bn:]
+        trip = self._while_trip_bound(cond, cond_consts, carry0)
+        body_env_consts = list(body.consts) if hasattr(body, "consts") else []
+        carry, _ = self._loop_fixpoint(
+            body.jaxpr,
+            body_env_consts,
+            list(body_consts),
+            list(carry0),
+            [],
+            trip,
+        )
+        self._record_loop_carries(carry, eqn.outvars)
+        return carry
+
+    def _while_trip_bound(self, cond, cond_consts, carry0):
+        """Recognise the lowered fori pattern — cond is a single
+        ``lt i n`` over carry slots — and bound the trip count by the
+        abstract ranges of ``i``'s start and ``n``."""
+        try:
+            cj = cond.jaxpr
+            if len(cj.eqns) != 1 or cj.eqns[0].primitive.name != "lt":
+                return None
+            eq = cj.eqns[0]
+            if list(cj.outvars) != list(eq.outvars):
+                return None
+            ncc = len(cj.constvars)
+            slots = {v: i for i, v in enumerate(cj.invars)}
+
+            def resolve(v):
+                from jax.core import Literal
+
+                if isinstance(v, Literal):
+                    return _const_val(v.val)
+                if v in slots:
+                    idx = slots[v]
+                    pool = list(cond_consts) + list(carry0)
+                    return pool[idx] if idx < len(pool) else None
+                return None
+
+            del ncc
+            a = resolve(eq.invars[0])
+            b = resolve(eq.invars[1])
+            if a is None or b is None or a.iv is None or b.iv is None:
+                return None
+            trip = b.iv.hi - a.iv.lo
+            if trip != trip or trip == _INF:  # NaN / unbounded
+                return None
+            trip = int(max(0, trip))
+            return trip if trip <= _MAX_TRIP_UNROLL else None
+        except Exception:  # noqa: BLE001 - recognition only, never fatal
+            return None
+
+    def _record_loop_carries(self, carry, outvars):
+        for c, var in zip(carry, outvars):
+            if isinstance(c, _RefCell) or c.iv is None:
+                continue
+            dt = getattr(getattr(var, "aval", None), "dtype", None)
+            if dt is None:
+                continue
+            if _is_float(dt):
+                self.float_accs.append(("loop-carry", c.iv))
+            elif _is_int(dt):
+                self.int_accs.append(("loop-carry", c.iv))
+
+    def _p_scan(self, eqn, ins):
+        p = eqn.params
+        nconsts, ncarry = p["num_consts"], p["num_carry"]
+        length = p["length"]
+        closed = p["jaxpr"]
+        consts = ins[:nconsts]
+        carry0 = ins[nconsts: nconsts + ncarry]
+        xs = ins[nconsts + ncarry:]
+
+        def slice_x(x):
+            onehot = frozenset(t - 1 for t in x.onehot if t > 0)
+            iota = (
+                x.iota_axis - 1
+                if (x.iota_axis is not None and x.iota_axis > 0)
+                else None
+            )
+            return dataclasses.replace(x, onehot=onehot, iota_axis=iota)
+
+        xslices = [slice_x(x) for x in xs]
+        jx_consts = [_const_val(c) for c in closed.consts]
+        trip = length if length <= _MAX_TRIP_UNROLL else None
+        carry, ys = self._loop_fixpoint(
+            closed.jaxpr, jx_consts, list(consts), list(carry0), xslices, trip
+        )
+        if trip is None:
+            self.widened = True
+        self._record_loop_carries(carry, eqn.outvars[: len(carry)])
+
+        def stack_y(y):
+            if isinstance(y, _RefCell):
+                return y
+            onehot = frozenset(t + 1 for t in y.onehot)
+            return dataclasses.replace(y, onehot=onehot, iota_axis=None)
+
+        return list(carry) + [stack_y(y) for y in ys]
+
+    def _p_pjit(self, eqn, ins):
+        closed = eqn.params["jaxpr"]
+        tag = eqn.params.get("name") or "pjit"
+        return self._run_tagged(tag, closed.jaxpr, list(closed.consts), list(ins))
+
+    def _p_closed_call(self, eqn, ins):
+        closed = eqn.params["call_jaxpr"]
+        return self.run(closed.jaxpr, list(closed.consts), list(ins))
+
+    def _p_custom_jvp_call(self, eqn, ins):
+        closed = eqn.params["call_jaxpr"]
+        return self.run(closed.jaxpr, list(closed.consts), list(ins))
+
+    def _p_custom_vjp_call(self, eqn, ins):
+        closed = eqn.params.get("call_jaxpr") or eqn.params.get("fun_jaxpr")
+        return self.run(closed.jaxpr, list(closed.consts), list(ins))
+
+    def _p_remat2(self, eqn, ins):
+        jx = eqn.params["jaxpr"]
+        return self.run(jx, [], list(ins))
+
+    _p_checkpoint = _p_remat2
+
+    # -- sharding / collectives -------------------------------------------
+
+    def _p_shard_map(self, eqn, ins):
+        mesh = eqn.params.get("mesh")
+        if mesh is not None and hasattr(mesh, "shape"):
+            try:
+                self.axis_sizes.update(dict(mesh.shape))
+            except Exception:  # noqa: BLE001 - mesh introspection only
+                pass
+        jx, consts = self._sub_jaxpr(eqn.params, "jaxpr")
+        if jx is None or len(jx.invars) != len(ins):
+            self.unknown.add("shard_map")
+            self._find(
+                "unknown-primitive",
+                "shard_map body jaxpr not introspectable — fail closed",
+            )
+            return [_top(v.aval) for v in eqn.outvars]
+        return self.run(jx, consts, list(ins))
+
+    def _axis_prod(self, axes) -> int:
+        n = 1
+        if isinstance(axes, (str, int)):
+            axes = (axes,)
+        for ax in axes or ():
+            n *= int(self.axis_sizes.get(ax, 8))
+        return n
+
+    def _p_psum(self, eqn, ins):
+        n = self._axis_prod(eqn.params.get("axes") or eqn.params.get("axis_name"))
+        outs = []
+        for a, v in zip(ins, eqn.outvars):
+            f = a.flat()
+            out = _iv(_mulc(n, min(f.lo, 0)) + max(f.lo, 0),
+                      _mulc(n, max(f.hi, 0)) + min(f.hi, 0))
+            self.float_accs.append((f"psum:{tuple(v.aval.shape)}", out)) if _is_float(
+                v.aval.dtype
+            ) else self.int_accs.append((f"psum:{tuple(v.aval.shape)}", out))
+            outs.append(_mk([out]))
+        return outs
+
+    def _p_all_gather(self, eqn, ins):
+        return [
+            dataclasses.replace(
+                a, onehot=frozenset(), iota_axis=None, stride=None, rem=None
+            )
+            for a in ins
+        ]
+
+    _p_ppermute = _p_all_gather
+    _p_all_to_all = _p_all_gather
+    _p_pbroadcast = _p_all_gather
+
+    def _p_axis_index(self, eqn, ins):
+        n = self._axis_prod(eqn.params.get("axis_name"))
+        return [AbsVal(_iv(0, max(n - 1, 0)))]
+
+    def _p_pmax(self, eqn, ins):
+        return [dataclasses.replace(a, onehot=frozenset(), iota_axis=None) for a in ins]
+
+    _p_pmin = _p_pmax
+
+    # -- pallas ------------------------------------------------------------
+
+    def _p_pallas_call(self, eqn, ins):
+        jx, consts = self._sub_jaxpr(eqn.params, "jaxpr")
+        n_out = len(eqn.outvars)
+        if jx is None or len(jx.invars) < len(ins) + n_out:
+            self.unknown.add("pallas_call")
+            self._find(
+                "unknown-primitive",
+                "pallas_call kernel jaxpr not introspectable — fail closed",
+            )
+            return [_top(v.aval) for v in eqn.outvars]
+        cells = []
+        for i, var in enumerate(jx.invars):
+            if i < len(ins):
+                cells.append(_RefCell(ins[i], var.aval))
+            else:
+                cells.append(_RefCell(None, var.aval))
+        # The grid re-runs the kernel over cell state: fixpoint with a
+        # small round bound, then widening (cells joined to dtype top).
+        tag = eqn.params.get("name") or "kernel"
+        for rounds in range(8):
+            before = [c.val for c in cells]
+            self._run_tagged(f"pallas:{tag}", jx, consts, list(cells))
+            if all(
+                self._cell_eq(b, c.val) for b, c in zip(before, cells)
+            ):
+                break
+        else:
+            for c in cells[len(ins):]:
+                c.val = _top(c.aval)
+            self.widened = True
+        del rounds
+        outs = []
+        for c in cells[len(ins): len(ins) + n_out]:
+            outs.append(c.val if c.val is not None else _top(c.aval))
+        return outs
+
+    def _cell_eq(self, a, b) -> bool:
+        return a == b
+
+    def _p_get(self, eqn, ins):
+        cell = ins[0]
+        if not isinstance(cell, _RefCell):
+            return [_top(eqn.outvars[0].aval)]
+        if cell.val is None:
+            return [_top(cell.aval)]
+        return [cell.val]
+
+    _p_masked_load = _p_get
+
+    def _p_swap(self, eqn, ins):
+        cell, new = ins[0], ins[1]
+        if not isinstance(cell, _RefCell):
+            return [_top(eqn.outvars[0].aval)]
+        old = cell.val if cell.val is not None else new
+        cell.val = old.join(new) if old is not new else new
+        return [old]
+
+    _p_masked_store = _p_swap
+
+    def _p_addupdate(self, eqn, ins):
+        cell, add = ins[0], ins[1]
+        if isinstance(cell, _RefCell):
+            base = cell.val if cell.val is not None else AbsVal(_iv(0, 0))
+            cell.val = base.join(
+                _mk([x.add(y) for x in base.bands() for y in add.bands()])
+            )
+        return []
+
+    def _p_program_id(self, eqn, ins):
+        return [AbsVal(_iv(0, 1 << 20))]
+
+    def _p_num_programs(self, eqn, ins):
+        return [AbsVal(_iv(1, 1 << 20))]
+
+    def _p_multiple_of(self, eqn, ins):
+        return [ins[0]]
+
+    # -- misc --------------------------------------------------------------
+
+    def _p_is_finite(self, eqn, ins):
+        return [AbsVal(_iv(0, 1))]
+
+    def _p_split(self, eqn, ins):
+        a = dataclasses.replace(
+            ins[0], onehot=frozenset(), iota_axis=None, stride=None, rem=None
+        )
+        return [a] * len(eqn.outvars)
+
+    def _p_sort(self, eqn, ins):
+        return [
+            dataclasses.replace(
+                a, onehot=frozenset(), iota_axis=None, stride=None, rem=None
+            )
+            for a in ins
+        ]
+
+    def _p_device_put(self, eqn, ins):
+        return list(ins)
+
+
+# --------------------------------------------------------------------------
+# Row analysis
+# --------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class RowResult:
+    """Proved result for one (entry, bucket, envelope) row."""
+
+    verdict: str  # exact | representable | unproven
+    float_acc: Interval | None
+    int_acc: Interval | None
+    findings: list
+    unknown: list
+    widened: bool
+    sentinel_casts: int
+    decodes_proved: int
+
+    def to_dict(self) -> dict:
+        def ivl(x):
+            return None if x is None else [x.lo, x.hi]
+
+        return {
+            "verdict": self.verdict,
+            "float_acc": ivl(self.float_acc),
+            "int_acc": ivl(self.int_acc),
+            "findings": [f.to_dict() for f in self.findings],
+            "unknown_primitives": sorted(self.unknown),
+            "widened": self.widened,
+            "sentinel_casts": self.sentinel_casts,
+            "decodes_proved": self.decodes_proved,
+        }
+
+
+def _join_accs(accs):
+    out = None
+    for _, it in accs:
+        out = it if out is None else out.join(it)
+    return out
+
+
+def analyze_jaxpr(closed, seeds, where: str) -> RowResult:
+    """Abstractly interpret one closed jaxpr under seeded input
+    envelopes and compute the row verdict."""
+    interp = _Interp(where)
+    consts = [_const_val(c) for c in closed.consts]
+    interp.run(closed.jaxpr, consts, list(seeds))
+
+    f32_window = _iv(-(1 << 24), 1 << 24)
+    float_acc = _join_accs(interp.float_accs)
+    int_acc = _join_accs(interp.int_accs)
+
+    if interp.unknown or interp.widened:
+        verdict = "unproven" if interp.unknown else "representable"
+    else:
+        verdict = "exact"
+    if verdict == "exact" and float_acc is not None and not f32_window.contains(
+        float_acc
+    ):
+        verdict = "representable"
+    if any(f.kind in ("int-overflow", "float-overflow") for f in interp.findings):
+        verdict = "unproven"
+
+    return RowResult(
+        verdict=verdict,
+        float_acc=float_acc,
+        int_acc=int_acc,
+        findings=list(interp.findings),
+        unknown=sorted(interp.unknown),
+        widened=interp.widened,
+        sentinel_casts=interp.sentinel_casts,
+        decodes_proved=interp.decodes_proved,
+    )
+
+
+def entry_seeds(args, l1p: int, l2p: int, w_lo: int, w_hi: int):
+    """Input envelopes for the canonical 5-operand chunk/pair signature:
+    (seq1ext codes, len1, rows codes, lens, val_flat)."""
+    if len(args) != 5:
+        return [AbsVal(dtype_window(a.dtype)) for a in args]
+    return [
+        AbsVal(_iv(0, 26)),
+        AbsVal(_iv(0, l1p)),
+        AbsVal(_iv(0, 26)),
+        AbsVal(_iv(0, l2p)),
+        AbsVal(_iv(w_lo, w_hi)),
+    ]
+
+
+def analyze_entry(fn, args, seeds, where: str) -> RowResult:
+    """Lower ``fn`` at abstract ``args`` and analyze under ``seeds``."""
+    import jax
+
+    try:
+        closed = jax.make_jaxpr(fn)(*args)
+    except Exception as exc:  # noqa: BLE001 - re-raise with context
+        raise RangeCertError(f"{where}: failed to lower: {exc!r}") from exc
+    return analyze_jaxpr(closed, seeds, where)
+
+
+# --------------------------------------------------------------------------
+# Derived constants — the machine re-derivation of every hand bound
+# --------------------------------------------------------------------------
+
+
+def _derive_operand_cap() -> int:
+    """Largest max|v| whose delta operand |d0 - d1| = 2*max|v| fits the
+    16 mantissa bits the HIGHEST multi-pass matmul resolves."""
+    budget = (1 << 16) - 1
+    v = Interval(0, 0)
+    cap = 0
+    while True:
+        nxt = cap + 1
+        v = _iv(-nxt, nxt)
+        if v.sub(v).max_abs() > budget:
+            return cap
+        cap = nxt
+        if cap > budget:  # pragma: no cover - safety rail
+            return cap
+
+
+def _derive_max_exact(l2p: int) -> int:
+    """Largest max|v| for which the interval engine's own accumulator
+    bound for the delta formulation at bucket width ``l2p`` stays inside
+    the f32 exact-integer window (and the operand inside the HIGHEST
+    budget) — binary search over a monotone admissibility predicate."""
+    window = exact_window("float32")
+    strict = _iv(window.lo + 1, window.hi - 1)  # 2*l2p*maxv <= 2^24 - 1
+    cap = _derive_operand_cap()
+
+    def admissible(v: int) -> bool:
+        if v > cap:
+            return False
+        val = _iv(-v, v)
+        delta = val.sub(val)  # the dot operand: |d0 - d1| <= 2v
+        prefix = delta.scale_sum(l2p)  # G partials over <= l2p rows
+        return strict.contains(prefix)
+
+    lo, hi = 0, 1 << 25
+    while lo < hi:
+        mid = (lo + hi + 1) // 2
+        if admissible(mid):
+            lo = mid
+        else:
+            hi = mid - 1
+    return lo
+
+
+def _pack_capacity(radix: int, ceiling: int) -> int:
+    """Largest |payload| with payload * radix + (radix - 1) <= ceiling —
+    the generic int32 packing budget behind the 2^19 rowpack gate and
+    the 2^31 argmax bound."""
+    return (ceiling - (radix - 1)) // radix
+
+
+def _derive_pack_radix(kappa_max: int) -> int:
+    """Smallest power of two strictly above every packable kappa, so the
+    low field masks/divides out exactly."""
+    r = 1
+    while r <= kappa_max:
+        r <<= 1
+    return r
+
+
+def _padded_bucket_cap() -> int:
+    from ..utils.constants import BUF_SIZE_SEQ2
+
+    return ((BUF_SIZE_SEQ2 + 127) // 128) * 128
+
+
+def derive_constants(wired: dict | None = None):
+    """Re-derive every hand numeric bound with the interval machinery
+    and diff each against its wired source value.  ``wired`` overrides
+    the imported sources (tests inject drift).  Returns (rows,
+    findings)."""
+    from ..ops import bounds as B
+    from ..ops.dispatch import pack_classes
+    from ..ops.matmul_scorer import MAX_NATIVE_PRECISION_WEIGHT
+    from ..ops.pallas_scorer import MAX_BF16_EXACT_WEIGHT, MAX_I8_EXACT_WEIGHT
+
+    w = {
+        "f32-exact-window": B.F32_EXACT_WINDOW,
+        "operand-cap": B.OPERAND_CAP,
+        "static-weight-ceiling": B.MAX_EXACT_WEIGHT,
+        "rowpack-epilogue-limit": B.ROWPACK_EPILOGUE_LIMIT,
+        "superblock-key-budget": B.SUPERBLOCK_CAP,
+        "argmax-pack-radix": B.PACK_RADIX,
+        "argmax-pack-bound": B.PACKED_L2P_CEILING,
+        "int32-packed-sentinel": B.INT32_PACKED_SENTINEL,
+        "i8-feed-ceiling": MAX_I8_EXACT_WEIGHT,
+        "bf16-feed-ceiling": MAX_BF16_EXACT_WEIGHT,
+        "native-precision-ceiling": MAX_NATIVE_PRECISION_WEIGHT,
+    }
+    for l2p in (128, 256, 512, 1024, 2048):
+        w[f"max-exact-value-{l2p}"] = B.max_exact_value(l2p)
+    w["rowpack-classes-static"] = list(pack_classes("f32", B.MAX_EXACT_WEIGHT))
+    if wired:
+        w.update(wired)
+
+    int32_max = (1 << 31) - 1
+    bucket_cap = _padded_bucket_cap()
+    i8_max = int(dtype_window("int8").hi)  # 127
+
+    rows = []
+
+    def row(name, derived, relation="==", note=""):
+        wv = w.get(name)
+        if relation == "==":
+            ok = derived == wv
+        elif relation == "<=":  # wired must not exceed the derived bound
+            ok = wv is not None and wv <= derived
+        else:  # pragma: no cover - defensive
+            ok = False
+        rows.append(
+            {
+                "name": name,
+                "derived": derived,
+                "wired": wv,
+                "relation": relation,
+                "ok": bool(ok),
+                "note": note,
+            }
+        )
+
+    row(
+        "f32-exact-window",
+        int(exact_window("float32").hi),
+        note="2^(f32 mantissa bits): integers to here survive f32 exactly",
+    )
+    row(
+        "operand-cap",
+        _derive_operand_cap(),
+        note="largest max|v| with delta operand 2*max|v| <= 2^16 - 1",
+    )
+    for l2p in (128, 256, 512, 1024, 2048):
+        row(
+            f"max-exact-value-{l2p}",
+            _derive_max_exact(l2p),
+            note=f"engine-derived exact-weight ceiling at l2p={l2p}",
+        )
+    row(
+        "static-weight-ceiling",
+        _derive_max_exact(bucket_cap),
+        note=f"max-exact-value at the padded BUF_SIZE_SEQ2 cap ({bucket_cap})",
+    )
+    # Rowpack epilogue: key field = 2^SUPERBLOCK_KEY_BITS lanes, packed
+    # payload must fit int32 -> payload < 2^(31 - key_bits) = 2^19.
+    key_bits = w.get("superblock-key-bits", B.SUPERBLOCK_KEY_BITS)
+    rowpack_limit = _pack_capacity(1 << key_bits, int32_max) + 1
+    row(
+        "rowpack-epilogue-limit",
+        rowpack_limit,
+        note="packed epilogue payload bound: payload*2^12 + (2^12-1) "
+        "<= 2^31 - 1",
+    )
+    # Largest sb whose lane key still fits the 12-bit field.
+    sb = 1
+    while ((sb + 1) * 128 - 1).bit_length() <= key_bits:
+        sb += 1
+    row(
+        "superblock-key-budget",
+        sb,
+        relation="<=",
+        note="derived admissible sb cap from klb <= 12; the wired 24 is "
+        "the measured perf plateau and must only stay at or below it",
+    )
+    radix = _derive_pack_radix(bucket_cap)
+    row(
+        "argmax-pack-radix",
+        radix,
+        note=f"smallest pow2 > kappa_max = {bucket_cap}",
+    )
+    # Packed argmax admission: |g| <= 2 * 127 * l2p must pack into int32.
+    g_budget = _pack_capacity(radix, int32_max)
+    l2p_cap = (g_budget // (2 * i8_max)) // 128 * 128
+    row(
+        "argmax-pack-bound",
+        l2p_cap,
+        note=f"largest 128-aligned l2p with 2*{i8_max}*l2p*{radix} + "
+        f"{radix - 1} <= 2^31 - 1 (g_budget={g_budget})",
+    )
+    row(
+        "int32-packed-sentinel",
+        -int32_max,
+        note="largest-magnitude int32 whose negation is representable",
+    )
+    row(
+        "i8-feed-ceiling",
+        i8_max,
+        note="int8 dtype window",
+    )
+    bf16_exact = int(exact_window("bfloat16").hi)  # 256
+    row(
+        "bf16-feed-ceiling",
+        bf16_exact // 2,
+        note="largest max|v| with delta operand 2*max|v| inside bf16's "
+        "exact-integer window",
+    )
+    row(
+        "native-precision-ceiling",
+        bf16_exact // 2,
+        note="single-pass f32 MXU multiplies at bf16 precision: same "
+        "2*max|v| <= 2^8 bound",
+    )
+    row(
+        "rowpack-classes-static",
+        [
+            s
+            for s in (8, 16, 32, 64)
+            if 3 * s * _derive_max_exact(bucket_cap) < rowpack_limit
+        ],
+        note="classes admitted at the static weight ceiling, recomputed "
+        "from derived bounds",
+    )
+    # Congruence corollary: the packed argmax decode is lossless — the
+    # remainder field spans exactly [0, radix - 1].
+    g = _iv(-(2 * i8_max * l2p_cap), 2 * i8_max * l2p_cap)
+    packed = g.mul(_iv(radix, radix)).add(_iv(0, radix - 1))
+    rows.append(
+        {
+            "name": "pack-decode-lossless",
+            "derived": bool(
+                _iv(-(int32_max), int32_max).contains(packed)
+            ),
+            "wired": True,
+            "relation": "==",
+            "ok": bool(_iv(-(int32_max), int32_max).contains(packed)),
+            "note": f"g*{radix} + r, r in [0, {radix - 1}]: packed band "
+            f"[{packed.lo}, {packed.hi}] inside int32 and rem width "
+            "< stride, so // and & recover (g, r) exactly",
+        }
+    )
+
+    findings = [
+        RangeFinding(
+            "constant-drift",
+            f"derived_constants/{r['name']}",
+            f"derived {r['derived']!r} {r['relation']} wired {r['wired']!r} "
+            "does not hold — the wired constant drifted from its "
+            "machine-derived value",
+        )
+        for r in rows
+        if not r["ok"]
+    ]
+    return rows, findings
+
+
+# --------------------------------------------------------------------------
+# Cert assembly
+# --------------------------------------------------------------------------
+
+#: The int16 envelope the BLOSUM/PAM roadmap item needs: substitution
+#: matrices carry NEGATIVE entries, and int16 is the widest table the
+#: serialized weight format admits.
+SIGNED_ENVELOPE = (-32768, 32767)
+
+
+def audit_entry_ranges(buckets=None):
+    """Analyze every entry contract at every audit bucket under the
+    CERTIFIED weight envelope (max_exact_value(l2p)) — these rows must
+    prove exact."""
+    from ..ops import bounds as B
+    from .contracts import _AUDIT_BUCKETS, ENTRY_CONTRACTS
+
+    if buckets is None:
+        buckets = _AUDIT_BUCKETS
+    rows = []
+    findings = []
+    for contract in ENTRY_CONTRACTS:
+        for bucket in buckets:
+            b, nc, l1p, l2p = bucket
+            maxv = B.max_exact_value(l2p)
+            fn, args = contract.make(b, nc, l1p, l2p)
+            where = f"entry={contract.name}/bucket={b}x{nc}x{l1p}x{l2p}"
+            seeds = entry_seeds(args, l1p, l2p, -maxv, maxv)
+            res = analyze_entry(fn, args, seeds, where)
+            findings.extend(res.findings)
+            if res.verdict != "exact":
+                findings.append(
+                    RangeFinding(
+                        "exactness-regression",
+                        where,
+                        f"verdict {res.verdict!r} under the certified "
+                        f"envelope |v| <= {maxv}: float accumulator "
+                        f"{res.float_acc and [res.float_acc.lo, res.float_acc.hi]} "
+                        "must stay inside the f32 exact-integer window",
+                    )
+                )
+            rows.append(
+                {
+                    "entry": contract.name,
+                    "bucket": list(bucket),
+                    "envelope": f"certified|v|<={maxv}",
+                    "maxv": maxv,
+                    **res.to_dict(),
+                }
+            )
+    return rows, findings
+
+
+def audit_signed_entries(buckets=None):
+    """The signed_weights envelope rows: every entry analyzed under the
+    full int16 window.  Documentation, not a gate — ``survives`` is the
+    per-path answer ROADMAP item 4 needs."""
+    from .contracts import _AUDIT_BUCKETS, ENTRY_CONTRACTS
+
+    if buckets is None:
+        buckets = _AUDIT_BUCKETS
+    lo, hi = SIGNED_ENVELOPE
+    rows = []
+    for contract in ENTRY_CONTRACTS:
+        for bucket in buckets:
+            b, nc, l1p, l2p = bucket
+            fn, args = contract.make(b, nc, l1p, l2p)
+            where = (
+                f"signed/entry={contract.name}/bucket={b}x{nc}x{l1p}x{l2p}"
+            )
+            seeds = entry_seeds(args, l1p, l2p, lo, hi)
+            res = analyze_entry(fn, args, seeds, where)
+            rows.append(
+                {
+                    "entry": contract.name,
+                    "bucket": list(bucket),
+                    "envelope": f"signed[{lo},{hi}]",
+                    "survives": res.verdict == "exact"
+                    and not res.findings,
+                    **res.to_dict(),
+                }
+            )
+    return rows
+
+
+def signed_weight_paths():
+    """Static per-path signed-weight survival table, derived from the
+    certified ceilings (pure interval arithmetic, no jaxpr needed)."""
+    from ..ops import bounds as B
+    from ..ops.dispatch import pack_classes
+
+    lo, hi = SIGNED_ENVELOPE
+    amax = max(abs(lo), abs(hi))
+    rows = []
+    for l2p in (128, 2048):
+        ceil = B.max_exact_value(l2p)
+        rows.append(
+            {
+                "path": "mm-f32",
+                "l2p": l2p,
+                "survives": amax <= ceil,
+                "ceiling": ceil,
+                "note": "sign-symmetric: every bound is on |v|; the "
+                f"int16 envelope max |v| = {amax} vs ceiling {ceil}",
+            }
+        )
+    int32 = dtype_window("int32")
+    gather_acc = _iv(-amax, amax).scale_sum(_padded_bucket_cap())
+    rows.append(
+        {
+            "path": "xla-gather-int32",
+            "l2p": _padded_bucket_cap(),
+            "survives": int32.contains(gather_acc),
+            "ceiling": int(int32.hi // _padded_bucket_cap()),
+            "note": f"int32 prefix sums: |acc| <= {int(gather_acc.hi)} "
+            "< 2^31 — the gather path survives the full signed envelope",
+        }
+    )
+    for feed, ceil in (("i8", 127), ("bf16", 128)):
+        rows.append(
+            {
+                "path": f"pallas-{feed}",
+                "l2p": None,
+                "survives": amax <= ceil,
+                "ceiling": ceil,
+                "note": "feed threshold",
+            }
+        )
+    rows.append(
+        {
+            "path": "rowpack",
+            "l2p": 128,
+            "survives": bool(pack_classes("f32", amax)),
+            "ceiling": (B.ROWPACK_EPILOGUE_LIMIT // 3 - 1) // 8,
+            "note": "classes admitted at the signed envelope magnitude: "
+            f"{list(pack_classes('f32', amax))}",
+        }
+    )
+    return rows
+
+
+def audit_schedule_ranges(problem, backend: str = "pallas"):
+    """Analyze every resolved production-bucket body at its production
+    chunk shape under the problem's ACTUAL value-table envelope."""
+    import jax
+    import numpy as np
+
+    from ..ops.schedule import production_schedule
+    from ..ops.values import max_abs_value, value_table
+
+    _, sched = production_schedule(problem, backend)
+    val = value_table(problem.weights)
+    maxv = int(max_abs_value(np.asarray(val).reshape(-1)))
+    rows = []
+    findings = []
+    for i, part in enumerate(sched):
+        batch = part["batch"]
+        body = part["body"]
+        nc, cb, l2p = np.asarray(part["rows"]).shape
+        args = (
+            jax.ShapeDtypeStruct(
+                np.asarray(batch.seq1ext).shape,
+                np.asarray(batch.seq1ext).dtype,
+            ),
+            jax.ShapeDtypeStruct((), np.int32),
+            jax.ShapeDtypeStruct((1, cb, l2p), np.int32),
+            jax.ShapeDtypeStruct((1, cb), np.int32),
+            jax.ShapeDtypeStruct((27 * 27,), np.int32),
+        )
+        where = f"schedule[{i}]/l1p={batch.l1p}/l2p={batch.l2p}/cb={cb}"
+        seeds = entry_seeds(args, batch.l1p, batch.l2p, -maxv, maxv)
+        res = analyze_entry(body, args, seeds, where)
+        findings.extend(res.findings)
+        if res.verdict != "exact":
+            findings.append(
+                RangeFinding(
+                    "exactness-regression",
+                    where,
+                    f"production bucket verdict {res.verdict!r} at the "
+                    f"problem's actual envelope |v| <= {maxv}",
+                )
+            )
+        rows.append(
+            {
+                "bucket": i,
+                "l1p": int(batch.l1p),
+                "l2p": int(batch.l2p),
+                "cb": int(cb),
+                "maxv": maxv,
+                **res.to_dict(),
+            }
+        )
+    return rows, findings
+
+
+def build_cert(problem=None, backend: str = "pallas") -> dict:
+    """Assemble the full RangeCert body (JSON-ready dict)."""
+    const_rows, const_findings = derive_constants()
+    entry_rows, entry_findings = audit_entry_ranges()
+    signed_rows = audit_signed_entries()
+    path_rows = signed_weight_paths()
+    sched_rows: list = []
+    sched_findings: list = []
+    if problem is not None:
+        sched_rows, sched_findings = audit_schedule_ranges(problem, backend)
+
+    findings = [
+        f.to_dict() for f in (*const_findings, *entry_findings, *sched_findings)
+    ]
+    f32w = exact_window("float32")
+    return {
+        "engine": {
+            "domain": "interval+sentinel+onehot+congruence",
+            "sentinel_floor": _SENTINEL_FLOOR,
+            "max_trip_unroll": _MAX_TRIP_UNROLL,
+        },
+        "windows": {
+            "f32_exact": [int(f32w.lo), int(f32w.hi)],
+            "int32": [
+                int(dtype_window("int32").lo),
+                int(dtype_window("int32").hi),
+            ],
+        },
+        "derived_constants": const_rows,
+        "entries": entry_rows,
+        "production": sched_rows,
+        "signed_weights": {"entries": signed_rows, "paths": path_rows},
+        "findings": findings,
+        "counts": {
+            "constants": len(const_rows),
+            "constants_ok": sum(1 for r in const_rows if r["ok"]),
+            "entries": len(entry_rows),
+            "entries_exact": sum(
+                1 for r in entry_rows if r["verdict"] == "exact"
+            ),
+            "production_buckets": len(sched_rows),
+            "signed_survivors": sum(
+                1 for r in signed_rows if r["survives"]
+            ),
+            "findings": len(findings),
+        },
+    }
+
+
+def run_or_raise(problem=None, backend: str = "pallas") -> dict:
+    """Build the cert and raise :class:`RangeCertError` on any finding —
+    the ``make analyze`` / CI entry point."""
+    cert = build_cert(problem=problem, backend=backend)
+    if cert["findings"]:
+        head = cert["findings"][:8]
+        lines = "; ".join(
+            f"[{f['kind']}] {f['where']}: {f['detail']}" for f in head
+        )
+        more = len(cert["findings"]) - len(head)
+        raise RangeCertError(
+            f"value-range certification failed with "
+            f"{len(cert['findings'])} finding(s): {lines}"
+            + (f" (+{more} more)" if more else "")
+        )
+    return cert

@@ -78,6 +78,16 @@ SEQ012   raw ``jax.lax`` collectives (``psum`` / ``ppermute`` /
          call must pass an explicit ``axis_name=`` keyword: a
          positional or implicit axis evades the audit's axis-resolution
          check and is exactly how an unregistered-axis hazard ships.
+SEQ013   every numeric-bound literal in traced gate/kernel code (the
+         certified overflow constants: ``4095``, ``32767``, ``65535``,
+         ``4096``, ``2**19``, ``2**24``, ``2**31`` and their
+         ``1 << N`` spellings) carries a ``# cert: <row>`` marker
+         naming the RangeCert ``derived_constants`` row that proves it
+         (``make ranges-audit``).  A bare ``# cert:`` with no row name
+         documents nothing and stays a finding.  An unmarked bound is
+         exactly the "hand-derived once, asserted forever" constant
+         the value-range certifier (``analysis/ranges.py``) exists to
+         retire — wire it through ``ops/bounds.py`` or name its proof.
 =======  ==================================================================
 
 Suppression: append ``# seqlint: disable=SEQ00N`` to the offending line
@@ -165,6 +175,11 @@ _MODULE_CLASSES: dict[str, tuple[str, ...]] = {
     # SEQ012's routing rule protects — the pass and the rule land
     # together; it WALKS collectives, it never issues one).
     "analysis/collectives.py": (ROLE_HOST,),
+    # The value-range certifier: host-side abstract interpretation over
+    # the scoring jaxprs (explicit row because its derived_constants
+    # rows are what SEQ013's `# cert:` markers must name — the pass and
+    # the rule land together; it PROVES bounds, it never gates on one).
+    "analysis/ranges.py": (ROLE_HOST,),
     # -- directory defaults ------------------------------------------------
     # The AOT warm plane is host-side orchestration whose diagnostics
     # ride the event bus; its timers (compile walls) are measurements,
@@ -259,6 +274,25 @@ _SUPPRESS_FILE_RE = re.compile(r"#\s*seqlint:\s*disable-file=([A-Z0-9, ]+)")
 #: SEQ011's explicit opt-out: the marker must carry a non-empty reason
 #: (a bare ``# nodonate:`` documents nothing and stays a finding).
 _NODONATE_RE = re.compile(r"#\s*nodonate:\s*(\S.*)?$")
+
+#: SEQ013's proof marker: must name a RangeCert ``derived_constants``
+#: row (a bare ``# cert:`` proves nothing and stays a finding).
+_CERT_RE = re.compile(r"#\s*cert:\s*(\S+)?")
+
+#: SEQ013's certified numeric-bound set — every hand overflow constant
+#: the value-range certifier re-derives (analysis/ranges.py
+#: derive_constants; keep in sync).  ``2**N`` / ``1 << N`` spellings of
+#: these values match too.
+_CERT_LITERALS = {
+    4095,  # static-weight-ceiling (max_exact_value at the padded cap)
+    4096,  # argmax-pack-radix (2^12)
+    32767,  # operand-cap (HIGHEST matmul 16-mantissa-bit operand)
+    65535,  # operand-cap's 2^16 - 1 numerator
+    524288,  # rowpack-epilogue-limit (2^19)
+    16777216,  # f32-exact-window (2^24)
+    2147483647,  # argmax-pack-bound / int32-packed-sentinel (2^31 - 1)
+    2147483648,  # 2^31 itself (the 2**31 - 1 spelling's inner literal)
+}
 
 
 @dataclasses.dataclass(frozen=True)
@@ -415,7 +449,77 @@ class _Linter(ast.NodeVisitor):
             )
         for stmt in node.body:
             self._check_jit_donation(stmt)
+        if self.in_traced_dir:
+            self._scan_cert_literals(node, None)
         self.generic_visit(node)
+
+    # -- SEQ013: numeric-bound literals name their cert row ----------------
+
+    @staticmethod
+    def _cert_literal_value(node: ast.AST) -> int | None:
+        """The certified-bound value this expression spells, else None:
+        a plain int literal, ``B ** N`` or ``B << N`` of literals."""
+        if isinstance(node, ast.Constant):
+            v = node.value
+            if isinstance(v, int) and not isinstance(v, bool):
+                return v if v in _CERT_LITERALS else None
+            return None
+        if (
+            isinstance(node, ast.BinOp)
+            and isinstance(node.op, (ast.Pow, ast.LShift))
+            and isinstance(node.left, ast.Constant)
+            and isinstance(node.right, ast.Constant)
+            and isinstance(node.left.value, int)
+            and isinstance(node.right.value, int)
+            and 0 <= node.right.value <= 64
+        ):
+            v = (
+                node.left.value**node.right.value
+                if isinstance(node.op, ast.Pow)
+                else node.left.value << node.right.value
+            )
+            return v if v in _CERT_LITERALS else None
+        return None
+
+    def _scan_cert_literals(self, node: ast.AST, stmt: ast.stmt | None):
+        """Walk the tree tracking the smallest enclosing statement; any
+        certified-bound literal must find a named ``# cert:`` marker on
+        one of that statement's source lines (SEQ013)."""
+        if isinstance(node, ast.stmt):
+            stmt = node
+        val = None if stmt is None else self._cert_literal_value(node)
+        if val is not None:
+            self._check_cert_marker(stmt, node, val)
+            return  # the spelled value is claimed; 2/31 inside 2**31
+            # are not independent bounds, and the statement's marker
+            # check already ran once for this literal
+        for child in ast.iter_child_nodes(node):
+            self._scan_cert_literals(child, stmt)
+
+    def _check_cert_marker(self, stmt: ast.stmt, node: ast.AST, val: int):
+        end = getattr(stmt, "end_lineno", stmt.lineno)
+        for text in self._lines[stmt.lineno - 1 : end]:
+            m = _CERT_RE.search(text)
+            if m is None:
+                continue
+            if m.group(1):
+                return  # named marker: the bound cites its proof row
+            self._emit(
+                "SEQ013",
+                node,
+                f"bare `# cert:` marker on numeric bound {val} names no "
+                "RangeCert row — cite the derived_constants row that "
+                "proves it (make ranges-audit; see ops/bounds.py)",
+            )
+            return
+        self._emit(
+            "SEQ013",
+            node,
+            f"numeric overflow bound {val} in traced gate/kernel code "
+            "carries no `# cert: <row>` marker; wire it through "
+            "ops/bounds.py or name the RangeCert derived_constants row "
+            "that proves it (analysis/ranges.py, make ranges-audit)",
+        )
 
     # -- SEQ011: module-level jit entries declare donation -----------------
 

@@ -636,6 +636,80 @@ def validate_report(rec) -> None:
                     "undonated_large_buffers and a pinned_live list, "
                     f"got {don!r}"
                 )
+    elif kind == "ranges-audit":
+        # scripts/ranges_audit.py's value-range certification report.
+        consts = rec.get("derived_constants")
+        if not isinstance(consts, list) or not consts:
+            problems.append(
+                f"derived_constants: want a non-empty list, got {consts!r}"
+            )
+        else:
+            for i, c in enumerate(consts):
+                if (
+                    not isinstance(c, dict)
+                    or not isinstance(c.get("name"), str)
+                    or not isinstance(c.get("relation"), str)
+                    or not isinstance(c.get("ok"), bool)
+                ):
+                    problems.append(
+                        f"derived_constants[{i}]: want name/relation strs "
+                        f"plus an ok bool, got {c!r}"
+                    )
+        entries = rec.get("entries")
+        if not isinstance(entries, list) or not entries:
+            problems.append(
+                f"entries: want a non-empty list, got {entries!r}"
+            )
+        else:
+            for i, e in enumerate(entries):
+                if (
+                    not isinstance(e, dict)
+                    or not isinstance(e.get("entry"), str)
+                    or e.get("verdict")
+                    not in ("exact", "representable", "unproven")
+                    or not isinstance(e.get("findings"), list)
+                ):
+                    problems.append(
+                        f"entries[{i}]: want entry str, verdict in "
+                        "exact/representable/unproven, a findings list, "
+                        f"got {e!r}"
+                    )
+        if not isinstance(rec.get("production"), list):
+            problems.append(
+                f"production: want a list, got {rec.get('production')!r}"
+            )
+        signed = rec.get("signed_weights")
+        if (
+            not isinstance(signed, dict)
+            or not isinstance(signed.get("entries"), list)
+            or not isinstance(signed.get("paths"), list)
+        ):
+            problems.append(
+                "signed_weights: want an object with entries/paths "
+                f"lists, got {signed!r}"
+            )
+        if not isinstance(rec.get("findings"), list):
+            problems.append(
+                f"findings: want a list, got {rec.get('findings')!r}"
+            )
+        counts = rec.get("counts")
+        if not isinstance(counts, dict) or not all(
+            isinstance(counts.get(k), int)
+            for k in (
+                "constants",
+                "constants_ok",
+                "entries",
+                "entries_exact",
+                "production_buckets",
+                "signed_survivors",
+                "findings",
+            )
+        ):
+            problems.append(
+                "counts: want constants/constants_ok/entries/"
+                "entries_exact/production_buckets/signed_survivors/"
+                f"findings ints, got {counts!r}"
+            )
     elif kind == "comms-audit":
         # scripts/comms_audit.py's collective-safety & comms-cost report.
         entries = rec.get("entries")

@@ -1,0 +1,103 @@
+"""Cert-backed numeric bounds: the single source of every overflow gate.
+
+Every ceiling the dispatch/kernel layers quote — the f32 exact-integer
+window, the HIGHEST-matmul operand cap, the rowpack epilogue limit, the
+packed-argmax radix and int32 ceiling — lives HERE, and nowhere else.
+The value-range certifier (``analysis/ranges.py``, ``make
+ranges-audit``) re-derives each one from first principles with its
+interval engine and diffs the derivation against these wired values:
+drift between a bound and its proof is a typed finding, so a constant
+can no longer be "hand-derived once, asserted forever".
+
+Each literal below carries a ``# cert: <row>`` marker naming the
+RangeCert ``derived_constants`` row that proves it (seqlint SEQ013
+enforces the markers on every numeric-bound literal in ops/ code).
+"""
+
+from __future__ import annotations
+
+from ..utils.constants import BUF_SIZE_SEQ2
+
+# float32 carries 24 mantissa bits: every integer of magnitude below
+# 2^24 is exactly representable, so f32 adds/accumulations of in-window
+# integers are exact.  Everything the mm path and the f32/bf16 pallas
+# feeds promise rests on keeping accumulators inside this window.
+F32_EXACT_WINDOW = 16777216  # = 2^24  # cert: f32-exact-window
+
+# The multi-pass Precision.HIGHEST matmul resolves operands of up to 16
+# mantissa bits exactly; the live operand of the delta formulation is
+# |d0 - d1| <= 2 * max|v|, capping |v| at 32767 regardless of length.
+MAX_HIGHEST_OPERAND = 65535  # = 2^16 - 1  # cert: operand-cap
+OPERAND_CAP = MAX_HIGHEST_OPERAND // 2  # 32767  # cert: operand-cap
+
+# Packed-argmax encoding (i8 feed): one int32 carries (g, kappa) as
+# g * PACK_RADIX + (PACK_RADIX - 1 - kappa).  The radix is the smallest
+# power of two that fields every kappa in a BUF_SIZE_SEQ2-capped bucket
+# (kappa <= l2p <= 2048 < 4096), and the whole pack must stay inside
+# int32: |g| * PACK_RADIX + (PACK_RADIX - 1) <= INT32_PACK_CEILING.
+PACK_RADIX = 4096  # = 2^12  # cert: argmax-pack-radix
+INT32_PACK_CEILING = 2147483647  # = 2^31 - 1  # cert: argmax-pack-bound
+
+# Largest Seq2 bucket width the i8 packed-argmax path admits: with
+# |g| <= 2 * 127 * l2p the pack fits int32 exactly up to the
+# BUF_SIZE_SEQ2 bucket ceiling (520192 * 4096 + 4095 < 2^31); wider
+# (ring long-context) buckets keep the unpacked path.
+PACKED_L2P_CEILING = 2048  # cert: argmax-pack-bound
+
+# Packed rowpack epilogue: spack = (t1 + gdec) * 2^klb + key with
+# klb <= SUPERBLOCK_KEY_BITS, so the packed score magnitude
+# 3 * l2s * max|v| must stay below 2^(31 - 12) = 2^19 for the int32
+# pack to be exact (dispatch.pack_classes is gated on this).
+SUPERBLOCK_KEY_BITS = 12  # cert: superblock-key-budget
+ROWPACK_EPILOGUE_LIMIT = 524288  # = 2^19  # cert: rowpack-epilogue-limit
+
+# Offset-super-block cap: sbw - 1 = sb * 128 - 1 must fit the klb <= 12
+# key field.  The derived admissible maximum is 32 (4096 lanes); the
+# wired chooser cap stays 24 — the measured perf plateau — which the
+# cert checks as wired <= derived, not equality.
+SUPERBLOCK_CAP = 24  # cert: superblock-key-budget
+
+# Weight magnitudes up to this keep every partial sum an exact float32
+# integer at ANY in-cap Seq2 length: max_exact_value() at the padded
+# BUF_SIZE_SEQ2 buffer (2 * 2048 * 4095 < 2^24).
+MAX_EXACT_WEIGHT = 4095  # cert: static-weight-ceiling
+
+# Out-of-band floor for packed int32 comparisons: the largest-magnitude
+# int32 whose negation is still representable, so masked lanes sort
+# below every real pack without overflowing on negation.
+INT32_PACKED_SENTINEL = -2147483647  # = -(2^31 - 1)  # cert: int32-packed-sentinel
+
+
+def max_exact_value(l2p: int | None = None) -> int:
+    """Largest |table value| for which the f32 delta formulation is exact
+    when each scored row spans at most ``l2p`` Seq2 positions.
+
+    Two binding constraints (r6, length-aware; the static 4095 ceiling is
+    exactly this bound at the padded BUF_SIZE_SEQ2 cap):
+
+    * accumulation — every partial of ``G = prefix(d0 - d1)`` is an
+      integer bounded by ``2 * l2p * max|v|``, which must stay < 2^24 for
+      the f32 adds (MXU accumulators and VPU epilogue alike) to be exact;
+    * operand — each ``|d0 - d1| <= 2 * max|v|`` must fit the 16 mantissa
+      bits the HIGHEST multi-pass matmul resolves, capping max|v| at
+      :data:`OPERAND_CAP` regardless of length.
+
+    ``l2p=None`` gives the conservative static bound for callers that do
+    not know the batch shape yet.  Shared by the mm path and the fused
+    Pallas kernel's f32 feed — both accumulate the same delta prefixes.
+    """
+    if l2p is None:
+        l2p = ((BUF_SIZE_SEQ2 + 127) // 128) * 128
+    l2p = max(int(l2p), 1)
+    return min((F32_EXACT_WINDOW - 1) // (2 * l2p), OPERAND_CAP)
+
+
+def fits_exact_window(val_flat, l2p: int | None = None) -> bool:
+    """True when every partial sum of the f32 delta formulation stays an
+    exact float32 integer for this value table at this Seq2 bucket width
+    (``l2p=None`` = the conservative whole-buffer bound).  The dispatch
+    gate formerly known as ``mm_formulation_exact`` — now consuming the
+    certified ceiling instead of re-deriving it locally."""
+    from .values import max_abs_value
+
+    return max_abs_value(val_flat) <= max_exact_value(l2p)

@@ -24,6 +24,7 @@ from ..obs.spans import fence as _obs_fence, span as _obs_span
 from ..resilience.faults import fire as _fault
 from ..resilience.watchdog import guard as _deadline_guard
 from ..utils.constants import ALPHABET_SIZE, BUF_SIZE_SEQ1, BUF_SIZE_SEQ2
+from .bounds import fits_exact_window  # noqa: F401 - re-exported certified gate
 from .oracle import score_batch_oracle
 from .values import value_table
 
@@ -184,14 +185,14 @@ def resolve_auto_backend() -> str:
 def mm_formulation_exact(val_flat: np.ndarray, l2p: int | None = None) -> bool:
     """True when every partial sum stays an exact float32 integer on the
     matmul path.  Length-aware (r6): with a concrete batch ``l2p`` the
-    bound is ``2 * l2p * max|value| < 2^24`` (operand-capped at 32767 —
-    see matmul_scorer.max_exact_value), so short-Seq2 buckets keep the
-    exact path far past the static 4095 ceiling; ``l2p=None`` is the
-    conservative whole-buffer bound."""
-    from .matmul_scorer import max_exact_value
-    from .values import max_abs_value
+    bound is ``2 * l2p * max|value| < 2^24`` (operand-capped — see
+    ops/bounds.py), so short-Seq2 buckets keep the exact path far past
+    the static ceiling; ``l2p=None`` is the conservative whole-buffer
+    bound.  Alias of :func:`ops.bounds.fits_exact_window` — the ceiling
+    lives in the cert-backed bounds module, not here."""
+    from .bounds import fits_exact_window
 
-    return max_abs_value(val_flat) <= max_exact_value(l2p)
+    return fits_exact_window(val_flat, l2p)
 
 
 def choose_pallas_formulation(
@@ -259,13 +260,18 @@ def pack_classes(feed: str, maxv: int | None = None) -> tuple[int, ...]:
     magnitude ``3 * l2s * max|v|`` must stay < 2^19.  i8 (|v| <= 127)
     passes at every class by construction; bf16 (|v| <= 128) likewise
     (3*64*128 < 2^19); the f32 feed keeps the classes its actual weight
-    magnitude affords — {8, 16, 32} at the static 4095 ceiling, shrinking
-    to none near the 32767 operand cap.  ``maxv=None`` is conservative
-    for non-i8 feeds (unknown weights -> no packing)."""
+    magnitude affords — {8, 16, 32} at the static ceiling, shrinking to
+    none near the operand cap.  ``maxv=None`` is conservative for non-i8
+    feeds (unknown weights -> no packing).  The 2^19 ceiling is imported
+    from the cert-backed bounds module, never inlined here."""
+    from .bounds import ROWPACK_EPILOGUE_LIMIT
+
     if feed == "i8":
         return (8, 16, 32, 64)
     if feed in ("bf16", "f32") and maxv is not None:
-        return tuple(s for s in (8, 16, 32, 64) if 3 * s * int(maxv) < 2**19)
+        return tuple(
+            s for s in (8, 16, 32, 64) if 3 * s * int(maxv) < ROWPACK_EPILOGUE_LIMIT
+        )
     return ()
 
 

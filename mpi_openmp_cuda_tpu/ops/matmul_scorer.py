@@ -45,43 +45,13 @@ import jax.numpy as jnp
 from jax import lax
 
 from ..utils.constants import ALPHABET_SIZE, BUF_SIZE_SEQ2, INT32_MIN
+from .bounds import (  # noqa: F401 - re-exported public API
+    MAX_EXACT_WEIGHT,
+    MAX_HIGHEST_OPERAND as _MAX_HIGHEST_OPERAND,
+    max_exact_value,
+)
 
 _NEG = jnp.float32(-(2.0**40))
-
-# Weight magnitudes up to this keep every partial sum an exact float32
-# integer at ANY in-cap Seq2 length: the static worst case of
-# max_exact_value() over the padded BUF_SIZE_SEQ2 buffer
-# (2 * 2048 * 4095 < 2^24).
-MAX_EXACT_WEIGHT = 4095
-
-# The multi-pass HIGHEST matmul guarantee this module relies on resolves
-# operands of up to 16 mantissa bits exactly; the live operand is the
-# delta |d0 - d1| <= 2 * max|v|.
-_MAX_HIGHEST_OPERAND = 2**16 - 1
-
-
-def max_exact_value(l2p: int | None = None) -> int:
-    """Largest |table value| for which the f32 delta formulation is exact
-    when each scored row spans at most ``l2p`` Seq2 positions.
-
-    Two binding constraints (r6, length-aware; the static 4095 ceiling is
-    exactly this bound at the padded BUF_SIZE_SEQ2 cap):
-
-    * accumulation — every partial of ``G = prefix(d0 - d1)`` is an
-      integer bounded by ``2 * l2p * max|v|``, which must stay < 2^24 for
-      the f32 adds (MXU accumulators and VPU epilogue alike) to be exact;
-    * operand — each ``|d0 - d1| <= 2 * max|v|`` must fit the 16 mantissa
-      bits the HIGHEST multi-pass matmul resolves, capping max|v| at
-      32767 regardless of length.
-
-    ``l2p=None`` gives the conservative static bound for callers that do
-    not know the batch shape yet.  Shared by the mm path and the fused
-    Pallas kernel's f32 feed — both accumulate the same delta prefixes.
-    """
-    if l2p is None:
-        l2p = ((BUF_SIZE_SEQ2 + 127) // 128) * 128
-    l2p = max(int(l2p), 1)
-    return min((2**24 - 1) // (2 * l2p), _MAX_HIGHEST_OPERAND // 2)
 
 # Up to this bound the MXU's DEFAULT f32 precision (single-pass bf16
 # multiplies) is already exact: one operand is 0/1 and |d0-d1| <= 2*128

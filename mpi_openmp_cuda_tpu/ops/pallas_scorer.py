@@ -154,6 +154,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from ..utils.constants import ALPHABET_SIZE, INT32_MIN
+from .bounds import INT32_PACKED_SENTINEL, PACK_RADIX, PACKED_L2P_CEILING
 
 _BLK = 128
 # Plain Python scalars: jnp scalars would be captured as pallas kernel
@@ -586,8 +587,8 @@ def _pair(
     # |g| <= l2p * 254 and kappa <= l2p fit: |pack| <= 520192 * 4096 +
     # 4095 < 2^31 for l2p <= 2048 — the BUF_SIZE_SEQ2 bucket ceiling;
     # wider (ring long-context) buckets keep the unpacked path.
-    packed = feed == "i8" and nbi * _BLK <= 2048
-    _KB = 4096
+    packed = feed == "i8" and nbi * _BLK <= PACKED_L2P_CEILING
+    _KB = PACK_RADIX
     sbw = sb * _BLK  # offset lanes per super-block
 
     ri1 = lax.broadcasted_iota(jnp.int32, (_BLK, _BLK), 0)
@@ -802,7 +803,7 @@ def _pair(
         zeros = jnp.zeros((sbw,), sc_t)
         init = (
             zeros,
-            jnp.full((sbw,), -(2**31 - 1) if packed else neg, sc_t),
+            jnp.full((sbw,), INT32_PACKED_SENTINEL if packed else neg, sc_t),
             jnp.zeros((sbw,), jnp.int32),
             zeros,
         )
@@ -873,7 +874,7 @@ def _pair(
             spack = jnp.where(
                 nvec < len1 - l2,
                 sv[None, :] * (1 << klb) + liw,
-                jnp.int32(-(2**31 - 1)),
+                jnp.int32(INT32_PACKED_SENTINEL),
             )
             best = jnp.max(spack, axis=1, keepdims=True)  # [1, 1]
             mstar = best & ((1 << klb) - 1)
@@ -883,7 +884,7 @@ def _pair(
             # as a plausible int32 score — the ring combine's all-invalid
             # guard tests against _NEG (ADVICE r3).
             sbbest = jnp.where(
-                best == jnp.int32(-(2**31 - 1)),
+                best == jnp.int32(INT32_PACKED_SENTINEL),
                 jnp.float32(_NEG),
                 (best >> klb).astype(jnp.float32),
             )
@@ -1121,9 +1122,9 @@ def _kernel_packed(
     p = _BLK // l2s
     sbw = sb * _BLK
     W = sbw + _BLK
-    _KB = 4096
+    _KB = PACK_RADIX
     klb = max((sbw - 1).bit_length(), 1)
-    neg32 = jnp.int32(-(2**31 - 1))
+    neg32 = jnp.int32(INT32_PACKED_SENTINEL)
     len1 = meta_ref[0]
     l2 = [meta_ref[1 + pl.program_id(0) * p + j] for j in range(p)]
     # Block-skip gate: a later super-block is dead when n0 >= len1 - l2

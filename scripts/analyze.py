@@ -1,7 +1,7 @@
 #!/usr/bin/env python3
 """`make analyze` driver: run the full static-analysis gate on CPU.
 
-Ten analysis passes plus optional tooling (docs/ARCHITECTURE.md §9),
+Eleven analysis passes plus optional tooling (docs/ARCHITECTURE.md §9),
 in cheapest-first order so the common failure (a lint regression)
 reports before jax even imports:
 
@@ -40,7 +40,12 @@ reports before jax even imports:
                     hygiene, and cross-check the ring against ring_plan
                     (analysis/collectives.py; golden drift gating lives
                     in scripts/comms_audit.py).
-10. ruff / mypy   — only when installed (the container may not ship
+10. ranges        — value-range certification: abstract interpretation
+                    over every scoring jaxpr re-deriving every hand
+                    numeric bound and proving every accumulator inside
+                    its exactness window (analysis/ranges.py; golden
+                    drift gating lives in scripts/ranges_audit.py).
+11. ruff / mypy   — only when installed (the container may not ship
                     them); the baselines live in pyproject.toml.
 
 EVERY pass runs regardless of earlier failures — an unexpected crash in
@@ -252,6 +257,41 @@ def _pass_collectives() -> str:
     )
 
 
+def _pass_ranges() -> str:
+    from mpi_openmp_cuda_tpu.analysis.ranges import run_or_raise
+    from mpi_openmp_cuda_tpu.models.workload import input3_class_problem
+
+    cert = run_or_raise(input3_class_problem(), "pallas")
+    counts = cert["counts"]
+    for c in cert["derived_constants"]:
+        print(
+            f"  const {c['name']}: derived={c['derived']} "
+            f"{c['relation']} wired={c['wired']} [ok]"
+        )
+    for e in cert["entries"]:
+        acc = e.get("float_acc") or e.get("int_acc")
+        print(
+            f"  {e['entry']:<45s} bucket={str(tuple(e['bucket'])):<22s} "
+            f"|v|<={e['maxv']} {e['verdict']} acc={acc}"
+        )
+    for p in cert["production"]:
+        print(
+            f"  production bucket[{p['bucket']}] l2p={p['l2p']} "
+            f"|v|<={p['maxv']}: {p['verdict']}"
+        )
+    print(
+        f"clean: {counts['constants_ok']}/{counts['constants']} constants "
+        f"match, {counts['entries_exact']}/{counts['entries']} entry rows "
+        f"exact, {counts['production_buckets']} production buckets, "
+        f"{counts['signed_survivors']} signed-envelope survivors, "
+        f"0 findings"
+    )
+    return (
+        f"{counts['constants']} constants re-derived, "
+        f"{counts['entries_exact']}/{counts['entries']} exact, 0 findings"
+    )
+
+
 PASSES = [
     ("seqlint", _pass_seqlint),
     ("lock graph", _pass_lockgraph),
@@ -262,6 +302,7 @@ PASSES = [
     ("trace audit", _pass_traceaudit),
     ("interleave", _pass_interleave),
     ("collectives", _pass_collectives),
+    ("ranges", _pass_ranges),
     ("ruff", _tool_pass("ruff", ["ruff", "check", "mpi_openmp_cuda_tpu"])),
     ("mypy", _tool_pass("mypy", ["mypy", "mpi_openmp_cuda_tpu"])),
 ]

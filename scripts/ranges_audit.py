@@ -1,0 +1,302 @@
+#!/usr/bin/env python3
+"""`make ranges-audit` driver: the numeric exactness certifier on CPU.
+
+One pass over the live tree, deterministic, golden-pinned
+(``analysis/ranges.py``): abstract interpretation in an interval
+domain (one-hot / congruence / sentinel-band refinements,
+widening-to-fixpoint loops, ``pallas_call`` kernel recursion) over
+
+1. **Derived constants** — every hand numeric bound in
+   ``ops/bounds.py`` and the kernel gates (``max_exact_value``, the
+   2^19 rowpack epilogue limit, the 2^31 argmax packing bound, the
+   i8/bf16 feed ceilings) is re-derived by the engine and diffed
+   against its wired source value; drift is a finding.
+2. **Entry certification** — all five registered scorer entry
+   contracts at three bucket shapes each, seeded from the contracts'
+   input envelopes at the CERTIFIED weight ceiling; every row must
+   prove ``exact`` (all float accumulators inside +/-2^24, every
+   intermediate inside its dtype window, no unknown primitives).
+3. **Production buckets** — every resolved production-schedule body at
+   its real chunk shape under the problem's ACTUAL value-table
+   envelope.
+4. **Signed-weight envelopes** — the same entries re-analyzed at the
+   full int16 envelope [-32768, 32767] (the BLOSUM/PAM prerequisite),
+   recorded as survives/fails per path, never as a failure.
+
+The committed golden (``tests/golden/ranges_cert.json``) pins the
+whole cert: every derived constant with its wired value, every entry
+verdict with its proved accumulator interval, and the signed-envelope
+survival map — so a kernel change that widens an accumulator (however
+harmless it looks) must be re-proved and committed.
+
+Exit 0 iff the cert has zero findings, every constant matches, every
+certified row is exact, the report is schema-valid, and nothing
+drifted from the golden.  CPU-only, zero devices, a few seconds.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+# Force the CPU backend BEFORE jax initialises (the certifier lowers
+# the real entry points; same idiom as analyze.py).
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+GOLDEN_PATH = os.path.join(REPO, "tests", "golden", "ranges_cert.json")
+
+
+def build_report() -> dict:
+    """The full enveloped range-certification report."""
+    from mpi_openmp_cuda_tpu.analysis import RangeCertError
+    from mpi_openmp_cuda_tpu.analysis.ranges import build_cert
+    from mpi_openmp_cuda_tpu.models.workload import input3_class_problem
+    from mpi_openmp_cuda_tpu.obs.metrics import wrap_report
+
+    try:
+        body = build_cert(input3_class_problem(), "pallas")
+    except RangeCertError as exc:
+        # The certifier itself failed closed (a jaxpr would not trace,
+        # or the eqn budget blew) — surface it as a report the schema
+        # still accepts, so CI uploads evidence instead of a stack.
+        body = {
+            "engine": {"domain": "interval", "error": str(exc)},
+            "windows": {},
+            "derived_constants": [
+                {
+                    "name": "engine",
+                    "derived": None,
+                    "wired": None,
+                    "relation": "==",
+                    "ok": False,
+                    "note": str(exc),
+                }
+            ],
+            "entries": [
+                {
+                    "entry": "engine",
+                    "verdict": "unproven",
+                    "findings": [],
+                }
+            ],
+            "production": [],
+            "signed_weights": {"entries": [], "paths": []},
+            "findings": [
+                {"kind": "engine-error", "where": "build_cert", "detail": str(exc)}
+            ],
+            "counts": {
+                "constants": 1,
+                "constants_ok": 0,
+                "entries": 1,
+                "entries_exact": 0,
+                "production_buckets": 0,
+                "signed_survivors": 0,
+                "findings": 1,
+            },
+        }
+    return wrap_report("ranges-audit", body)
+
+
+def golden_view(report: dict) -> dict:
+    """The drift-gated subset: every derived constant with its wired
+    source value, every certified row's verdict and proved accumulator
+    interval, the production verdicts, and the signed-envelope survival
+    map — static facts of the tree, no walls."""
+    return {
+        "derived_constants": [
+            {
+                "name": c["name"],
+                "derived": c["derived"],
+                "wired": c["wired"],
+                "relation": c["relation"],
+                "ok": c["ok"],
+            }
+            for c in report["derived_constants"]
+        ],
+        "entries": [
+            {
+                "entry": e["entry"],
+                "bucket": list(e.get("bucket") or []),
+                "maxv": e.get("maxv"),
+                "verdict": e["verdict"],
+                "float_acc": e.get("float_acc"),
+                "int_acc": e.get("int_acc"),
+            }
+            for e in report["entries"]
+        ],
+        "production": [
+            {
+                "bucket": p["bucket"],
+                "l2p": p["l2p"],
+                "verdict": p["verdict"],
+                "float_acc": p.get("float_acc"),
+                "int_acc": p.get("int_acc"),
+            }
+            for p in report["production"]
+        ],
+        "signed_weights": {
+            "entries": [
+                {
+                    "entry": s["entry"],
+                    "bucket": list(s.get("bucket") or []),
+                    "survives": s["survives"],
+                    "verdict": s["verdict"],
+                }
+                for s in report["signed_weights"]["entries"]
+            ],
+            "paths": [
+                {
+                    "path": p["path"],
+                    "l2p": p["l2p"],
+                    "survives": p["survives"],
+                    "ceiling": p["ceiling"],
+                }
+                for p in report["signed_weights"]["paths"]
+            ],
+        },
+        "findings": len(report["findings"]),
+        "counts": dict(report["counts"]),
+    }
+
+
+def diff_views(want: dict, got: dict) -> list[str]:
+    """Field-by-field drift rows (empty = match)."""
+    rows: list[str] = []
+    for key in sorted(set(want) | set(got)):
+        w, g = want.get(key), got.get(key)
+        if w != g:
+            rows.append(f"  {key}: golden {json.dumps(w)} != got {json.dumps(g)}")
+    return rows
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument(
+        "--update",
+        action="store_true",
+        help="rewrite the committed golden baseline from this run "
+        "(commit it together with the change that explains the drift)",
+    )
+    parser.add_argument(
+        "--out",
+        default=None,
+        help="also write the full enveloped report JSON to this path "
+        "(CI uploads it as the failure artifact)",
+    )
+    args = parser.parse_args()
+
+    from mpi_openmp_cuda_tpu.obs.metrics import validate_report
+
+    report = build_report()
+    failed = False
+
+    print("== schema ==")
+    try:
+        validate_report(report)
+        print("valid: kind=ranges-audit")
+    except ValueError as exc:
+        print(f"FAIL: {exc}")
+        failed = True
+
+    print("\n== derived constants ==")
+    for c in report["derived_constants"]:
+        mark = "ok" if c["ok"] else "DRIFT"
+        print(
+            f"  {c['name']}: derived={c['derived']} "
+            f"{c['relation']} wired={c['wired']} [{mark}]"
+        )
+        if not c["ok"]:
+            failed = True
+
+    print("\n== certified entries ==")
+    for e in report["entries"]:
+        acc = e.get("float_acc") or e.get("int_acc")
+        print(
+            f"  {e['entry']} {tuple(e.get('bucket') or ())} "
+            f"|v|<={e.get('maxv')}: {e['verdict']} acc={acc}"
+        )
+        if e["verdict"] != "exact":
+            failed = True
+
+    print("\n== production buckets ==")
+    for p in report["production"]:
+        print(
+            f"  bucket[{p['bucket']}] l2p={p['l2p']} |v|<={p['maxv']}: "
+            f"{p['verdict']} facc={p.get('float_acc')} "
+            f"iacc={p.get('int_acc')}"
+        )
+        if p["verdict"] != "exact":
+            failed = True
+
+    print("\n== signed-weight envelope (int16, BLOSUM/PAM prerequisite) ==")
+    for s in report["signed_weights"]["entries"]:
+        mark = "survives" if s["survives"] else "needs gating"
+        print(
+            f"  {s['entry']} {tuple(s.get('bucket') or ())}: "
+            f"{s['verdict']} [{mark}]"
+        )
+    for p in report["signed_weights"]["paths"]:
+        mark = "survives" if p["survives"] else f"gate at |v|<={p['ceiling']}"
+        print(f"  path {p['path']} l2p={p['l2p']}: {mark}")
+
+    for f in report["findings"]:
+        print(f"  FINDING [{f['kind']}] {f['where']}: {f['detail']}")
+        failed = True
+
+    if args.out:
+        with open(args.out, "w") as fh:
+            json.dump(report, fh, indent=1, sort_keys=True)
+            fh.write("\n")
+        print(f"\nreport written to {args.out}")
+
+    view = golden_view(report)
+    if args.update:
+        if failed:
+            print("\nrefusing --update: the run itself failed")
+            return 1
+        os.makedirs(os.path.dirname(GOLDEN_PATH), exist_ok=True)
+        with open(GOLDEN_PATH, "w") as fh:
+            json.dump(view, fh, indent=1, sort_keys=True)
+            fh.write("\n")
+        print(f"\ngolden updated: {GOLDEN_PATH}")
+        return 0
+
+    print("\n== golden drift ==")
+    if not os.path.exists(GOLDEN_PATH):
+        print(
+            f"FAIL: no committed golden at {GOLDEN_PATH} "
+            "(run scripts/ranges_audit.py --update and commit it)"
+        )
+        return 1
+    with open(GOLDEN_PATH) as fh:
+        want = json.load(fh)
+    rows = diff_views(want, view)
+    if rows:
+        print(f"FAIL: {len(rows)} field(s) drifted from the golden:")
+        print("\n".join(rows))
+        print(
+            "either fix the regression, or regenerate deliberately with "
+            "scripts/ranges_audit.py --update and commit the new "
+            "baseline with the change that explains it"
+        )
+        return 1
+    print("match: range cert equals the committed golden")
+    if failed:
+        print("\nranges-audit: FAIL")
+        return 1
+    print("\nranges-audit: OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -67,7 +67,7 @@ def test_bench_emits_contract_json_line():
                         "mfu_vs_feed_roofline",
                         "vpu_probe_arith_gelems", "vpu_floor_us",
                         "wall_vs_vpu_floor", "formulation", "donation",
-                        "comms"}
+                        "comms", "ranges"}
     # r6: every record carries the DonationPlan it ran under — the
     # wired donate_argnums per entry and the committed pre-donation
     # MFU baseline (BENCH_r05) the TPU record's delta is quoted against.
@@ -88,6 +88,15 @@ def test_bench_emits_contract_json_line():
     effs = comms["predicted_scaling_efficiency"]
     assert {"2x-batch", "2x-seq", "8x-seq"} <= set(effs)
     assert all(0.0 < v <= 1.0 for v in effs.values())
+    # PR 15: the record carries the numeric-exactness cert it ran under
+    # — every hand constant re-derived and matching, every certified
+    # row exact, zero findings.
+    ranges = rec["ranges"]
+    assert ranges["constants_ok"] == ranges["constants"] == 18
+    assert ranges["entries_exact"] == ranges["entries"] == 15
+    assert ranges["production_buckets"] >= 1
+    assert ranges["signed_survivors"] >= 1
+    assert ranges["findings"] == 0
     assert rec["e2e_first_run_s"] >= 0 and rec["e2e_warm_s"] >= 0
     # Cold start spans process start -> first result, so it bounds the
     # first in-process run from above; no SEQALIGN_PREWARM in this env.

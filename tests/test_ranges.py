@@ -1,0 +1,298 @@
+"""Value-range certifier tests (analysis/ranges.py): the interval
+domain's algebra, each seeded numeric hazard caught by a typed finding,
+the hand constants re-derived and drift-gated, and the real entry
+contracts certifying exact under their certified envelopes."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mpi_openmp_cuda_tpu.analysis import RangeCertError
+from mpi_openmp_cuda_tpu.analysis import ranges as R
+
+
+def _analyze(fn, args, seeds, where="test"):
+    return R.analyze_entry(fn, args, seeds, where)
+
+
+def _aval(shape, dtype):
+    return jax.ShapeDtypeStruct(tuple(shape), np.dtype(dtype))
+
+
+class TestIntervalDomain:
+    def test_arith(self):
+        a, b = R.Interval(-2, 3), R.Interval(1, 4)
+        assert R.Interval(-1, 7) == a.add(b)
+        assert R.Interval(-6, 2) == a.sub(b)
+        assert R.Interval(-8, 12) == a.mul(b)
+        assert R.Interval(-2, 4) == a.join(b)
+
+    def test_scale_sum_keeps_zero(self):
+        # An n-term sum of terms each in [lo, hi] spans n*[lo, hi], but
+        # never excludes 0 (some terms may be masked out).
+        s = R.Interval(1, 5).scale_sum(4)
+        assert s == R.Interval(0, 20)
+        assert R.Interval(-3, 2).scale_sum(4) == R.Interval(-12, 8)
+
+    def test_windows(self):
+        assert R.dtype_window("int8") == R.Interval(-128, 127)
+        assert R.dtype_window("int32") == R.Interval(-(2**31), 2**31 - 1)
+        assert R.exact_window("float32") == R.Interval(-(2**24), 2**24)
+        assert R.exact_window("bfloat16") == R.Interval(-256, 256)
+
+
+class TestSeededHazards:
+    """Each numeric hazard class, seeded synthetically, must be caught
+    by its typed finding — the certifier fails closed, never silent."""
+
+    def test_unknown_primitive_fails_closed(self):
+        res = _analyze(
+            lambda x: jnp.sin(x),
+            (_aval((8,), "float32"),),
+            [R.AbsVal(R._iv(0, 1))],
+        )
+        assert res.verdict == "unproven"
+        assert "sin" in res.unknown
+        assert any(f.kind == "unknown-primitive" for f in res.findings)
+
+    def test_lossy_narrowing_is_a_finding(self):
+        # [0, 1000] does not fit int8: the cast destroys live range.
+        res = _analyze(
+            lambda x: x.astype(jnp.int8),
+            (_aval((8,), "int32"),),
+            [R.AbsVal(R._iv(0, 1000))],
+        )
+        assert any(f.kind == "lossy-narrowing" for f in res.findings)
+
+    def test_widening_cast_is_clean(self):
+        res = _analyze(
+            lambda x: x.astype(jnp.float32),
+            (_aval((8,), "int32"),),
+            [R.AbsVal(R._iv(0, 1000))],
+        )
+        assert res.findings == []
+        assert res.verdict == "exact"
+
+    def test_int_overflow_escapes_window(self):
+        # 8 cumsum terms of up to 2^30 escape int32: typed finding and
+        # the row cannot be proved.
+        res = _analyze(
+            lambda x: jnp.cumsum(x),
+            (_aval((8,), "int32"),),
+            [R.AbsVal(R._iv(0, 2**30))],
+        )
+        assert any(f.kind == "int-overflow" for f in res.findings)
+        assert res.verdict == "unproven"
+
+    def test_onehot_extraction_does_not_widen(self):
+        # where(eq(iota, idx), vals, 0).sum() extracts ONE element; the
+        # naive n-term sum bound would claim int32 overflow.  The
+        # one-hot refinement must prove the exact envelope instead.
+        def extract(vals, idx):
+            lane = jnp.arange(8, dtype=jnp.int32)
+            return jnp.where(lane == idx, vals, 0).sum()
+
+        res = _analyze(
+            extract,
+            (_aval((8,), "int32"), _aval((), "int32")),
+            [R.AbsVal(R._iv(0, 2**30)), R.AbsVal(R._iv(0, 7))],
+        )
+        assert res.findings == []
+        assert res.verdict == "exact"
+
+    def test_overflowing_weights_not_admitted_under_widened_cap(self):
+        # The seeded admission hazard: weights at the l2p=128 ceiling
+        # (32767) fed into a WIDE (l2p=2048) bucket overflow the f32
+        # exact window (2 * 2048 * 32767 >> 2^24).  A certifier that
+        # widened the cap would wrongly admit them; this row must NOT
+        # prove exact.
+        from mpi_openmp_cuda_tpu.analysis.contracts import ENTRY_CONTRACTS
+        from mpi_openmp_cuda_tpu.ops.bounds import max_exact_value
+
+        contract = next(
+            c for c in ENTRY_CONTRACTS if "matmul" in c.name
+        )
+        b, nc, l1p, l2p = 16, 4, 3072, 2048
+        assert max_exact_value(l2p) < 32767  # the gate this row proves
+        fn, args = contract.make(b, nc, l1p, l2p)
+        seeds = R.entry_seeds(args, l1p, l2p, -32767, 32767)
+        res = _analyze(fn, args, seeds, "seeded-overflow")
+        assert res.verdict != "exact"
+        assert res.float_acc is not None
+        assert res.float_acc.hi > 2**24
+
+    def test_lowering_failure_wraps_into_rangecerterror(self):
+        def bad(x):
+            raise ValueError("boom")
+
+        with pytest.raises(RangeCertError, match="failed to lower"):
+            _analyze(bad, (_aval((4,), "int32"),), [R.AbsVal(R._iv(0, 1))])
+
+
+class TestDerivedConstants:
+    def test_every_hand_constant_rederived_and_matching(self):
+        rows, findings = R.derive_constants()
+        assert findings == []
+        assert len(rows) == 18
+        assert all(r["ok"] for r in rows)
+        by_name = {r["name"]: r for r in rows}
+        # The five headline bounds, re-derived from first principles.
+        assert by_name["f32-exact-window"]["derived"] == 2**24
+        assert by_name["operand-cap"]["derived"] == 32767
+        assert by_name["static-weight-ceiling"]["derived"] == 4095
+        assert by_name["rowpack-epilogue-limit"]["derived"] == 2**19
+        assert by_name["argmax-pack-radix"]["derived"] == 4096
+        assert by_name["max-exact-value-2048"]["derived"] == 4095
+        assert by_name["max-exact-value-128"]["derived"] == 32767
+
+    def test_superblock_cap_is_an_inequality_row(self):
+        rows, _ = R.derive_constants()
+        row = next(r for r in rows if r["name"] == "superblock-key-budget")
+        assert row["relation"] == "<="
+        assert row["wired"] <= row["derived"]
+
+    def test_injected_drift_is_a_finding(self):
+        # Tamper one wired source: the diff must name the row.
+        rows, findings = R.derive_constants(
+            wired={"static-weight-ceiling": 4094}
+        )
+        drifted = [f for f in findings if f.kind == "constant-drift"]
+        assert len(drifted) == 1
+        assert "static-weight-ceiling" in drifted[0].where
+        row = next(r for r in rows if r["name"] == "static-weight-ceiling")
+        assert not row["ok"]
+
+
+class TestEntryCertification:
+    def test_small_bucket_certifies_exact(self):
+        rows, findings = R.audit_entry_ranges(buckets=[(4, 1, 200, 40)])
+        assert findings == []
+        assert len(rows) == 5
+        assert all(r["verdict"] == "exact" for r in rows)
+        assert all(r["unknown_primitives"] == [] for r in rows)
+
+
+class TestSignedEnvelope:
+    """ROADMAP item 4's BLOSUM/PAM prerequisite: the negative-weight
+    envelope is pinned per path, never silently assumed."""
+
+    def test_envelope_is_full_int16(self):
+        assert R.SIGNED_ENVELOPE == (-32768, 32767)
+
+    def test_wide_bucket_survival_map(self):
+        rows = R.audit_signed_entries(buckets=[(16, 4, 3072, 2048)])
+        by_entry = {r["entry"]: r for r in rows}
+        # int32 gather accumulates exactly at any sign; the f32 delta
+        # paths overflow the exact window at l2p=2048 and must be gated.
+        assert by_entry["xla_scorer.score_chunks_body"]["survives"]
+        assert not by_entry["matmul_scorer.score_chunks_mm_body"]["survives"]
+
+    def test_path_table_pins_the_feed_ceilings(self):
+        paths = {(p["path"], p["l2p"]): p for p in R.signed_weight_paths()}
+        assert paths[("xla-gather-int32", 2048)]["survives"]
+        assert not paths[("pallas-i8", None)]["survives"]
+        assert paths[("pallas-i8", None)]["ceiling"] == 127
+        assert paths[("pallas-bf16", None)]["ceiling"] == 128
+        assert not paths[("mm-f32", 2048)]["survives"]
+
+
+class TestRangesAuditSchema:
+    """The kind="ranges-audit" branch of the one report schema gate."""
+
+    def _body(self):
+        return {
+            "engine": {"domain": "interval"},
+            "windows": {"f32_exact": [-(2**24), 2**24]},
+            "derived_constants": [
+                {
+                    "name": "static-weight-ceiling",
+                    "derived": 4095,
+                    "wired": 4095,
+                    "relation": "==",
+                    "ok": True,
+                }
+            ],
+            "entries": [
+                {
+                    "entry": "matmul_scorer.score_chunks_mm_body",
+                    "verdict": "exact",
+                    "findings": [],
+                }
+            ],
+            "production": [],
+            "signed_weights": {"entries": [], "paths": []},
+            "findings": [],
+            "counts": {
+                "constants": 1,
+                "constants_ok": 1,
+                "entries": 1,
+                "entries_exact": 1,
+                "production_buckets": 0,
+                "signed_survivors": 0,
+                "findings": 0,
+            },
+        }
+
+    def test_valid_report_passes(self):
+        from mpi_openmp_cuda_tpu.obs.metrics import (
+            validate_report,
+            wrap_report,
+        )
+
+        validate_report(wrap_report("ranges-audit", self._body()))
+
+    @pytest.mark.parametrize(
+        "mutate",
+        [
+            lambda b: b.pop("derived_constants"),
+            lambda b: b.pop("entries"),
+            lambda b: b.pop("production"),
+            lambda b: b.pop("signed_weights"),
+            lambda b: b.pop("findings"),
+            lambda b: b.pop("counts"),
+            lambda b: b["derived_constants"][0].pop("ok"),
+            lambda b: b["entries"][0].__setitem__("verdict", "maybe"),
+            lambda b: b["signed_weights"].pop("paths"),
+            lambda b: b["counts"].pop("entries_exact"),
+        ],
+    )
+    def test_malformed_reports_rejected(self, mutate):
+        from mpi_openmp_cuda_tpu.obs.metrics import (
+            validate_report,
+            wrap_report,
+        )
+
+        body = self._body()
+        mutate(body)
+        with pytest.raises(ValueError, match="invalid run report"):
+            validate_report(wrap_report("ranges-audit", body))
+
+    def test_real_cert_is_schema_valid_and_json(self):
+        import json
+
+        from mpi_openmp_cuda_tpu.obs.metrics import (
+            validate_report,
+            wrap_report,
+        )
+
+        cert = R.build_cert()  # no problem: entries + constants only
+        json.dumps(cert)  # no dataclasses / tuples leaking through
+        validate_report(wrap_report("ranges-audit", cert))
+        assert cert["counts"]["findings"] == 0
+
+
+class TestBenchRangesRecord:
+    def test_record_summarises_the_cert(self):
+        import bench
+        from mpi_openmp_cuda_tpu.models.workload import (
+            input3_class_problem,
+        )
+
+        rec = bench.ranges_record(input3_class_problem(), "pallas")
+        assert rec["constants_ok"] == rec["constants"] == 18
+        assert rec["entries_exact"] == rec["entries"] == 15
+        assert rec["production_buckets"] == 4
+        assert rec["findings"] == 0

@@ -880,3 +880,129 @@ class TestSeq012Collectives:
         from mpi_openmp_cuda_tpu.analysis.collectives import COLLECTIVE_PRIMS
 
         assert seqlint._COLLECTIVE_NAMES == set(COLLECTIVE_PRIMS)
+
+
+class TestSeq013CertMarkers:
+    def test_unmarked_bound_in_traced_code(self, tmp_path):
+        findings = _lint_snippet(
+            tmp_path,
+            "ops/foo.py",
+            """
+            MAX_WEIGHT = 4095
+            """,
+        )
+        assert [f.code for f in findings] == ["SEQ013"]
+        assert "4095" in findings[0].message
+        assert "ops/bounds.py" in findings[0].message
+
+    def test_pow_and_shift_spellings_match(self, tmp_path):
+        findings = _lint_snippet(
+            tmp_path,
+            "ops/foo.py",
+            """
+            EPILOGUE = 2**19
+            WINDOW = 1 << 24
+            """,
+        )
+        assert [f.code for f in findings] == ["SEQ013", "SEQ013"]
+        assert "524288" in findings[0].message
+        assert "16777216" in findings[1].message
+
+    def test_inner_literal_of_int32_ceiling_matches(self, tmp_path):
+        # 2**31 - 1 spells the pack ceiling via its inner 2**31.
+        findings = _lint_snippet(
+            tmp_path,
+            "ops/foo.py",
+            """
+            CEILING = 2**31 - 1
+            """,
+        )
+        assert [f.code for f in findings] == ["SEQ013"]
+
+    def test_named_marker_is_clean(self, tmp_path):
+        assert not _lint_snippet(
+            tmp_path,
+            "ops/foo.py",
+            """
+            MAX_WEIGHT = 4095  # cert: static-weight-ceiling
+            PACK = 4096  # cert: argmax-pack-radix
+            """,
+        )
+
+    def test_marker_anywhere_on_multiline_statement(self, tmp_path):
+        assert not _lint_snippet(
+            tmp_path,
+            "ops/foo.py",
+            """
+            def gate(v):
+                return min(
+                    v,
+                    32767,  # cert: operand-cap
+                )
+            """,
+        )
+
+    def test_bare_marker_is_a_finding(self, tmp_path):
+        findings = _lint_snippet(
+            tmp_path,
+            "ops/foo.py",
+            """
+            MAX_WEIGHT = 4095  # cert:
+            """,
+        )
+        assert [f.code for f in findings] == ["SEQ013"]
+        assert "bare" in findings[0].message
+
+    def test_host_modules_are_out_of_scope(self, tmp_path):
+        # The bound set only polices traced gate/kernel code; a host
+        # module quoting 4095 (a report, a test fixture) is fine.
+        assert not _lint_snippet(
+            tmp_path,
+            "models/foo.py",
+            """
+            REPORT_CEILING = 4095
+            """,
+        )
+
+    def test_unrelated_literals_are_fine(self, tmp_path):
+        assert not _lint_snippet(
+            tmp_path,
+            "ops/foo.py",
+            """
+            BLOCK = 128
+            LANES = 8 * 128
+            """,
+        )
+
+    def test_suppression_honoured(self, tmp_path):
+        assert not _lint_snippet(
+            tmp_path,
+            "ops/foo.py",
+            """
+            MAX_WEIGHT = 4095  # seqlint: disable=SEQ013
+            """,
+        )
+
+    def test_ranges_pass_is_classified_host(self):
+        # The certifier PROVES bounds (it never gates on one), so it
+        # lives under the host role on purpose.
+        roles = seqlint.module_roles("pkg/analysis/ranges.py")
+        assert roles == (seqlint.ROLE_HOST,)
+
+    def test_literal_set_covers_the_wired_bounds(self):
+        # Every bound ops/bounds.py wires must be in SEQ013's literal
+        # set, so moving a constant OUT of bounds.py cannot silently
+        # escape the marker rule.
+        from mpi_openmp_cuda_tpu.ops import bounds
+
+        for v in (
+            bounds.F32_EXACT_WINDOW,
+            bounds.MAX_HIGHEST_OPERAND,
+            bounds.OPERAND_CAP,
+            bounds.PACK_RADIX,
+            bounds.INT32_PACK_CEILING,
+            bounds.ROWPACK_EPILOGUE_LIMIT,
+            bounds.MAX_EXACT_WEIGHT,
+            abs(bounds.INT32_PACKED_SENTINEL),
+        ):
+            assert v in seqlint._CERT_LITERALS, v
