@@ -36,6 +36,16 @@ Event alphabet (the §8.6 failure matrix, one event per row):
   without the epoch check demuxes it; the invariant catches that (the
   seeded-bug test in tests/test_interleave.py proves it).
 
+The failover scenario (PR 16) extends the alphabet with the
+coordinator-level failure modes: ``crash`` (the leader dies ``kill -9``
+style, board debris intact), ``sb<i>.tick`` (one standby watch tick —
+observe the newest generation's beat, race ``try_acquire`` on verdict,
+replay the predecessor's checkpoint on a win), and leader *starvation*
+(a leader the scheduler never runs again is the zombie shape — its
+deposition on the next pump is explored, not assumed).  Its invariants:
+exactly one leader per generation, no reply duplicated across
+generations, no reply dropped.
+
 Invariants, checked after every transition and at quiescence:
 
 1. **each offer demuxed exactly once** — never two completions (demux
@@ -63,15 +73,18 @@ import io
 import json
 
 from ..resilience.membership import (
+    OFFER_PREFIX,
+    LeaderLease,
     board_read_json,
     claim_key,
     heartbeat_key,
     offer_key,
+    read_checkpoint,
     result_key,
     worker_key,
 )
 from ..resilience.rescue import MemoryBoard
-from ..serve.fleet import FleetCoordinator
+from ..serve.fleet import FleetCoordinator, LeadershipLostError
 from ..serve.queue import ADMIT_CLOSED, ADMIT_OK, RequestQueue
 from . import InterleaveViolation
 
@@ -381,6 +394,363 @@ class FleetScenario:
         return not (fa & fb)
 
 
+class _FailoverState:
+    """One failover replay's world: the board, every coordinator that
+    has ever led (the original plus each takeover's successor), the
+    standby leases, and the invariant ledgers."""
+
+    def __init__(self):
+        self.board = None
+        self.coords = []  # [{coord, rec, lease, gen, halted, answered}]
+        self.standbys = []  # [{lease, ticks, taken (coord entry | None)}]
+        self.workers = []
+        self.crashed = False  # the original leader was killed
+        self.gen_winners = {}  # gen -> winning lid (single-leader ledger)
+        self.seen_done = {}  # request id -> completion count, cumulative
+
+
+class FleetFailoverScenario:
+    """Coordinator failover (PR 16) under exploration: the REAL
+    :class:`~..resilience.membership.LeaderLease`,
+    checkpoint/:func:`~..resilience.membership.read_checkpoint` replay,
+    and generation fencing, with a leader ``crash`` event in the
+    alphabet and TWO standbys racing ``try_acquire`` so the
+    single-leader invariant is a genuine race, not a tautology.
+
+    One request (id ``r1``) flows through: the original leader offers
+    its superblock and checkpoints (the post-ingest checkpoint the serve
+    loop writes before its first tick); any schedule may then kill the
+    leader, starve it (the zombie shape — a leader the scheduler never
+    runs again is indistinguishable from a hung one), or let it finish.
+    A standby whose watch verdict lands claims the next generation,
+    replays the predecessor's checkpoint (skipping answered ids), and
+    re-offers.  Block labels are REQUEST ids, not bids, so completions
+    aggregate across generations — the duplicate check spans every
+    coordinator that ever led.
+    """
+
+    #: The admitted-request journal this run would checkpoint.
+    REQUESTS = ({"id": "r1"},)
+    #: Standby watch deadline (ticks) — matches lease_s/poll_s below.
+    DEADLINE_TICKS = 2
+
+    def __init__(self, name: str = "fleet-failover", *, standbys: int = 2):
+        self.name = name
+        self.n_standbys = int(standbys)
+        self.invariants = (
+            "single-leader-per-generation",
+            "no-reply-duplicated",
+            "no-reply-dropped",
+        )
+
+    # -- world construction ------------------------------------------------
+
+    def _new_leader(self, state: _FailoverState, lease) -> dict:
+        rec = _Recorder()
+        coord = FleetCoordinator(
+            state.board,
+            local_score=rec.local_score,
+            demux=rec.demux,
+            clock=VirtualClock(),
+            lease_s=2.0,
+            poll_s=1.0,  # lease_ticks = 2, same window as DEADLINE_TICKS
+            leader=lease,
+        )
+        return {
+            "coord": coord, "rec": rec, "lease": lease,
+            "gen": lease.gen, "halted": False, "answered": set(),
+        }
+
+    def fresh(self) -> _FailoverState:
+        state = _FailoverState()
+        state.board = MemoryBoard()
+        lease = LeaderLease(state.board, "lead", self.DEADLINE_TICKS)
+        gen = lease.acquire()  # virgin board: wins generation 0
+        state.gen_winners[gen] = lease.lid
+        cx = self._new_leader(state, lease)
+        state.coords.append(cx)
+        state.workers = [_ModelWorker(0)]
+        for w in state.workers:
+            state.board.post(worker_key(w.wid), json.dumps({"wid": w.wid}))
+            w.beats = 1
+            state.board.post(heartbeat_key(w.wid), str(w.beats))
+        cx["coord"].pump(idle=True)  # tick 1: the worker joins
+        self._offer_requests(cx, set())
+        self._ckpt(cx)  # the post-ingest checkpoint, pre first tick
+        state.standbys = [
+            {
+                "lease": LeaderLease(
+                    state.board, f"sb{i}", self.DEADLINE_TICKS
+                ),
+                "ticks": 0,
+                "taken": None,
+            }
+            for i in range(self.n_standbys)
+        ]
+        return state
+
+    def _offer_requests(self, cx: dict, answered: set) -> None:
+        for raw in self.REQUESTS:
+            if raw["id"] in answered:
+                continue
+            block = _ModelBlock()
+            cx["coord"].offer(block)
+            block.label = raw["id"]
+
+    def _ckpt(self, cx: dict) -> None:
+        unanswered = [
+            dict(raw) for raw in self.REQUESTS
+            if raw["id"] not in cx["answered"]
+        ]
+        cx["coord"].checkpoint(unanswered, sorted(cx["answered"]))
+
+    # -- per-coordinator steps ---------------------------------------------
+
+    def _leader_tick(self, state: _FailoverState, cx: dict) -> None:
+        """One serve tick of an incumbent: pump (which self-deposes on a
+        higher generation BEFORE collecting anything), fold this tick's
+        completions into the answered set, checkpoint.  Pump + checkpoint
+        are one event — the model's atomicity grain is the tick boundary,
+        exactly the exactly-once boundary ARCHITECTURE §8.6 documents."""
+        try:
+            cx["coord"].pump(idle=True)
+        except LeadershipLostError:
+            cx["halted"] = True
+            return
+        rec = cx["rec"]
+        for label, _rows in rec.demuxed:
+            cx["answered"].add(label)
+        for label in rec.local:
+            cx["answered"].add(label)
+        self._ckpt(cx)
+
+    def _sb_tick(self, state: _FailoverState, i: int, schedule) -> None:
+        """One standby watch tick; after this standby has taken over, its
+        ticks ARE the successor coordinator's serve ticks."""
+        sb = state.standbys[i]
+        if sb["taken"] is not None:
+            self._leader_tick(state, sb["taken"])
+            return
+        sb["ticks"] += 1
+        lease = sb["lease"]
+        if not lease.observe(sb["ticks"]):
+            return
+        watched = lease.watched_gen()
+        if watched is None or not lease.try_acquire(watched + 1):
+            return  # a rival won this generation; the watch restarts
+        gen = lease.gen
+        if gen in state.gen_winners:
+            raise InterleaveViolation(
+                f"TWO leaders for generation {gen}: "
+                f"{state.gen_winners[gen]} and {lease.lid} — the claim "
+                f"primitive must admit exactly one; "
+                f"schedule={list(schedule)}"
+            )
+        state.gen_winners[gen] = lease.lid
+        cx = self._new_leader(state, lease)
+        ckpt = read_checkpoint(state.board, watched)
+        if ckpt is not None:
+            cx["answered"] = set(ckpt["answered"])
+        state.coords.append(cx)
+        sb["taken"] = cx
+        cx["coord"].pump(idle=True)  # tick 1: workers re-join
+        self._offer_requests(cx, cx["answered"])
+        self._ckpt(cx)  # re-checkpoint under the successor's generation
+
+    def _active(self, state: _FailoverState) -> dict | None:
+        live = [cx for cx in state.coords if not cx["halted"]]
+        return max(live, key=lambda cx: cx["gen"]) if live else None
+
+    def _completions(self, state: _FailoverState) -> dict:
+        done: dict[str, int] = {}
+        for cx in state.coords:
+            for label, _rows in cx["rec"].demuxed:
+                done[label] = done.get(label, 0) + 1
+            for label in cx["rec"].local:
+                done[label] = done.get(label, 0) + 1
+        return done
+
+    def _offers(self, board) -> list:
+        out = []
+        for key in sorted(board.keys(OFFER_PREFIX)):
+            offer = board_read_json(board, key)
+            if (
+                offer is not None
+                and isinstance(offer.get("bid"), str)
+                and isinstance(offer.get("epoch"), int)
+            ):
+                out.append(offer)
+        return out
+
+    # -- the event alphabet ------------------------------------------------
+
+    def enabled(self, state: _FailoverState):
+        evs = []
+        original = state.coords[0]
+        if not state.crashed and not original["halted"]:
+            evs.append("tick")
+            evs.append("crash")
+        for i, sb in enumerate(state.standbys):
+            if sb["taken"] is None or not sb["taken"]["halted"]:
+                evs.append(f"sb{i}.tick")
+        board = state.board
+        for w in state.workers:
+            evs.append(f"w{w.idx}.beat")
+            can_claim = can_post = False
+            for offer in self._offers(board):
+                bid, epoch = offer["bid"], int(offer["epoch"])
+                if (
+                    w.claimed.get(bid) != epoch
+                    and board.get(claim_key(bid, epoch)) is None
+                    and board.get(result_key(bid, epoch)) is None
+                ):
+                    can_claim = True
+                if (
+                    w.claimed.get(bid) is not None
+                    and board.get(result_key(bid, w.claimed[bid])) is None
+                ):
+                    can_post = True
+            if can_claim:
+                evs.append(f"w{w.idx}.claim")
+            if can_post:
+                evs.append(f"w{w.idx}.post")
+        return evs
+
+    def execute(self, state: _FailoverState, ev: str, schedule=()) -> None:
+        if ev == "tick":
+            self._leader_tick(state, state.coords[0])
+            return
+        if ev == "crash":
+            # kill -9: the original leader stops mid-run.  Its board
+            # state (offer, claim, beat, checkpoint) stays exactly as
+            # posted — that debris is what fencing and GC exist for.
+            state.crashed = True
+            state.coords[0]["halted"] = True
+            return
+        actor, verb = ev.split(".", 1)
+        if actor.startswith("sb"):
+            self._sb_tick(state, int(actor[2:]), schedule)
+            return
+        w = state.workers[int(actor[1:])]
+        board = state.board
+        if verb == "beat":
+            w.beats += 1
+            board.post(heartbeat_key(w.wid), str(w.beats))
+        elif verb == "claim":
+            # First eligible offer in key order — deterministic, and
+            # recomputed here so enabled() and execute() agree.
+            for offer in self._offers(board):
+                bid, epoch = offer["bid"], int(offer["epoch"])
+                if (
+                    w.claimed.get(bid) != epoch
+                    and board.get(claim_key(bid, epoch)) is None
+                    and board.get(result_key(bid, epoch)) is None
+                ):
+                    if board.claim(
+                        claim_key(bid, epoch),
+                        json.dumps({"wid": w.wid, "epoch": epoch}),
+                    ):
+                        w.claimed[bid] = epoch
+                    return
+        elif verb == "post":
+            for bid, epoch in sorted(w.claimed.items()):
+                if board.get(result_key(bid, epoch)) is None:
+                    board.post(
+                        result_key(bid, epoch),
+                        json.dumps({
+                            "bid": bid, "epoch": epoch, "wid": w.wid,
+                            "rows": [[w.idx, epoch, 0]],
+                        }),
+                    )
+                    return
+        else:
+            raise InterleaveViolation(f"unknown event {ev!r} (model bug)")
+
+    # -- invariants --------------------------------------------------------
+
+    def check(self, state: _FailoverState, schedule) -> None:
+        done = self._completions(state)
+        for label, n in done.items():
+            if n > 1:
+                raise InterleaveViolation(
+                    f"reply DUPLICATED: request {label} completed {n} "
+                    f"times across leader generations — the answered-id "
+                    f"replay filter or generation fencing is broken; "
+                    f"schedule={list(schedule)}"
+                )
+        state.seen_done = done
+
+    def finish(self, state: _FailoverState, schedule) -> None:
+        """Leaf closure: freeze the worker, then drive whoever should be
+        driving — the highest-generation live coordinator if one exists,
+        else the next standby's watch — until the request completes and
+        the active coordinator drains.  Hitting the bound IS the
+        dropped-reply violation; a world with every coordinator halted
+        and no standby left is the (worse) leaderless violation."""
+        ticks = 0
+        while ticks < _QUIESCE_TICKS:
+            done = self._completions(state)
+            active = self._active(state)
+            if (
+                all(done.get(raw["id"], 0) == 1 for raw in self.REQUESTS)
+                and (active is None or not active["coord"].blocks)
+            ):
+                return
+            if active is not None:
+                self._leader_tick(state, active)
+            else:
+                idle = next(
+                    (
+                        i for i, sb in enumerate(state.standbys)
+                        if sb["taken"] is None
+                    ),
+                    None,
+                )
+                if idle is None:
+                    raise InterleaveViolation(
+                        f"LEADERLESS: every coordinator halted and no "
+                        f"standby remains to take over; "
+                        f"schedule={list(schedule)}"
+                    )
+                self._sb_tick(state, idle, schedule)
+            self.check(state, schedule)
+            ticks += 1
+        done = self._completions(state)
+        raise InterleaveViolation(
+            f"reply DROPPED: completions {done} after {_QUIESCE_TICKS} "
+            f"quiescence ticks (want exactly one per request); "
+            f"schedule={list(schedule)}"
+        )
+
+    # -- independence (sleep-set pruning) ----------------------------------
+
+    def _actor(self, ev: str) -> str:
+        if ev in ("tick", "crash"):
+            return "lead"
+        return ev.split(".", 1)[0]
+
+    def _footprint(self, ev: str):
+        if ev == "crash":
+            # The crash flips only the original leader's halted flag —
+            # it writes nothing to the board, so it commutes with every
+            # event except that leader's own tick (actor rule).
+            return {"lead"}
+        if ev == "tick" or ev.startswith("sb"):
+            return {"*"}  # board polls read everything
+        _w, verb = ev.split(".", 1)
+        if verb == "beat":
+            return {f"hb/{_w}"}
+        return {"blk"}
+
+    def independent(self, a: str, b: str) -> bool:
+        if self._actor(a) == self._actor(b):
+            return False
+        fa, fb = self._footprint(a), self._footprint(b)
+        if "*" in fa or "*" in fb:
+            return False
+        return not (fa & fb)
+
+
 class QueueScenario:
     """The RequestQueue under exploration: three submitting clients, the
     popping loop, drain close, and source close, interleaved every way.
@@ -541,6 +911,9 @@ def explore(scenario, depth: int) -> dict:
 #: fleet-fencing: one worker with the adversarial stale re-post enabled
 #:   and lease_ticks=1, deep enough that claim → expiry → re-offer →
 #:   stale post → collect all fit inside the depth bound.
+#: fleet-failover: leader crash/starvation with two standbys racing the
+#:   next generation — single-leader-per-generation, checkpoint-replay
+#:   exactly-once, takeover within the watch deadline (PR 16).
 #: request-queue: admission/pop/close/close-source interleavings.
 def scenarios():
     return [
@@ -551,6 +924,7 @@ def scenarios():
             ),
             8,
         ),
+        (FleetFailoverScenario(), 6),
         (QueueScenario(), 6),
     ]
 

@@ -322,6 +322,19 @@ def build_arg_parser() -> argparse.ArgumentParser:
         "warm from the AOT manifest)",
     )
     p.add_argument(
+        "--fleet-standby",
+        action="store_true",
+        help="run as a STANDBY fleet coordinator: watch the active "
+        "leader's beat on the --fleet-board, and when it goes silent "
+        "for a full lease window (SEQALIGN_LEASE_S), claim the next "
+        "leader generation, replay the dead leader's board checkpoint "
+        "(unanswered requests + answered reply ids), fence its late "
+        "posts by generation, and resume serving with zero duplicate "
+        "and zero dropped replies; exits 0 when the fleet shuts down "
+        "cleanly instead (--port/--telemetry-port open immediately, so "
+        "clients can reconnect-and-redrive before the takeover lands)",
+    )
+    p.add_argument(
         "--check",
         action="store_true",
         help="validate every concrete dispatch decision against the "
@@ -923,21 +936,48 @@ def run(argv: list[str] | None = None) -> int:
             file=sys.stderr,
         )
         return EX_USAGE
-    if args.fleet_board and not (args.serve or args.fleet_worker):
+    if args.fleet_standby and _reject_combos("--fleet-standby", (
+        ("--serve", args.serve, "a standby IS a serve loop in waiting; "
+         "it becomes the coordinator only by winning the takeover"),
+        ("--fleet-worker", args.fleet_worker, "a process is a standby "
+         "coordinator OR a scoring worker, never both"),
+        ("--stream", args.stream is not None, "the standby serves fleet "
+         "requests after takeover, not streamed chunks"),
+        ("--distributed", args.distributed, "the fleet is its own "
+         "multi-process layer on the coordination board"),
+        ("--input", args.input is not None, "a standby's requests come "
+         "from the dead leader's checkpoint and reconnecting clients, "
+         "not a pipe"),
+    )):
+        return EX_USAGE
+    if args.fleet_standby and not args.fleet_board:
         print(
-            "mpi_openmp_cuda_tpu: error: --fleet-board requires --serve "
-            "(coordinator) or --fleet-worker (scoring worker)",
+            "mpi_openmp_cuda_tpu: error: --fleet-standby requires "
+            "--fleet-board DIR (the board is where the leader lease "
+            "lives)",
             file=sys.stderr,
         )
         return EX_USAGE
-    if args.port is not None and not args.serve:
+    if args.fleet_board and not (
+        args.serve or args.fleet_worker or args.fleet_standby
+    ):
+        print(
+            "mpi_openmp_cuda_tpu: error: --fleet-board requires --serve "
+            "(coordinator), --fleet-worker (scoring worker), or "
+            "--fleet-standby (failover coordinator)",
+            file=sys.stderr,
+        )
+        return EX_USAGE
+    if args.port is not None and not (args.serve or args.fleet_standby):
         print(
             "mpi_openmp_cuda_tpu: error: --port requires --serve (the "
             "port is where the serving loop listens)",
             file=sys.stderr,
         )
         return EX_USAGE
-    if args.telemetry_port is not None and not args.serve:
+    if args.telemetry_port is not None and not (
+        args.serve or args.fleet_standby
+    ):
         print(
             "mpi_openmp_cuda_tpu: error: --telemetry-port requires "
             "--serve (live telemetry scrapes a running serve loop; a "
@@ -991,12 +1031,12 @@ def run(argv: list[str] | None = None) -> int:
         # --serve arms it unconditionally: the flight recorder must be
         # taping before the first request so a later wedge has history.
         obs_on, metrics_out, heartbeat_s, trace_out = _build_obs(args)
-        if obs_on or args.serve:
+        if obs_on or args.serve or args.fleet_standby:
             registry, recorder = arm_observability(
                 with_trace=bool(trace_out),
                 flightrec_depth=(
                     env_int("SEQALIGN_FLIGHTREC_DEPTH", 256)
-                    if (args.serve or obs_on)
+                    if (args.serve or args.fleet_standby or obs_on)
                     else 0
                 ),
             )
@@ -1050,7 +1090,7 @@ def run(argv: list[str] | None = None) -> int:
             _run_prewarm(args, timer, backend=deg.scorer.backend)
             rc = fleet_mod.run_fleet_worker(args, timer, policy, deg)
             return rc
-        if args.serve:
+        if args.serve or args.fleet_standby:
             if args.journal:
                 _check_resume(args)
 

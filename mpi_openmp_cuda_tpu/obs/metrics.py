@@ -236,6 +236,22 @@ class MetricsRegistry:
             self.inc("fleet_fenced_posts")
         elif event == "fleet.redispatch":
             self.inc("fleet_redispatches")
+        elif event == "fleet.deadletter":
+            self.inc("fleet_deadletter")
+        elif event == "leader.elected":
+            self.inc("fleet_elections")
+            self.gauge("fleet_leader_epoch", int(fields.get("gen", 0)))
+        elif event == "leader.takeover":
+            self.inc("fleet_takeovers")
+            self.gauge("fleet_leader_epoch", int(fields.get("gen", 0)))
+        elif event == "leader.fenced":
+            self.inc("fleet_leader_fenced")
+        elif event == "leader.deposed":
+            self.inc("fleet_depositions")
+        elif event == "board.gc":
+            self.inc("fleet_gc_swept", int(fields.get("count", 0)))
+        elif event == "serve.request.duplicate":
+            self.inc("serve_duplicates")
         elif event.startswith("breaker."):
             # breaker.open / breaker.half_open / breaker.close -> one
             # counter each, plus the current-state gauge the chaos tier

@@ -67,6 +67,12 @@ dies — instead of a raised error:
 ``kill:fleet-worker``     SIGKILL a fleet scoring worker at its scheduled
                           ``fleet_score`` fire point — after the lease claim,
                           before any result lands (mid-superblock)
+``kill:fleet-coordinator``  SIGKILL the fleet *coordinator* at its scheduled
+                          ``fleet_pump`` fire point (the pump-tick boundary,
+                          after the previous tick's board checkpoint) — the
+                          standby-takeover chaos tier: a ``--fleet-standby``
+                          process must win the next leader generation and
+                          answer every unanswered request exactly once
 ========================  ====================================================
 
 Hang sites require an armed watchdog (``--deadline`` /
@@ -109,6 +115,13 @@ way — probed with :func:`scheduled`, the fleet machinery does the rest:
                             and the superblock re-dispatches
 ``lease:stall``             this worker claims the offer and never scores it
                             — the pure lease-expiry path, no death involved
+``zombie:fleet-leader``     the *coordinator* freezes its leader beat at this
+                            pump tick while continuing to serve — it must be
+                            deposed by a standby and its late board posts
+                            fenced by generation, never double-answered
+``board:enospc``            this board post's tmp write fails mid-write
+                            (disk full): the key must read as missing — never
+                            as a torn post — and no ``.tmp.`` file may leak
 ==========================  ==================================================
 """
 
@@ -133,7 +146,9 @@ SERVE_SITES = frozenset(
 FLEET_SITES = frozenset(
     {
         "zombie:fleet-worker",
+        "zombie:fleet-leader",
         "board:torn-post",
+        "board:enospc",
         "lease:stall",
     }
 )
@@ -155,6 +170,7 @@ KNOWN_SITES = (
             "kill:journal-append",
             "kill:serve-tick",
             "kill:fleet-worker",
+            "kill:fleet-coordinator",
         }
     )
     | SERVE_SITES
@@ -177,6 +193,7 @@ _KILL_SITES = {
     "journal_append": "kill:journal-append",
     "serve_tick": "kill:serve-tick",
     "fleet_score": "kill:fleet-worker",
+    "fleet_pump": "kill:fleet-coordinator",
 }
 
 

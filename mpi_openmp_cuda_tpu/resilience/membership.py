@@ -38,6 +38,22 @@ board, is counted (``lease.fenced``), and is never demuxed.  Death is
 terminal: a worker whose heartbeat resumes after the verdict stays
 dead — its leases were already re-dispatched — and a restarted process
 registers under a new (pid-derived) worker id instead.
+
+**Leader leases** (PR 16) apply the same three disciplines one layer
+up, to the coordinator itself.  The fleet **generation** is the
+coordinator-level fencing epoch: every coordinator that ever leads this
+board wins exactly one generation by claiming ``leader/g<gen>`` through
+the board's single-winner ``claim`` primitive, renews a beat value on
+every pump tick, and stamps its generation into every block id it
+offers.  A ``--fleet-standby`` process watches the newest generation's
+beat exactly the way :class:`Membership` watches worker heartbeats —
+value *change* under a tick-counted deadline — and on a stale verdict
+races ``claim`` on the NEXT generation; the winner replays the dead
+leader's board checkpoint (:func:`read_checkpoint`) and every key the
+dead leader ever posted is now a fenced lower generation, swept by the
+new leader's board GC.  Death is terminal here too: a deposed leader
+(one that observes a higher generation claim) must stop answering —
+:class:`~..serve.fleet.FleetCoordinator` raises on the next pump.
 """
 
 from __future__ import annotations
@@ -50,6 +66,7 @@ from ..obs.events import publish
 #: Board key namespace.  One fleet per board: for FileBoard fleets the
 #: board *directory* is the run scope, so no run tag is needed here.
 _ROOT = "seqalign/fleet"
+FLEET_PREFIX = f"{_ROOT}/"  # everything the board GC may ever sweep
 WORKER_PREFIX = f"{_ROOT}/worker/"
 OFFER_PREFIX = f"{_ROOT}/offer/"
 
@@ -76,6 +93,41 @@ def result_key(bid: str, epoch: int) -> str:
 
 def shutdown_key() -> str:
     return f"{_ROOT}/shutdown"
+
+
+#: Leader-lease key namespace: one claim key per generation (the
+#: single-winner record), one beat key per generation (liveness), one
+#: checkpoint key per generation (the takeover's replay state).
+LEADER_PREFIX = f"{_ROOT}/leader/"
+
+
+def leader_claim_key(gen: int) -> str:
+    return f"{LEADER_PREFIX}g{int(gen)}"
+
+
+def leader_beat_key(gen: int) -> str:
+    return f"{_ROOT}/leaderhb/g{int(gen)}"
+
+
+def ckpt_key(gen: int) -> str:
+    return f"{_ROOT}/ckpt/g{int(gen)}"
+
+
+def current_generation(board) -> int:
+    """The newest leader generation ever claimed on this board (-1 on a
+    board no coordinator has led yet).  A scan, not a counter post: the
+    claim keys themselves are the authoritative monotonic record, so
+    there is no torn-counter state to reconcile after a crash."""
+    best = -1
+    for key in board.keys(LEADER_PREFIX):
+        name = key[len(LEADER_PREFIX):]
+        if not name.startswith("g"):
+            continue
+        try:
+            best = max(best, int(name[1:]))
+        except ValueError:
+            continue
+    return best
 
 
 def board_read_json(board, key: str) -> dict | None:
@@ -241,3 +293,140 @@ class LeaseTable:
             lease for lease in self._leases.values()
             if lease.holder == str(wid)
         ]
+
+
+class LeaderLease:
+    """The coordinator-level lease: exactly one leader per generation.
+
+    Leader side: :meth:`acquire` wins the next free generation (board
+    ``claim`` — the same ``os.link`` single-winner primitive worker
+    leases ride), :meth:`renew` posts the beat every pump tick, and
+    :meth:`deposed` detects a successor (any higher-generation claim).
+
+    Standby side: :meth:`observe` is one watch tick — the same
+    change-under-a-tick-counted-deadline liveness rule as worker
+    heartbeats (SEQ005: the caller supplies the tick number; wall time
+    never decides).  A leader whose beat value has not changed for
+    ``deadline_ticks`` observed ticks — including one that died before
+    its first beat ever landed — earns a dead verdict, and the standby
+    races :meth:`try_acquire` on the NEXT generation.  Losing that race
+    is not an error: a rival standby won, and the watch simply restarts
+    against the new leader's beat.
+    """
+
+    def __init__(self, board, lid: str, deadline_ticks: int):
+        if deadline_ticks < 1:
+            raise ValueError(
+                f"leader deadline must be >= 1 tick, got {deadline_ticks}"
+            )
+        self.board = board
+        self.lid = str(lid)
+        self.deadline_ticks = int(deadline_ticks)
+        self.gen: int | None = None  # the generation this lease holds
+        self._beat = 0
+        # Standby watch state: the generation under watch, the last beat
+        # value read, and the tick that value last changed.
+        self._watch_gen: int | None = None
+        self._watch_beat: str | None = None
+        self._watch_tick = 0
+
+    # -- leader side -------------------------------------------------------
+
+    def try_acquire(self, gen: int) -> bool:
+        """One claim attempt on one specific generation — the standby
+        race's unit.  Exactly one claimer wins; the loser keeps
+        watching."""
+        won = self.board.claim(
+            leader_claim_key(gen),
+            json.dumps({"lid": self.lid, "gen": int(gen)}),
+        )
+        if won:
+            self.gen = int(gen)
+            self.renew()
+            publish("leader.elected", leader=self.lid, gen=int(gen))
+        return won
+
+    def acquire(self) -> int:
+        """Startup acquisition: claim the next free generation.  Bounded
+        retries cover the startup race where several coordinators scan
+        the same maximum — each retry re-scans, so the loop terminates
+        as soon as this process stops losing."""
+        for _ in range(64):
+            if self.try_acquire(current_generation(self.board) + 1):
+                return self.gen
+        raise RuntimeError(
+            "could not win a fleet leader generation after 64 claim "
+            "attempts (a claim storm this deep means the board is sick)"
+        )
+
+    def renew(self) -> None:
+        """Post the next beat value (leader liveness).  Best-effort on a
+        sick board: one missed beat is indistinguishable from a slow
+        tick; a board that stays unwritable earns this leader the same
+        dead verdict a crash would."""
+        self._beat += 1
+        try:
+            self.board.post(leader_beat_key(self.gen), str(self._beat))
+        except OSError:
+            pass
+
+    def deposed(self) -> bool:
+        """Has any successor generation been claimed?  The deposed
+        leader must stop answering — its late posts are fenced by
+        generation exactly as a zombie worker's are by epoch."""
+        return self.gen is not None and current_generation(self.board) > self.gen
+
+    # -- standby side ------------------------------------------------------
+
+    def watched_gen(self) -> int | None:
+        """The generation currently under watch (None before any leader
+        has claimed)."""
+        return self._watch_gen
+
+    def observe(self, tick: int) -> bool:
+        """One standby watch tick; True when the watched leader's beat
+        has been frozen (or absent) for ``deadline_ticks`` ticks.  A new
+        claim — even mid-countdown — restarts the watch against the new
+        generation: the verdict always names the NEWEST leader."""
+        tick = int(tick)
+        gen = current_generation(self.board)
+        if gen < 0:
+            # No leader has ever claimed: nothing to succeed.  A standby
+            # is a coordinator-in-WAITING; it never seizes a virgin board.
+            self._watch_gen = None
+            return False
+        raw = self.board.get(leader_beat_key(gen))
+        beat = raw.strip() if raw is not None and raw.strip() else None
+        if gen != self._watch_gen:
+            self._watch_gen = gen
+            self._watch_beat = beat
+            self._watch_tick = tick
+            return False
+        if beat is not None and beat != self._watch_beat:
+            self._watch_beat = beat
+            self._watch_tick = tick
+            return False
+        return tick - self._watch_tick >= self.deadline_ticks
+
+
+def write_checkpoint(board, gen: int, state: dict) -> None:
+    """Post one coordinator state checkpoint (atomic board post).  The
+    caller (FleetCoordinator) owns change-detection; OSError is the
+    caller's to absorb — a leader that cannot checkpoint keeps serving
+    and keeps its --journal authoritative."""
+    board.post(ckpt_key(gen), json.dumps(state))
+
+
+def read_checkpoint(board, gen: int) -> dict | None:
+    """Read generation ``gen``'s coordinator checkpoint with the full
+    torn-post guarantee plus shape validation: anything that is not a
+    JSON object carrying list-valued ``requests``/``answered`` reads as
+    missing — a takeover replays nothing rather than garbage."""
+    post = board_read_json(board, ckpt_key(gen))
+    if post is None:
+        return None
+    if not isinstance(post.get("requests"), list):
+        return None
+    if not isinstance(post.get("answered"), list):
+        return None
+    return post

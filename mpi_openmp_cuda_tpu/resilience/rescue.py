@@ -31,6 +31,7 @@ the scatter semantics but makes the gather survivable:
 
 from __future__ import annotations
 
+import errno
 import json
 import os
 import threading
@@ -39,6 +40,7 @@ import numpy as np
 
 from ..obs.events import log_line, publish
 from .degrade import BackendDegrader, run_degrading
+from .faults import scheduled as _fault_scheduled
 
 
 def shard_index_sets(total: int, parts: int) -> list[list[int]]:
@@ -144,10 +146,31 @@ class FileBoard:
             f"{self._TMP}{os.path.basename(path)}"
             f".{os.getpid()}.{threading.get_ident()}",
         )
-        with open(tmp, "w", encoding="utf-8") as fh:
-            fh.write(value)
-            fh.flush()
-            os.fsync(fh.fileno())
+        try:
+            with open(tmp, "w", encoding="utf-8") as fh:
+                if _fault_scheduled("board:enospc"):
+                    # Modelled disk-full: half the bytes land, then the
+                    # write fails — the worst torn-tmp shape.  The final
+                    # key must still read as missing (the tmp never
+                    # reaches os.replace/os.link) and the orphan must
+                    # not leak.
+                    fh.write(value[: len(value) // 2])
+                    fh.flush()
+                    raise OSError(
+                        errno.ENOSPC, "injected: no space left on device"
+                    )
+                fh.write(value)
+                fh.flush()
+                os.fsync(fh.fileno())
+        except OSError:
+            # A failed staging write (ENOSPC, quota, I/O error) must not
+            # leak the tmp orphan: the caller sees the post as never
+            # having happened, and the board directory stays clean.
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
         return tmp
 
     def post(self, key: str, value: str) -> None:
@@ -196,6 +219,25 @@ class FileBoard:
                 if key.startswith(prefix):
                     out.append(key)
         return sorted(out)
+
+    def sweep_orphans(self) -> int:
+        """Unlink every ``.tmp.`` orphan under the board root — the debris
+        a writer killed mid-post leaves behind.  Readers already skip
+        these, so this is pure hygiene (the fleet-chaos no-stale-keys
+        gate).  Racing a LIVE writer is safe: its ``os.replace`` on an
+        unlinked tmp raises OSError, which every board writer absorbs
+        and retries as a lost post."""
+        swept = 0
+        for dirpath, _dirs, files in os.walk(self.root):
+            for name in files:
+                if not name.startswith(self._TMP):
+                    continue
+                try:
+                    os.unlink(os.path.join(dirpath, name))
+                    swept += 1
+                except OSError:
+                    pass
+        return swept
 
 
 class CoordinationBoard:

@@ -23,12 +23,31 @@ The failure model (docs/ARCHITECTURE.md §8.6):
   the lease expires and the block is re-dispatched;
 * with NO live workers, every block — new or orphaned — scores locally
   on the coordinator through the PR-1 degrade chain.  The fleet is an
-  accelerator, never an availability dependency.
+  accelerator, never an availability dependency;
+* a superblock whose lease keeps expiring does not re-offer forever:
+  the fencing epoch doubles as the attempt counter, and past
+  ``SEQALIGN_FLEET_MAX_REDISPATCH`` bumps the block takes the typed
+  **dead-letter** path — scored locally through the serve loop's
+  quarantine ladder (retry → degrade → poison bisection), so a
+  poisoned request is *answered* (``{"id", "error": "poisoned"}``),
+  never orbited.
 
-All membership/lease decisions are tick-counted (one coordinator board
-poll = one tick); wall time only paces polls through the injectable
-:class:`~.clock.ServeClock`, so unit tests drive everything with a fake
-clock and zero sleeps.
+**Coordinator failover** (PR 16) extends the same model one layer up.
+The coordinator holds a :class:`~..resilience.membership.LeaderLease`:
+it claims a fleet **generation** at startup, renews a beat on every
+pump tick, stamps its generation into every block id (``g<gen>b<seq>``),
+and checkpoints its unanswered requests + answered reply ids to the
+board.  A ``--fleet-standby`` process (:func:`standby_wait`) watches
+the newest generation's beat with the worker-heartbeat staleness rule;
+when the leader goes silent, the standby claims the next generation,
+replays the checkpoint, and re-answers only what was never answered —
+exactly-once across ``kill -9`` at tick boundaries.  A deposed leader
+(one that observes a higher generation) raises
+:class:`LeadershipLostError` on its next pump *before* collecting or
+demuxing anything, and its late board posts are fenced by generation —
+counted by the new leader's board GC, never read.  The GC also keeps
+the board bounded: retired-epoch debris and dead generations' keys are
+swept each tick past a grace window.
 """
 
 from __future__ import annotations
@@ -36,6 +55,7 @@ from __future__ import annotations
 import collections
 import json
 import os
+import re
 import threading
 
 import numpy as np
@@ -46,10 +66,12 @@ from ..resilience.drain import drain_requested
 from ..resilience.faults import fire as _fault_fire
 from ..resilience.faults import scheduled as _fault_scheduled
 from ..resilience.membership import (
+    FLEET_PREFIX,
     OFFER_PREFIX,
     LeaseTable,
     Membership,
     board_read_json,
+    ckpt_key,
     claim_key,
     heartbeat_key,
     offer_key,
@@ -64,6 +86,35 @@ from .clock import ServeClock
 _POLL_S = 0.05
 
 
+def lease_ticks_for(lease_s=None, poll_s=_POLL_S) -> int:
+    """The one lease-window formula, shared by the coordinator's worker
+    leases and the standby's leader-watch deadline — a takeover must
+    land within the same window a worker death verdict does."""
+    if lease_s is None:
+        lease_s = env_float("SEQALIGN_LEASE_S", 2.0)
+    return max(2, round(float(lease_s) / float(poll_s)))
+
+
+def _gen_of(name: str) -> int | None:
+    """Parse a ``g<gen>`` key segment (leader/leaderhb/ckpt names)."""
+    if not name.startswith("g"):
+        return None
+    try:
+        return int(name[1:])
+    except ValueError:
+        return None
+
+
+def _epoch_of(name: str) -> int | None:
+    """Parse an ``e<epoch>`` key segment (claim/result leaf names)."""
+    if not name.startswith("e"):
+        return None
+    try:
+        return int(name[1:])
+    except ValueError:
+        return None
+
+
 def _pause(clock, seconds: float, predicate=None) -> None:
     """Bounded wait through the injectable clock (SEQ007: the ServeClock
     is the one legal wait seam).  A fresh local Condition per wait —
@@ -72,6 +123,14 @@ def _pause(clock, seconds: float, predicate=None) -> None:
     cond = threading.Condition()
     with cond:
         clock.block_until(cond, predicate or (lambda: False), seconds)
+
+
+class LeadershipLostError(RuntimeError):
+    """This coordinator observed a higher leader generation: a standby
+    took over.  The deposed leader must stop — answering anything after
+    this point could double a reply the successor is about to give.
+    Raised from ``pump()`` before any collect/demux, so the answer
+    window of a zombie leader is bounded by one board poll."""
 
 
 class FleetCoordinator:
@@ -98,15 +157,15 @@ class FleetCoordinator:
         clock=None,
         lease_s=None,
         poll_s=_POLL_S,
+        leader=None,
+        max_redispatch=None,
     ):
         self.board = board
         self.clock = clock or ServeClock()
         self._local_score = local_score
         self._demux = demux
-        if lease_s is None:
-            lease_s = env_float("SEQALIGN_LEASE_S", 2.0)
         self.poll_s = float(poll_s)
-        self.lease_ticks = max(2, round(float(lease_s) / self.poll_s))
+        self.lease_ticks = lease_ticks_for(lease_s, self.poll_s)
         self.membership = Membership(board, deadline_ticks=self.lease_ticks)
         self.leases = LeaseTable(self.lease_ticks)
         self.expected = env_int("SEQALIGN_FLEET_WORKERS", 0)
@@ -117,6 +176,25 @@ class FleetCoordinator:
         self._last_poll = None
         self._fenced_seen: set[str] = set()
         self._retired = collections.deque(maxlen=self._RETIRED_PROBE)
+        # Failover state (PR 16).  ``leader`` is the held LeaderLease, or
+        # None for a leaderless coordinator (unit tests, the in-memory
+        # interleave scenarios) — which behaves as generation 0 with no
+        # beat, no deposition, and no checkpointing.
+        self.leader = leader
+        self.gen = (
+            leader.gen if leader is not None and leader.gen is not None else 0
+        )
+        if max_redispatch is None:
+            max_redispatch = env_int("SEQALIGN_FLEET_MAX_REDISPATCH", 5)
+        self.max_redispatch = int(max_redispatch)
+        self.gc_ticks = (
+            env_int("SEQALIGN_FLEET_GC_TICKS", 0) or 2 * self.lease_ticks
+        )
+        self._deposed = False
+        self._zombie_leader = False  # chaos: freeze the beat, earn deposition
+        self._gc_marks: dict[str, int] = {}  # sweepable key -> tick marked
+        self._gc_fenced: set[str] = set()  # stale-gen keys already counted
+        self._ckpt_blob: str | None = None  # change-cache for checkpoint()
 
     # -- dispatch side -----------------------------------------------------
 
@@ -131,12 +209,22 @@ class FleetCoordinator:
     def offer(self, block) -> str:
         """Put one planned superblock on the board under a fresh lease.
         Only the scoring payload crosses the board — session tags (live
-        object references) stay coordinator-side, keyed by block id."""
+        object references) stay coordinator-side, keyed by block id.
+
+        Block ids are generation-scoped (``g<gen>b<seq>``): a successor
+        leader restarting its sequence at 1 must never collide with the
+        dead leader's keys — those are fenced debris, not its namespace.
+
+        The post happens BEFORE any lease state exists: on a board that
+        cannot take the write (ENOSPC), the raised OSError propagates to
+        the dispatcher with nothing to unwind, and the serve loop's
+        quarantine ladder scores the block instead.
+        """
+        bid = f"g{self.gen}b{self._seq + 1}"
+        self._post_offer(bid, 0, block)  # a fresh lease starts at epoch 0
         self._seq += 1
-        bid = f"b{self._seq}"
         self.blocks[bid] = block
-        lease = self.leases.issue(bid, self._tick)
-        self._post_offer(bid, lease.epoch, block)
+        self.leases.issue(bid, self._tick)
         return bid
 
     def _post_offer(self, bid: str, epoch: int, block) -> None:
@@ -169,6 +257,33 @@ class FleetCoordinator:
         self._last_poll = self.clock.now()
         self._tick += 1
         tick = self._tick
+        # kill:fleet-coordinator rides this fire point: SIGKILL at the
+        # pump-tick boundary, after the previous tick's checkpoint
+        # landed — the standby-takeover chaos tier.
+        _fault_fire("fleet_pump")
+        if self.leader is not None:
+            if _fault_scheduled("zombie:fleet-leader"):
+                self._zombie_leader = True
+                log_line(
+                    "mpi_openmp_cuda_tpu: fleet: leader "
+                    f"gen {self.gen} going zombie — beat frozen (chaos)"
+                )
+            # Deposition check FIRST, before renew and before any
+            # collect/demux: a zombie leader's answer window is one poll.
+            if self.leader.deposed():
+                self._deposed = True
+                publish(
+                    "leader.deposed", gen=self.gen, leader=self.leader.lid
+                )
+                log_line(
+                    f"mpi_openmp_cuda_tpu: fleet: leader gen {self.gen} "
+                    "deposed by a higher generation; stopping"
+                )
+                raise LeadershipLostError(
+                    f"fleet leader generation {self.gen} was superseded"
+                )
+            if not self._zombie_leader:
+                self.leader.renew()
         joined, died = self.membership.observe(tick)
         for wid in joined:
             log_line(
@@ -210,6 +325,7 @@ class FleetCoordinator:
                 "re-dispatching"
             )
             self._redispatch(lease.bid, "lease-expired")
+        self._gc(tick)
         obs_gauge("fleet_workers", self.membership.live_count())
 
     def membership_held(self, wid: str):
@@ -290,9 +406,36 @@ class FleetCoordinator:
 
     def _redispatch(self, bid: str, reason: str) -> None:
         epoch = self.leases.bump(bid, self._tick)
+        # The fencing epoch IS the attempt counter: epoch N means N
+        # offers already failed.  Past the cap, the block takes the
+        # typed dead-letter path — scored locally through the serve
+        # loop's quarantine ladder (retry → degrade → poison bisection),
+        # so a block no worker can ever finish still gets each of its
+        # requests a terminal answer instead of re-offering forever.
+        if epoch > self.max_redispatch:
+            publish(
+                "fleet.deadletter", block=bid, epoch=epoch, reason=reason
+            )
+            log_line(
+                f"mpi_openmp_cuda_tpu: fleet: {bid} exhausted "
+                f"{self.max_redispatch} re-dispatch attempts "
+                f"(last: {reason}); dead-lettering to the local "
+                "quarantine ladder"
+            )
+            self._finish_local(bid)
+            return
         publish("fleet.redispatch", block=bid, epoch=epoch, reason=reason)
         if self.membership.live_count() > 0:
-            self._post_offer(bid, epoch, self.blocks[bid])
+            try:
+                self._post_offer(bid, epoch, self.blocks[bid])
+            except OSError:
+                # Unpostable board (ENOSPC): the lease stays bumped, so
+                # the next expiry retries the post — and the attempt cap
+                # above still bounds the loop.
+                log_line(
+                    f"mpi_openmp_cuda_tpu: fleet: re-offer of {bid} "
+                    "failed to post; will retry at next lease expiry"
+                )
             return
         log_line(
             f"mpi_openmp_cuda_tpu: fleet: no live workers for {bid}; "
@@ -320,13 +463,170 @@ class FleetCoordinator:
             self.leases.bump(bid, self._tick)
             self._finish_local(bid)
 
+    # -- failover: checkpoint + board GC -----------------------------------
+
+    def checkpoint(self, raws, answered) -> None:
+        """Post the takeover replay state: every admitted-but-unanswered
+        request (raw dicts, replayable through ``ingest``) plus the
+        answered reply ids (the successor's idempotency set).  Change-
+        cached — a quiet tick costs no board write — and best-effort on
+        a sick board: the ``--journal`` file stays authoritative for
+        same-process resume; this board copy is the one a STANDBY can
+        reach."""
+        if self.leader is None:
+            return
+        blob = json.dumps({
+            "gen": self.gen,
+            "requests": list(raws),
+            "answered": list(answered),
+        })
+        if blob == self._ckpt_blob:
+            return
+        try:
+            self.board.post(ckpt_key(self.gen), blob)
+            self._ckpt_blob = blob
+        except OSError:
+            pass
+
+    @staticmethod
+    def _bid_gen(bid: str) -> int:
+        """The leader generation stamped into a block id
+        (``g<gen>b<seq>``); ids without a stamp read as generation 0."""
+        m = re.match(r"^g(\d+)b", bid)
+        return int(m.group(1)) if m else 0
+
+    def _gc_verdict(self, rel: str) -> str:
+        """Classify one board key (relative to the fleet root):
+        ``keep``, ``sweep`` (delete past the grace window), or ``fence``
+        (sweep + count once as a dead generation's fenced post)."""
+        parts = rel.split("/")
+        kind = parts[0]
+        if kind in ("worker", "hb"):
+            view = self.membership.workers.get(parts[-1])
+            if view is not None and not view.alive:
+                return "sweep"  # a dead worker's registration/beat
+            return "keep"  # live, or not yet observed (still joining)
+        if kind in ("leader", "leaderhb", "ckpt"):
+            gen = _gen_of(parts[-1])
+            if gen is not None and gen < self.gen:
+                return "sweep"  # a retired generation's record
+            return "keep"
+        if kind in ("offer", "claim", "result"):
+            bid = parts[1] if len(parts) > 1 else ""
+            gen = self._bid_gen(bid)
+            if gen > self.gen:
+                return "keep"  # a successor's namespace: never touch
+            if gen < self.gen:
+                return "fence"  # dead leader's debris: count, then sweep
+            if bid in self.blocks:
+                if kind == "offer":
+                    return "keep"
+                epoch = _epoch_of(parts[-1])
+                if epoch is not None and self.leases.admits(bid, epoch):
+                    return "keep"  # the live lease's claim/result keys
+                return "sweep"  # a fenced previous epoch's debris
+            return "sweep"  # retired bid: whatever it left is debris
+        return "keep"  # shutdown key, unknown shapes: not GC's business
+
+    def _gc(self, tick: int) -> None:
+        """Epoch-aware board GC, one pass per pump tick.  A key first
+        classified sweepable at tick T is deleted at T + ``gc_ticks``
+        (default two lease windows) — late enough that ``_fence_stale``
+        has counted any zombie post and a mid-join worker is not
+        confused, early enough that the board stays bounded across
+        leader generations."""
+        swept = 0
+        for key in self.board.keys(FLEET_PREFIX):
+            verdict = self._gc_verdict(key[len(FLEET_PREFIX):])
+            if verdict == "keep":
+                self._gc_marks.pop(key, None)
+                continue
+            if verdict == "fence" and key not in self._gc_fenced:
+                self._gc_fenced.add(key)
+                publish("leader.fenced", key=key, gen=self.gen)
+                log_line(
+                    "mpi_openmp_cuda_tpu: fleet: fenced dead-generation "
+                    f"post {key} (current gen {self.gen})"
+                )
+            mark = self._gc_marks.setdefault(key, tick)
+            if tick - mark >= self.gc_ticks:
+                self.board.delete(key)
+                self._gc_marks.pop(key, None)
+                swept += 1
+        if swept:
+            publish("board.gc", count=swept, gen=self.gen)
+
+    def gc_final(self) -> None:
+        """Clean-completion sweep (no grace): everything this run could
+        have left on the board EXCEPT the worker registry (workers are
+        still alive until the shutdown key lands), the shutdown key,
+        and the surviving generations' leader claim + beat — the
+        board's monotonic generation record.  This is what makes
+        ``make fleet-chaos``'s no-stale-keys gate hold without keeping
+        the loop alive for a grace window.
+
+        A zombie's stale post can land in the window between its
+        block's retirement and this sweep; probe the retired set one
+        last time so such a post is fence-COUNTED before it is
+        deleted, never silently swallowed."""
+        self._probe_retired()
+        swept = 0
+        for key in self.board.keys(FLEET_PREFIX):
+            parts = key[len(FLEET_PREFIX):].split("/")
+            if parts[0] in ("worker", "hb", "shutdown"):
+                continue
+            if parts[0] in ("leader", "leaderhb"):
+                gen = _gen_of(parts[-1])
+                if gen is None or gen >= self.gen:
+                    continue
+            self.board.delete(key)
+            swept += 1
+        sweep = getattr(self.board, "sweep_orphans", None)
+        if sweep is not None:
+            swept += int(sweep() or 0)
+        if swept:
+            publish("board.gc", count=swept, gen=self.gen, final=True)
+
     def shutdown(self) -> None:
         """End of run: tell workers to exit.  Best-effort — a worker
-        that never sees the key still exits on its own drain signal."""
+        that never sees the key still exits on its own drain signal.
+        A DEPOSED leader must not post it: the fleet belongs to the
+        successor now, and this key would kill ITS workers."""
+        if self._deposed:
+            return
         try:
             self.board.post(shutdown_key(), "shutdown")
         except OSError:
             pass
+
+
+def standby_wait(board, leader, clock, poll_s=_POLL_S):
+    """The ``--fleet-standby`` watch loop: poll the newest leader
+    generation's beat under the membership staleness rule until one of
+
+    * ``("takeover", gen)`` — the watched leader went silent for a full
+      deadline and THIS standby won the claim on generation ``gen + 1``
+      (``leader`` now holds it; the caller replays gen ``gen``'s
+      checkpoint and starts serving);
+    * ``("shutdown", None)`` — the fleet completed cleanly (the leader
+      posted the shutdown key): exit 0, nothing to take over;
+    * ``("drain", None)`` — this standby itself was drain-signalled.
+
+    Losing the takeover race is not an exit: a rival standby won, and
+    the watch simply restarts against the new leader's beat.
+    """
+    tick = 0
+    while True:
+        if drain_requested():
+            return ("drain", None)
+        if board.get(shutdown_key()) is not None:
+            return ("shutdown", None)
+        tick += 1
+        if leader.observe(tick):
+            watched = leader.watched_gen()
+            if leader.try_acquire(watched + 1):
+                return ("takeover", watched)
+        _pause(clock, poll_s, drain_requested)
 
 
 class FleetWorker:
@@ -363,7 +663,13 @@ class FleetWorker:
 
     def heartbeat(self) -> None:
         self._beat += 1
-        self.board.post(heartbeat_key(self.wid), str(self._beat))
+        try:
+            self.board.post(heartbeat_key(self.wid), str(self._beat))
+        except OSError:
+            # A board that cannot take the beat (ENOSPC) earns this
+            # worker the same death verdict a crash would — the correct
+            # outcome, reached without killing the heartbeat thread.
+            pass
 
     def should_exit(self) -> bool:
         return (
@@ -468,7 +774,18 @@ class FleetWorker:
             # MISSING; the lease expires and the block re-dispatches.
             self.board.post(result_key(bid, epoch), payload[: len(payload) // 2])
             return
-        self.board.post(result_key(bid, epoch), payload)
+        try:
+            self.board.post(result_key(bid, epoch), payload)
+        except OSError as e:
+            # Disk-full mid-post: the key reads as missing (the atomic
+            # post never completed), so the lease expiry re-dispatches —
+            # the same recovery as a worker death, minus the death.
+            log_line(
+                f"mpi_openmp_cuda_tpu: fleet: worker {self.wid}: result "
+                f"post for {bid} failed ({e}); leaving it to lease "
+                "re-dispatch"
+            )
+            return
         if zombie:
             # The stale post landed (it MUST read as fenced); a declared-
             # dead worker has no further business claiming fresh work.

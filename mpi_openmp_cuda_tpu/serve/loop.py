@@ -65,7 +65,9 @@ mutation happen on the main loop thread.
 
 from __future__ import annotations
 
+import collections
 import json
+import os
 import socket as socketlib
 import struct
 import sys
@@ -160,6 +162,12 @@ class ServeLoop:
         # plus the last journal body written (skip no-op rewrites).
         self._inflight: list[tuple] = []
         self._journal_state: str | None = None
+        # Answered reply ids (bounded: the deque evicts, the set mirrors
+        # it for O(1) lookup).  As fleet leader these ride the board
+        # checkpoint — the successor's idempotency set — and make
+        # reconnect-and-redrive duplicates answerable without rescoring.
+        self._answered: collections.deque = collections.deque(maxlen=4096)
+        self._answered_set: set[str] = set()
 
     # -- ingest (reader threads and the main-thread stdin loop) -----------
 
@@ -186,6 +194,22 @@ class ServeLoop:
             # "trace"}) answer inline from the live plane — never queued,
             # never priced against the admission bucket.
             self._telemetry(str(cmd), responder)
+            return
+        rid = raw.get("id")
+        if (
+            self.fleet is not None
+            and self.fleet.leader is not None
+            and rid is not None
+            and str(rid) in self._answered_set
+        ):
+            # Reconnect-and-redrive idempotency: this id was already
+            # answered — by this leader, or (via the checkpoint's
+            # answered set) by the dead one.  A typed duplicate notice
+            # instead of a rescore; advisory here, authoritative at
+            # takeover replay.  Anonymous requests (no id) cannot be
+            # deduplicated across a failover — documented at-least-once.
+            publish("serve.request.duplicate", id=str(rid))
+            responder.send({"id": rid, "duplicate": True})
             return
         verdict = self.queue.submit(raw, responder)
         if verdict == ADMIT_FULL:
@@ -538,20 +562,46 @@ class ServeLoop:
             or (self.fleet is not None and self.fleet.outstanding() > 0)
         )
 
+    def _note_answered(self, rid: str) -> None:
+        """Record one answered reply id in the bounded dedupe window."""
+        if rid in self._answered_set:
+            return
+        if len(self._answered) == self._answered.maxlen:
+            self._answered_set.discard(self._answered[0])
+        self._answered.append(rid)
+        self._answered_set.add(rid)
+
     def _journal_live(self) -> None:
         """Rewrite the serve journal (whole-file atomic) with every
         admitted-but-unanswered raw request — in-flight first (older),
         then still-queued — skipping the write when nothing changed.
         The drain path's :func:`journal_drained` call stays the final
         authoritative write; this keeps the file honest BETWEEN drains
-        so ``kill -9`` + ``--resume`` loses and doubles nothing."""
-        if self.journal_path is None:
+        so ``kill -9`` + ``--resume`` loses and doubles nothing.
+
+        The same checkpoint, as fleet LEADER, also goes to the board
+        (:meth:`.fleet.FleetCoordinator.checkpoint`): unanswered raws
+        plus the answered-id set — everything a standby needs to take
+        over with zero dropped and zero duplicated replies."""
+        kept = []
+        for sess, raw in self._inflight:
+            if not sess.closed:
+                kept.append((sess, raw))
+                continue
+            if sess.answered:
+                rid = raw.get("id")
+                if rid is not None:
+                    self._note_answered(str(rid))
+        self._inflight = kept
+        fleet_leader = self.fleet is not None and self.fleet.leader is not None
+        if self.journal_path is None and not fleet_leader:
             return
-        self._inflight = [
-            (sess, raw) for (sess, raw) in self._inflight if not sess.closed
-        ]
         raws = [raw for (_sess, raw) in self._inflight]
         raws += self.queue.snapshot_raws()
+        if fleet_leader:
+            self.fleet.checkpoint(raws, self._answered)
+        if self.journal_path is None:
+            return
         state = json.dumps(raws)
         if state == self._journal_state:
             return
@@ -660,6 +710,82 @@ def _accept_loop(loop: ServeLoop, sock) -> None:
         ).start()
 
 
+def _standby_phase(loop: ServeLoop, board, leader, out_responder) -> bool:
+    """The ``--fleet-standby`` serve phase: watch the active leader's
+    beat until a verdict.  Returns True once THIS process holds the
+    leadership (the caller then runs the normal tick loop as the
+    successor coordinator) and False on a clean exit — the fleet shut
+    down, or this standby was drain-signalled while empty.
+
+    Takeover sequence (all before the first tick): claim the next
+    generation (done inside ``standby_wait``), build the successor
+    coordinator, seed the answered-id set from the dead leader's
+    checkpoint, and re-ingest its unanswered raw requests through the
+    normal admission path.  The answered set makes the replay — and any
+    client redriving its own requests afterwards — idempotent: zero
+    dropped, zero duplicated reply lines.
+    """
+    from ..resilience.membership import read_checkpoint
+    from .fleet import FleetCoordinator, standby_wait
+
+    verdict, watched = standby_wait(board, leader, loop.clock)
+    if verdict != "takeover":
+        log_line(
+            f"mpi_openmp_cuda_tpu: serve: standby exiting ({verdict}): "
+            "nothing to take over"
+        )
+        if verdict == "drain" and loop.queue.depth() > 0:
+            loop._drain(())  # raises DrainInterrupt → the CLI's exit 75
+        return False
+    publish(
+        "leader.takeover", gen=leader.gen, prev=watched, leader=leader.lid
+    )
+    obs_gauge("fleet_leader_epoch", leader.gen)
+    log_line(
+        f"mpi_openmp_cuda_tpu: serve: standby took over as leader gen "
+        f"{leader.gen} (gen {watched} went silent)"
+    )
+    loop.fleet = FleetCoordinator(
+        board,
+        local_score=loop._fleet_fallback,
+        demux=loop._demux,
+        clock=loop.clock,
+        leader=leader,
+    )
+    obs_gauge("fleet_workers", 0)
+    ckpt = read_checkpoint(board, watched)
+    if ckpt is None:
+        log_line(
+            "mpi_openmp_cuda_tpu: serve: no readable checkpoint from "
+            f"gen {watched}; serving fresh traffic only"
+        )
+        return True
+    for rid in ckpt["answered"]:
+        loop._note_answered(str(rid))
+    replayed = 0
+    loop.queue.open_source()
+    try:
+        for raw in ckpt["requests"]:
+            if not isinstance(raw, dict):
+                continue
+            rid = raw.get("id")
+            if rid is not None and str(rid) in loop._answered_set:
+                continue  # the dead leader answered it; don't re-reply
+            loop.ingest(json_dumps_line(raw), out_responder)
+            replayed += 1
+    finally:
+        loop.queue.close_source()
+    log_line(
+        f"mpi_openmp_cuda_tpu: serve: replayed {replayed} unanswered "
+        f"request(s) from gen {watched}'s checkpoint "
+        f"({len(ckpt['answered'])} already answered)"
+    )
+    # Re-checkpoint under OUR generation before the first tick: a kill
+    # during takeover must not lose what we just admitted.
+    loop._journal_live()
+    return True
+
+
 def run_serve(args, timer, policy, deg, out_stream=None, prewarmed=False) -> int:
     """CLI entry for ``--serve`` (called with the observability plane,
     faults, watchdog, and drain guard already armed by ``run()``).
@@ -695,21 +821,41 @@ def run_serve(args, timer, policy, deg, out_stream=None, prewarmed=False) -> int
     )
     if prewarmed:
         loop.baseline_steady()
+    standby = bool(getattr(args, "fleet_standby", False))
+    board = None
+    leader = None
     if getattr(args, "fleet_board", None):
+        from ..resilience.membership import LeaderLease, shutdown_key
         from ..resilience.rescue import FileBoard
-        from .fleet import FleetCoordinator
+        from .fleet import FleetCoordinator, lease_ticks_for
 
-        loop.fleet = FleetCoordinator(
-            FileBoard(args.fleet_board),
-            local_score=loop._fleet_fallback,
-            demux=loop._demux,
-            clock=loop.clock,
-        )
-        obs_gauge("fleet_workers", 0)
-        log_line(
-            "mpi_openmp_cuda_tpu: serve: fleet coordinator on board "
-            f"{args.fleet_board!r} (lease {loop.fleet.lease_ticks} ticks)"
-        )
+        board = FileBoard(args.fleet_board)
+        leader = LeaderLease(board, f"c{os.getpid()}", lease_ticks_for())
+        if standby:
+            log_line(
+                "mpi_openmp_cuda_tpu: serve: standby watching board "
+                f"{args.fleet_board!r} (leader deadline "
+                f"{leader.deadline_ticks} ticks)"
+            )
+        else:
+            # A reused board may hold a finished run's shutdown key —
+            # it would retire this run's workers/standbys on sight.
+            board.delete(shutdown_key())
+            gen = leader.acquire()
+            obs_gauge("fleet_leader_epoch", gen)
+            loop.fleet = FleetCoordinator(
+                board,
+                local_score=loop._fleet_fallback,
+                demux=loop._demux,
+                clock=loop.clock,
+                leader=leader,
+            )
+            obs_gauge("fleet_workers", 0)
+            log_line(
+                "mpi_openmp_cuda_tpu: serve: fleet coordinator on board "
+                f"{args.fleet_board!r} as leader gen {gen} "
+                f"(lease {loop.fleet.lease_ticks} ticks)"
+            )
     out_responder = Responder(out_stream or sys.stdout)
     if args.journal:
         resumed = load_drained(args.journal)
@@ -745,25 +891,38 @@ def run_serve(args, timer, policy, deg, out_stream=None, prewarmed=False) -> int
             threading.Thread(
                 target=_accept_loop, args=(loop, sock), daemon=True
             ).start()
+        serving = True
         with timer.phase("serve"):
-            if not persistent or args.input is not None:
-                loop.queue.open_source()
-                try:
-                    with open_input(args.input) as stream:
-                        for line in stream:
-                            loop.ingest(line, out_responder)
-                            if drain_requested():
-                                break
-                finally:
-                    loop.queue.close_source()
-            while True:
-                alive = loop.tick()
-                if not persistent and not alive:
-                    break
-        if args.journal:
+            if standby:
+                serving = _standby_phase(loop, board, leader, out_responder)
+            if serving:
+                if (not persistent or args.input is not None) and not standby:
+                    loop.queue.open_source()
+                    try:
+                        with open_input(args.input) as stream:
+                            for line in stream:
+                                loop.ingest(line, out_responder)
+                                if drain_requested():
+                                    break
+                    finally:
+                        loop.queue.close_source()
+                    # Checkpoint the freshly-queued raws BEFORE the first
+                    # tick: a leader killed at its very first pump must
+                    # already have them on the board for the standby.
+                    loop._journal_live()
+                while True:
+                    alive = loop.tick()
+                    if not persistent and not alive:
+                        break
+        if serving and args.journal:
             # Clean completion: nothing pending — rewrite the journal
             # empty so a later --resume re-admits nothing.
             journal_drained(args.journal, [])
+        if serving and loop.fleet is not None:
+            # Force-sweep the board: a completed run leaves no offer/
+            # claim/result/checkpoint debris behind (fleet-chaos gates
+            # on this), only the generation record and worker registry.
+            loop.fleet.gc_final()
         timer.report()
         return 0
     finally:

@@ -176,6 +176,15 @@ class Session:
         """The client is gone (dead responder): nobody reads the rows."""
         return bool(getattr(self.responder, "dead", False))
 
+    @property
+    def answered(self) -> bool:
+        """Terminal AND a reply record went out (the done record or a
+        typed error) — everything except abandonment, where the vanished
+        client was sent nothing.  The fleet leader checkpoints answered
+        ids to the board so a takeover coordinator never re-answers a
+        request the dead leader already finished."""
+        return self._done and self.failed != "abandoned"
+
     def expired(self, now: float) -> bool:
         return self.deadline_t is not None and now > self.deadline_t
 

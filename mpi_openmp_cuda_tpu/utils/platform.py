@@ -292,6 +292,27 @@ ENV_VARS: tuple[EnvVar, ...] = (
         "declared dead and its claimed superblocks re-dispatched",
     ),
     EnvVar(
+        "SEQALIGN_FLEET_MAX_REDISPATCH",
+        "int",
+        5,
+        "re-dispatch attempts one fleet superblock may burn (the lease "
+        "epoch doubles as the counter) before the coordinator dead-"
+        "letters it to the local quarantine ladder (retry -> degrade -> "
+        "poison bisection), so an offer no worker can finish still "
+        "answers every request with a typed error instead of "
+        "re-offering forever",
+    ),
+    EnvVar(
+        "SEQALIGN_FLEET_GC_TICKS",
+        "int",
+        0,
+        "grace window, in coordinator board-poll ticks, before the "
+        "board GC sweeps a key classified as debris (retired epochs, "
+        "dead generations' posts, dead workers' registrations); 0 "
+        "means two lease windows — late enough that stale-post fencing "
+        "was counted first",
+    ),
+    EnvVar(
         "JAX_COORDINATOR_ADDRESS",
         "str",
         None,

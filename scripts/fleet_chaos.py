@@ -22,8 +22,20 @@ stalled along the way:
 * **lease-stall**: a worker claims and then never scores
   (``lease:stall``); lease expiry re-dispatches and the same worker
   completes the bumped epoch;
-* **usage**: ``--fleet-worker`` without ``--fleet-board`` is a hard
-  exit 64.
+* **coordinator-kill**: the fleet COORDINATOR is SIGKILLed at a pump
+  tick (``kill:fleet-coordinator``) with its superblock in flight; a
+  ``--fleet-standby`` process watches the leader beat, wins the next
+  generation, replays the dead leader's board checkpoint, re-offers,
+  and answers every request — replies byte-identical to the clean
+  fleetless baseline, the dead generation's board debris fenced and
+  swept;
+* **usage**: ``--fleet-worker`` (or ``--fleet-standby``) without
+  ``--fleet-board`` is a hard exit 64.
+
+Completed runs also gate board hygiene: after a clean exit the leader's
+final sweep (``gc_final``) must leave no offer/claim/result/checkpoint
+keys and no ``.tmp.`` orphans — only the worker registry, the shutdown
+beacon, and the generation record may survive.
 
 The coordinator must never crash and the SLO armor must stay quiet:
 every scenario also gates "no Traceback", ``shed_state == accept``, and
@@ -95,8 +107,23 @@ def _wait_registered(board, n, timeout_s=90.0) -> bool:
     return False
 
 
+def _parse_records(text, *, tolerant=False):
+    """ndjson stdout -> record dicts.  ``tolerant`` skips a torn final
+    line — the legitimate shape of a SIGKILLed coordinator's stdout."""
+    records = []
+    for line in text.splitlines():
+        if not line.strip():
+            continue
+        try:
+            records.append(json.loads(line))
+        except json.JSONDecodeError:
+            if not tolerant:
+                raise
+    return records
+
+
 def _run_coordinator(out_dir, name, *, board=None, faults=None,
-                     env_extra=None):
+                     env_extra=None, expect_kill=False):
     """One pipe-mode --serve subprocess (the fleet coordinator when
     ``board`` is set); returns (rc, records, report, stderr)."""
     reqfile = os.path.join(out_dir, f"{name}.ndjson")
@@ -119,11 +146,7 @@ def _run_coordinator(out_dir, name, *, board=None, faults=None,
     proc = subprocess.run(
         argv, cwd=REPO, env=env, capture_output=True, text=True, timeout=300
     )
-    records = [
-        json.loads(line)
-        for line in proc.stdout.splitlines()
-        if line.strip()
-    ]
+    records = _parse_records(proc.stdout, tolerant=expect_kill)
     report = None
     try:
         with open(report_path, encoding="utf-8") as fh:
@@ -192,6 +215,29 @@ def _counter_gates(name, report, wants, problems):
                 f"{name}: counters.{counter}: want >= {want}, got "
                 f"{c.get(counter, 0)}"
             )
+
+
+def _stale_key_gate(name, board, problems):
+    """Board hygiene after a completed run: ``gc_final`` must have swept
+    every offer/claim/result/checkpoint key and no torn ``.tmp.`` file
+    may survive anywhere — only the worker registry (worker/hb), the
+    shutdown beacon, and the leader generation record (leader/leaderhb)
+    are legitimate leftovers."""
+    root = os.path.join(board, "seqalign", "fleet")
+    keep = ("worker", "hb", "leader", "leaderhb", "shutdown")
+    leftovers = []
+    for dirpath, _dirs, files in os.walk(root):
+        for fname in files:
+            rel = os.path.relpath(os.path.join(dirpath, fname), root)
+            if fname.startswith(".tmp."):
+                leftovers.append(f"{rel} (torn tmp)")
+            elif rel.split(os.sep)[0] not in keep:
+                leftovers.append(rel)
+    if leftovers:
+        problems.append(
+            f"{name}: stale board keys survived the completed run: "
+            f"{sorted(leftovers)}"
+        )
 
 
 def baseline_run(out_dir, problems):
@@ -267,6 +313,7 @@ def scenario_kill_worker(out_dir, baseline, problems):
         "fleet_deaths": 1,
         "fleet_redispatches": 1,
     }, problems)
+    _stale_key_gate(name, board, problems)
 
 
 def scenario_zombie_fence(out_dir, baseline, problems):
@@ -302,13 +349,24 @@ def scenario_zombie_fence(out_dir, baseline, problems):
         "fleet_deaths": 1,
         "fleet_redispatches": 1,
     }, problems)
-    # The smoking gun: the stale epoch-0 result file IS on the board —
-    # and the byte-identical gate above already proved no client saw it.
-    stale = os.path.join(board, "seqalign", "fleet", "result", "b1", "e0")
-    if not os.path.exists(stale):
+    # The smoking gun, either face of it: the zombie's stale epoch-0
+    # post was fence-COUNTED by the coordinator (it landed before the
+    # final GC sweep, which probes retired blocks before deleting), OR
+    # the raw file is still on the board (it landed after the run
+    # completed, past any sweep).  The byte-identical gate above
+    # already proved no client saw it either way.  Block ids are
+    # generation-scoped since ISSUE 16.
+    fenced = 0
+    if report is not None:
+        fenced = int(report.get("counters", {}).get("fleet_fenced_posts", 0))
+    stale = os.path.join(
+        board, "seqalign", "fleet", "result", "g0b1", "e0"
+    )
+    if fenced < 1 and not os.path.exists(stale):
         problems.append(
-            f"{name}: expected the zombie's stale e0 result on the board "
-            f"at {stale}"
+            f"{name}: the zombie's stale e0 result was neither "
+            f"fence-counted (fleet_fenced_posts=0) nor left on the board "
+            f"at {stale} — did it ever post?"
         )
 
 
@@ -340,6 +398,7 @@ def scenario_torn_post(out_dir, baseline, problems):
         "fleet_lease_expiries": 1,
         "fleet_redispatches": 1,
     }, problems)
+    _stale_key_gate(name, board, problems)
 
 
 def scenario_lease_stall(out_dir, baseline, problems):
@@ -370,26 +429,131 @@ def scenario_lease_stall(out_dir, baseline, problems):
         "fleet_lease_expiries": 1,
         "fleet_redispatches": 1,
     }, problems)
+    _stale_key_gate(name, board, problems)
+
+
+def scenario_coordinator_kill(out_dir, baseline, problems):
+    """SIGKILL the fleet COORDINATOR with its superblock in flight; a
+    ``--fleet-standby`` process must win generation 1, replay the dead
+    leader's checkpoint, and answer BOTH requests — combined stdout
+    byte-identical to the clean fleetless baseline (zero duplicates,
+    zero losses), the dead generation's board debris fenced + swept.
+
+    Staging: ``kill:fleet-coordinator:fail=1,after=1`` fires at the
+    SECOND pump tick — tick 1 has already dispatched the superblock to
+    the board and checkpointed both requests as unanswered, tick 2 dies
+    before its collect.  The kill lands before any reply, so exactly-
+    once holds deterministically, not probabilistically."""
+    name = "coordinator-kill"
+    board = os.path.join(out_dir, f"{name}.board")
+    fleet_env = {
+        "SEQALIGN_LEASE_S": "2",
+        "SEQALIGN_FLEET_WORKERS": "1",
+    }
+    worker, worker_log = _spawn_worker(out_dir, board, name)
+    standby_out = open(os.path.join(out_dir, f"{name}.standby.ndjson"), "w+")
+    standby_log = open(os.path.join(out_dir, f"{name}.standby.log"), "w")
+    standby_report = os.path.join(out_dir, f"{name}.standby.report.json")
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    env.setdefault("SEQALIGN_BACKOFF_BASE", "0.01")
+    env.update(fleet_env)
+    standby = subprocess.Popen(
+        [
+            sys.executable, "-m", "mpi_openmp_cuda_tpu",
+            "--fleet-standby", "--fleet-board", board,
+            "--metrics-out", standby_report,
+        ],
+        cwd=REPO, env=env, stdout=standby_out, stderr=standby_log,
+    )
+    try:
+        if not _wait_registered(board, 1):
+            problems.append(f"{name}: worker never registered")
+            return
+        rc, records, report, stderr = _run_coordinator(
+            out_dir, name, board=board, env_extra=fleet_env,
+            faults="kill:fleet-coordinator:fail=1,after=1",
+            expect_kill=True,
+        )
+        try:
+            standby_rc = standby.wait(timeout=240)
+        except subprocess.TimeoutExpired:
+            standby.kill()
+            standby_rc = standby.wait()
+            problems.append(f"{name}: standby never completed the takeover")
+    finally:
+        worker_rc = _reap(worker, worker_log)
+        standby_out.seek(0)
+        standby_records = _parse_records(standby_out.read())
+        standby_out.close()
+        standby_log.close()
+    if rc != -signal.SIGKILL:
+        problems.append(
+            f"{name}: coordinator must die by SIGKILL, got rc {rc}"
+        )
+    if standby_rc != 0:
+        problems.append(
+            f"{name}: standby must exit 0 after serving, got rc "
+            f"{standby_rc}"
+        )
+    if worker_rc != 0:
+        problems.append(f"{name}: worker must exit clean, got rc {worker_rc}")
+    with open(os.path.join(out_dir, f"{name}.standby.log")) as fh:
+        standby_err = fh.read()
+    if "Traceback" in standby_err:
+        problems.append(f"{name}: standby crashed (Traceback on stderr)")
+    # The one promise: dead leader's replies + successor's replies,
+    # merged, are byte-identical to the clean baseline per id.
+    got = _by_id(records + standby_records)
+    if got != baseline:
+        problems.append(
+            f"{name}: combined coordinator+standby records must be "
+            f"byte-identical to the clean fleetless run; want {baseline}, "
+            f"got {got}"
+        )
+    sb_report = None
+    try:
+        with open(standby_report, encoding="utf-8") as fh:
+            sb_report = json.load(fh)
+    except (OSError, json.JSONDecodeError):
+        problems.append(f"{name}: no readable standby run report")
+    if sb_report is not None:
+        try:
+            validate_report(sb_report)
+        except ValueError as e:
+            problems.append(f"{name}: standby report: {e}")
+        if sb_report["gauges"].get("fleet_leader_epoch") != 1:
+            problems.append(
+                f"{name}: standby must lead generation 1, gauge says "
+                f"{sb_report['gauges'].get('fleet_leader_epoch')!r}"
+            )
+        _counter_gates(f"{name}(standby)", sb_report, {
+            "fleet_takeovers": 1,
+            "fleet_leader_fenced": 1,
+            "fleet_joins": 1,
+        }, problems)
+    _stale_key_gate(name, board, problems)
 
 
 def scenario_usage(out_dir, problems):
-    """--fleet-worker without --fleet-board: hard exit 64."""
+    """--fleet-worker / --fleet-standby without --fleet-board: exit 64."""
     name = "usage"
-    proc = subprocess.run(
-        [sys.executable, "-m", "mpi_openmp_cuda_tpu", "--fleet-worker"],
-        cwd=REPO, env=dict(os.environ, JAX_PLATFORMS="cpu"),
-        capture_output=True, text=True, timeout=120,
-    )
-    if proc.returncode != 64:
-        problems.append(
-            f"{name}: --fleet-worker without --fleet-board: want exit "
-            f"64, got {proc.returncode}"
+    for flag in ("--fleet-worker", "--fleet-standby"):
+        proc = subprocess.run(
+            [sys.executable, "-m", "mpi_openmp_cuda_tpu", flag],
+            cwd=REPO, env=dict(os.environ, JAX_PLATFORMS="cpu"),
+            capture_output=True, text=True, timeout=120,
         )
-    if "--fleet-board" not in proc.stderr:
-        problems.append(
-            f"{name}: stderr must name the missing flag, got: "
-            f"{proc.stderr.strip()[:200]}"
-        )
+        if proc.returncode != 64:
+            problems.append(
+                f"{name}: {flag} without --fleet-board: want exit "
+                f"64, got {proc.returncode}"
+            )
+        if "--fleet-board" not in proc.stderr:
+            problems.append(
+                f"{name}: {flag}: stderr must name the missing flag, "
+                f"got: {proc.stderr.strip()[:200]}"
+            )
 
 
 def main() -> int:
@@ -401,6 +565,7 @@ def main() -> int:
         scenario_zombie_fence(out_dir, baseline, problems)
         scenario_torn_post(out_dir, baseline, problems)
         scenario_lease_stall(out_dir, baseline, problems)
+        scenario_coordinator_kill(out_dir, baseline, problems)
     scenario_usage(out_dir, problems)
     if problems:
         for p in problems:
@@ -408,7 +573,8 @@ def main() -> int:
         return 1
     print(
         "fleet-chaos: OK (kill -9 redispatch, zombie fence, torn post, "
-        f"lease stall, usage gate; artifacts={out_dir})"
+        "lease stall, coordinator kill -9 -> standby takeover, "
+        f"usage gates; artifacts={out_dir})"
     )
     return 0
 

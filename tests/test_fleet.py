@@ -17,19 +17,36 @@ import numpy as np
 import pytest
 
 from mpi_openmp_cuda_tpu.obs import arm_observability, disarm_observability
+from mpi_openmp_cuda_tpu.resilience.faults import (
+    activate_faults,
+    deactivate_faults,
+)
 from mpi_openmp_cuda_tpu.resilience.membership import (
+    LeaderLease,
     LeaseTable,
     Membership,
     board_read_json,
+    ckpt_key,
     claim_key,
+    current_generation,
     heartbeat_key,
+    leader_beat_key,
+    leader_claim_key,
     offer_key,
+    read_checkpoint,
     result_key,
     shutdown_key,
     worker_key,
+    write_checkpoint,
 )
 from mpi_openmp_cuda_tpu.resilience.rescue import FileBoard, MemoryBoard
-from mpi_openmp_cuda_tpu.serve.fleet import FleetCoordinator, FleetWorker
+from mpi_openmp_cuda_tpu.serve.fleet import (
+    FleetCoordinator,
+    FleetWorker,
+    LeadershipLostError,
+    lease_ticks_for,
+    standby_wait,
+)
 
 
 class FakeClock:
@@ -528,3 +545,305 @@ def test_worker_scoring_failure_leaves_redispatch_to_lease(capsys):
     assert worker.step() is True  # the claim was attempted...
     assert board.get(result_key("b1", 0)) is None  # ...but nothing posted
     assert "leaving it to lease re-dispatch" in capsys.readouterr().err
+
+
+# -- leader lease + coordinator failover (ISSUE 16) --------------------------
+
+
+def test_lease_ticks_for_shares_the_worker_window():
+    assert lease_ticks_for(2.0, 1.0) == 2
+    assert lease_ticks_for(5.0, 1.0) == 5
+    assert lease_ticks_for(0.01, 0.05) == 2  # floor: never below 2 ticks
+
+
+def test_leader_lease_single_winner_per_generation():
+    board = MemoryBoard()
+    a = LeaderLease(board, "a", deadline_ticks=2)
+    b = LeaderLease(board, "b", deadline_ticks=2)
+    assert current_generation(board) == -1  # virgin board
+    assert a.acquire() == 0
+    assert b.try_acquire(0) is False  # generation 0 is taken, forever
+    assert json.loads(board.get(leader_claim_key(0)))["lid"] == "a"
+    assert b.acquire() == 1  # the next free generation
+    assert current_generation(board) == 1
+    assert a.deposed() is True  # any higher claim deposes
+    assert b.deposed() is False
+
+
+def test_standby_observe_frozen_beat_earns_takeover():
+    board = MemoryBoard()
+    lead = LeaderLease(board, "lead", deadline_ticks=2)
+    lead.acquire()
+    sb = LeaderLease(board, "sb", deadline_ticks=2)
+    assert not sb.observe(1)  # the watch starts against gen 0
+    lead.renew()
+    assert not sb.observe(2)  # beat changed: the countdown restarts
+    assert not sb.observe(3)  # frozen 1 tick: not yet
+    assert sb.observe(4)  # frozen 2 ticks: verdict
+    assert sb.try_acquire(sb.watched_gen() + 1) is True
+    assert sb.gen == 1 and lead.deposed()
+
+
+def test_standby_watch_restarts_against_a_new_generation():
+    board = MemoryBoard()
+    lead = LeaderLease(board, "lead", deadline_ticks=2)
+    lead.acquire()
+    sb = LeaderLease(board, "sb", deadline_ticks=2)
+    assert not sb.observe(1)
+    # A rival standby wins generation 1 mid-countdown: the verdict must
+    # name the NEWEST leader, so the watch restarts from its beat.
+    rival = LeaderLease(board, "rival", deadline_ticks=2)
+    assert rival.try_acquire(1) is True
+    assert not sb.observe(3)  # reset, even though 2 ticks have passed
+    assert sb.watched_gen() == 1
+    assert not sb.observe(4)
+    assert sb.observe(5)  # the rival's beat froze in turn
+
+
+def test_checkpoint_roundtrip_and_torn_reads_missing():
+    board = MemoryBoard()
+    state = {"gen": 0, "requests": [{"id": "r1"}], "answered": ["r0"]}
+    write_checkpoint(board, 0, state)
+    assert read_checkpoint(board, 0) == state
+    board.post(ckpt_key(1), '{"requests": [{"id": "to')  # torn mid-write
+    assert read_checkpoint(board, 1) is None
+    board.post(ckpt_key(2), json.dumps({"requests": "x", "answered": []}))
+    assert read_checkpoint(board, 2) is None  # wrong shape == missing
+
+
+def test_coordinator_checkpoint_is_change_cached():
+    board = MemoryBoard()
+    clock = FakeClock()
+    lead = LeaderLease(board, "lead", deadline_ticks=2)
+    lead.acquire()
+    coord, _, _ = make_coordinator(board, clock, leader=lead)
+    coord.checkpoint([{"id": "r1"}], [])
+    assert read_checkpoint(board, 0)["requests"] == [{"id": "r1"}]
+    board.delete(ckpt_key(0))
+    coord.checkpoint([{"id": "r1"}], [])  # unchanged: no board write
+    assert board.get(ckpt_key(0)) is None
+    coord.checkpoint([], ["r1"])  # the answer changes the blob
+    assert read_checkpoint(board, 0)["answered"] == ["r1"]
+
+
+def test_leaderless_coordinator_never_checkpoints():
+    board = MemoryBoard()
+    coord, _, _ = make_coordinator(board, FakeClock())
+    coord.checkpoint([{"id": "r1"}], [])
+    assert board.keys("") == []
+
+
+def test_deposed_leader_stops_before_collecting(obs_registry):
+    board = MemoryBoard()
+    clock = FakeClock()
+    lead = LeaderLease(board, "lead", deadline_ticks=2)
+    lead.acquire()
+    coord, collected, fallback = make_coordinator(board, clock, leader=lead)
+    enlist(board, "w1")
+    tick(coord, clock)
+    bid = coord.offer(Block(n_rows=1))
+    # A perfectly good result lands — and a successor claims generation
+    # 1 — both before the next pump.  The deposition check runs FIRST:
+    # the zombie leader must never demux that answer.
+    board.post(result_key(bid, 0), json.dumps({
+        "bid": bid, "epoch": 0, "wid": "w1", "rows": [[1, 2, 3]],
+    }))
+    rival = LeaderLease(board, "rival", deadline_ticks=2)
+    rival.acquire()
+    with pytest.raises(LeadershipLostError):
+        tick(coord, clock)
+    assert collected == [] and fallback == []
+    assert obs_registry.counters["fleet_depositions"] == 1
+    coord.shutdown()  # deposed: the fleet belongs to the successor now
+    assert board.get(shutdown_key()) is None
+
+
+def test_zombie_leader_marker_freezes_beat_until_deposed(obs_registry):
+    board = MemoryBoard()
+    clock = FakeClock()
+    lead = LeaderLease(board, "lead", deadline_ticks=2)
+    lead.acquire()
+    coord, _, _ = make_coordinator(board, clock, leader=lead)
+    beat = board.get(leader_beat_key(0))
+    try:
+        activate_faults("zombie:fleet-leader:fail=1")
+        tick(coord, clock)
+    finally:
+        deactivate_faults()
+    assert board.get(leader_beat_key(0)) == beat  # renewal skipped
+    tick(coord, clock)  # the freeze is sticky past the marker
+    assert board.get(leader_beat_key(0)) == beat
+    # The standby watch sees the frozen beat, takes over, and the
+    # zombie's next pump self-deposes.
+    sb = LeaderLease(board, "sb", deadline_ticks=2)
+    assert not sb.observe(1) and not sb.observe(2)
+    assert sb.observe(3)
+    assert sb.try_acquire(sb.watched_gen() + 1) is True
+    with pytest.raises(LeadershipLostError):
+        tick(coord, clock)
+
+
+def test_redispatch_cap_dead_letters_to_local_scoring(obs_registry):
+    board = MemoryBoard()
+    clock = FakeClock()
+    coord, collected, fallback = make_coordinator(
+        board, clock, max_redispatch=2
+    )
+    enlist(board, "w1")
+    tick(coord, clock)
+    block = Block()
+    bid = coord.offer(block)
+    # The worker stays alive but never claims (a permanently failing
+    # offer): every expiry re-offers at a bumped epoch until the cap.
+    for t in range(20 * coord.lease_ticks):
+        if coord.outstanding() == 0:
+            break
+        board.post(heartbeat_key("w1"), str(10 + t))
+        tick(coord, clock)
+    assert fallback == [block] and collected == []
+    assert obs_registry.counters["fleet_lease_expiries"] == 3
+    assert obs_registry.counters["fleet_redispatches"] == 2
+    assert obs_registry.counters["fleet_deadletter"] == 1
+    assert board.get(offer_key(bid)) is None  # nothing left to claim
+    assert not coord.leases.admits(bid, 3)  # stragglers land fenced
+
+
+def test_gc_sweeps_dead_generation_debris_counted_once(obs_registry):
+    board = MemoryBoard()
+    clock = FakeClock()
+    # Generation 0 died mid-run: its offer/claim/result debris, leader
+    # records, and checkpoint are all still on the board.
+    board.post(offer_key("g0b1"), json.dumps({"bid": "g0b1", "epoch": 0}))
+    board.post(claim_key("g0b1", 0), json.dumps({"wid": "w9"}))
+    board.post(result_key("g0b1", 0), json.dumps({"rows": [[1, 2, 3]]}))
+    board.post(leader_claim_key(0), json.dumps({"lid": "dead", "gen": 0}))
+    board.post(leader_beat_key(0), "7")
+    write_checkpoint(board, 0, {"gen": 0, "requests": [], "answered": []})
+    lead = LeaderLease(board, "sb", deadline_ticks=2)
+    assert lead.acquire() == 1
+    coord, _, _ = make_coordinator(board, clock, leader=lead)
+    tick(coord, clock)  # classify + mark; grace window opens
+    assert obs_registry.counters["fleet_leader_fenced"] == 3
+    assert board.get(offer_key("g0b1")) is not None  # grace: not yet
+    tick(coord, clock, n=coord.gc_ticks)
+    for key in (
+        offer_key("g0b1"),
+        claim_key("g0b1", 0),
+        result_key("g0b1", 0),
+        ckpt_key(0),
+        leader_claim_key(0),
+        leader_beat_key(0),
+    ):
+        assert board.get(key) is None, key
+    # The run's own generation record survives; fences counted ONCE.
+    assert board.get(leader_claim_key(1)) is not None
+    assert obs_registry.counters["fleet_leader_fenced"] == 3
+    assert obs_registry.counters["fleet_gc_swept"] == 6
+
+
+def test_gc_keeps_live_state_and_successor_namespace():
+    board = MemoryBoard()
+    clock = FakeClock()
+    lead = LeaderLease(board, "lead", deadline_ticks=2)
+    lead.acquire()
+    coord, _, _ = make_coordinator(board, clock, leader=lead)
+    coord.gc_ticks = 2  # sweep well inside the worker-lease window
+    enlist(board, "w1")
+    tick(coord, clock)
+    bid = coord.offer(Block())
+    board.claim(claim_key(bid, 0), json.dumps({"wid": "w1"}))
+    # A successor generation's key (as a rejoining standby would see
+    # after losing its own leadership): NEVER touched.
+    board.post(offer_key("g5b1"), json.dumps({"bid": "g5b1", "epoch": 0}))
+    for t in range(2 + coord.gc_ticks):
+        board.post(heartbeat_key("w1"), str(10 + t))
+        tick(coord, clock)
+    assert board.get(offer_key(bid)) is not None  # live offer kept
+    assert board.get(claim_key(bid, 0)) is not None  # admitted epoch kept
+    assert board.get(worker_key("w1")) is not None  # live worker kept
+    assert board.get(offer_key("g5b1")) is not None  # successor kept
+
+
+def test_gc_final_clears_everything_but_registry_and_generations():
+    board = MemoryBoard()
+    clock = FakeClock()
+    lead = LeaderLease(board, "lead", deadline_ticks=2)
+    lead.acquire()
+    coord, _, fallback = make_coordinator(board, clock, leader=lead)
+    enlist(board, "w1")
+    tick(coord, clock)
+    coord.offer(Block())
+    coord.checkpoint([{"id": "r1"}], [])
+    board.post(offer_key("g0b9"), json.dumps({"bid": "g0b9", "epoch": 0}))
+    coord.finish_locally()
+    coord.gc_final()
+    coord.shutdown()
+    assert [k for k in board.keys("") if "/offer/" in k] == []
+    assert [k for k in board.keys("") if "/ckpt/" in k] == []
+    assert board.get(worker_key("w1")) is not None  # w1 exits on its own
+    assert board.get(leader_claim_key(0)) is not None  # generation record
+    assert board.get(shutdown_key()) is not None
+
+
+def test_fileboard_enospc_failed_post_reads_missing_no_tmp_leak(tmp_path):
+    root = tmp_path / "board"
+    board = FileBoard(str(root))
+    board.post("seqalign/fleet/ok", "before")
+    try:
+        activate_faults("board:enospc:fail=1")
+        with pytest.raises(OSError):
+            board.post("seqalign/fleet/x", "half-written-payload")
+    finally:
+        deactivate_faults()
+    # The failed post is invisible: no key, no torn value, no tmp file.
+    assert board.get("seqalign/fleet/x") is None
+    assert board.keys("") == ["seqalign/fleet/ok"]
+    leftovers = [
+        p for p in root.rglob("*")
+        if p.is_file() and p.name.startswith(".tmp.")
+    ]
+    assert leftovers == []
+    board.post("seqalign/fleet/x", "whole")  # the retry lands whole
+    assert board.get("seqalign/fleet/x") == "whole"
+
+
+def test_offer_on_unpostable_board_raises_with_no_lease_state():
+    class SickBoard(MemoryBoard):
+        def post(self, key, value):
+            if "/offer/" in key:
+                raise OSError(28, "No space left on device")
+            super().post(key, value)
+
+    board = SickBoard()
+    clock = FakeClock()
+    coord, _, _ = make_coordinator(board, clock)
+    enlist(board, "w1")
+    tick(coord, clock)
+    with pytest.raises(OSError):
+        coord.offer(Block())
+    # Nothing to unwind: the dispatcher's quarantine ladder takes the
+    # block, and the coordinator carries no phantom lease.
+    assert coord.outstanding() == 0
+    tick(coord, clock)  # no stale lease ever expires
+
+
+def test_standby_wait_sees_clean_shutdown():
+    board = MemoryBoard()
+    lead = LeaderLease(board, "lead", deadline_ticks=2)
+    lead.acquire()
+    sb = LeaderLease(board, "sb", deadline_ticks=2)
+    board.post(shutdown_key(), "shutdown")
+    assert standby_wait(board, sb, FakeClock(), poll_s=0.01) == (
+        "shutdown", None,
+    )
+    assert sb.gen is None  # nothing was taken over
+
+
+def test_standby_wait_takes_over_a_silent_leader():
+    board = MemoryBoard()
+    lead = LeaderLease(board, "lead", deadline_ticks=2)
+    lead.acquire()
+    sb = LeaderLease(board, "sb", deadline_ticks=2)
+    verdict = standby_wait(board, sb, FakeClock(), poll_s=0.01)
+    assert verdict == ("takeover", 0)
+    assert sb.gen == 1 and lead.deposed()
