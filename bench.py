@@ -898,6 +898,15 @@ def main() -> None:
         "prewarmed": prewarmed,
         "formulation": formulation,
     }
+    # Feed-overlap config fact (r6): whether the host-feed double buffer
+    # (io.pipeline.FeedStager) was enabled for this run — a measured MFU
+    # is only comparable across rounds with the same setting.
+    try:
+        from mpi_openmp_cuda_tpu.io.pipeline import feed_overlap_enabled
+
+        record["feed_overlap"] = feed_overlap_enabled()
+    except Exception:  # noqa: BLE001 - diagnostic only
+        pass
     # The probe context bracketing the recorded measurement, IN the record
     # (VERDICT r2: a degraded-probe run must be recognisable from the JSON
     # alone).
@@ -942,6 +951,33 @@ def main() -> None:
                 )
             if pred is not None:
                 record["predicted_mfu_vs_feed_roofline"] = pred
+            # Launch-plane accounting (r6 fusion): the schedule's lowered
+            # launch count and distinct executables next to the MFU pair,
+            # plus the measured-minus-modelled residue — the total wall
+            # the cost model cannot attribute to kernels or launch
+            # overhead (feed stalls, dispatch floor).  Never fatal, same
+            # contract as the prediction above.
+            try:
+                from mpi_openmp_cuda_tpu.analysis.costmodel import (
+                    schedule_cost_sheet,
+                )
+
+                _sheet = schedule_cost_sheet(problem, backend)
+                record["launches"] = _sheet["totals"]["launches"]
+                record["distinct_executables"] = _sheet["totals"][
+                    "executables"
+                ]
+                record["fused_groups"] = (
+                    (_sheet.get("fused") or {}).get("groups")
+                )
+                record["gap_attribution_total_s"] = round(
+                    wall - _sheet["totals"]["predicted_wall_us"] / 1e6, 9
+                )
+            except Exception as e:  # noqa: BLE001 - diagnostic only
+                print(
+                    f"[bench] WARNING: launch accounting failed ({e})",
+                    file=sys.stderr,
+                )
             if feed == "i8" and on_tpu:
                 # VPU-pass floor (VERDICT r3 item 2): the kernel is
                 # VPU-pass-bound, so its floor is the irreducible
@@ -1068,6 +1104,31 @@ def main() -> None:
         f"compile+first run {compile_and_run:.1f}s){cold}{probe}",
         file=sys.stderr,
     )
+    # Fusion summary row (r6): pure host arithmetic over the schedule —
+    # prints the launch plane on CPU CI runs too.  Never fatal.
+    if backend == "pallas":
+        try:
+            from mpi_openmp_cuda_tpu.analysis.costmodel import (
+                schedule_cost_sheet,
+            )
+
+            _s = schedule_cost_sheet(problem, backend)
+            _groups = (_s.get("fused") or {}).get("groups") or []
+            _gtxt = " ".join(
+                "+".join(str(k) for k in g) for g in _groups
+            ) or "-"
+            print(
+                f"[bench] fused: launches={_s['totals']['launches']} "
+                f"executables={_s['totals']['executables']} "
+                f"groups={_gtxt} "
+                f"feed_overlap={'on' if record.get('feed_overlap') else 'off'}",
+                file=sys.stderr,
+            )
+        except Exception as e:  # noqa: BLE001 - diagnostic only
+            print(
+                f"[bench] WARNING: fused summary failed ({e})",
+                file=sys.stderr,
+            )
 
 
 if __name__ == "__main__":

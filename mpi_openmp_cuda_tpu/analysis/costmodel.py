@@ -374,6 +374,7 @@ def schedule_cost_sheet(problem, backend: str = "pallas") -> dict:
             "totals": None,
             "predicted_mfu_vs_feed_roofline": None,
             "hot_configs": [],
+            "fused": None,
             "comms": None,
         }
 
@@ -501,6 +502,15 @@ def schedule_cost_sheet(problem, backend: str = "pallas") -> dict:
             total_flops / predicted_wall_s / (roof * 1e12), 3
         ),
         "hot_configs": hot_rows,
+        # Launch-fusion view (r6): the bucket-key partition the fusion
+        # planner chose and the launch count it declares — the same
+        # numbers the trace auditor's launch-budget gate enforces on the
+        # actual lowering.  The launch_overhead_us total above collapses
+        # with the group count (launch count x LAUNCH_OVERHEAD_S).
+        "fused": {
+            "groups": [list(cfg.bucket_keys) for cfg in cfgs],
+            "declared_launches": int(total_launches),
+        },
         "comms": {
             "ici_link_gbytes_s": ICI_LINK_GBYTES_S,
             "ici_hop_latency_us": round(ICI_HOP_LATENCY_S * 1e6, 3),

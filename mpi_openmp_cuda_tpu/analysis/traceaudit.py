@@ -258,22 +258,33 @@ def audit_entry_points(buckets=None, threshold: int = LARGE_BUFFER_BYTES):
 
 
 def audit_schedule(problem, backend: str = "pallas") -> dict:
-    """Trace-audit the COMPOSED schedule: every bucket's resolved body
-    is traced at its production chunk shapes; each 128-aligned pallas
-    bucket must contain exactly one ``pallas_call`` (so the static
-    launch count is ``n_chunks`` per bucket — the number the megakernel
-    work must drive down), and donation coverage is reported for the
+    """Trace-audit the COMPOSED schedule: every launch group's resolved
+    body is traced at its production chunk shapes, and the LAUNCH-BUDGET
+    gate holds the lowering to the fusion planner's declaration — the
+    schedule must lower to EXACTLY ``FusedScheduleConfig
+    .declared_launches`` ``pallas_call`` launches (r6; supersedes the
+    per-bucket one-launch gate, which the fused schedule satisfies as a
+    corollary: one call per chunk per group).  A lowering that de-fuses
+    (extra calls per chunk) or silently re-splits the grid fails here
+    before hardware ever sees it.  Donation coverage is reported for the
     chunk-pipeline operands.  Returns a JSON-ready dict."""
     import jax
     import numpy as np
 
-    from ..ops.schedule import kernel_configs, production_schedule
+    from ..ops.schedule import (
+        fused_schedule_config,
+        kernel_configs,
+        production_schedule,
+    )
 
     _, sched = production_schedule(problem, backend)
     cfgs = kernel_configs(problem, backend, buckets=True)
+    declared = fused_schedule_config(problem, backend).declared_launches
     rows = []
     total_large = 0
     total_donated = 0
+    actual_launches = 0  # traced pallas_calls x chunks, aligned groups
+    budgeted_launches = 0  # chunks of the aligned groups (1 call each)
     all_pinned: list = []
     for i, part in enumerate(sched):
         batch = part["batch"]
@@ -306,15 +317,9 @@ def audit_schedule(problem, backend: str = "pallas") -> dict:
                 f"cb={cb}) failed to lower: {exc!r}"
             ) from exc
         aligned = batch.l1p % 128 == 0 and batch.l2p % 128 == 0
-        if aligned and backend == "pallas" and counts["pallas_calls"] != 1:
-            raise TraceAuditError(
-                f"schedule bucket {i} (l1p={batch.l1p}, l2p={batch.l2p}) "
-                f"lowers to {counts['pallas_calls']} pallas_call(s), "
-                "expected exactly 1: the static launch count "
-                "(launches == chunks) no longer holds — update "
-                "analysis/costmodel.py's launch accounting in lockstep "
-                "with the kernel restructuring"
-            )
+        if aligned and backend == "pallas":
+            actual_launches += nc * counts["pallas_calls"]
+            budgeted_launches += nc
         large = [b for b in infos if b.nbytes >= LARGE_BUFFER_BYTES]
         violations, pinned = _split_undonated(large, entry_plan)
         if violations:
@@ -348,6 +353,22 @@ def audit_schedule(problem, backend: str = "pallas") -> dict:
             }
         )
         del lens_arr
+    # The launch-budget gate (r6): the lowered schedule must spend
+    # EXACTLY the launch count the fusion planner declared — one
+    # pallas_call per chunk per launch group.  More means a group
+    # de-fused or re-split in lowering; fewer means the trace walk went
+    # blind.  Fix the plan or the kernel, never this gate (and the
+    # committed golden is REGENERATED on deliberate schedule changes,
+    # not loosened).
+    if backend == "pallas" and actual_launches != budgeted_launches:
+        raise TraceAuditError(
+            f"schedule lowers to {actual_launches} pallas_call "
+            f"launch(es) against a launch budget of {budgeted_launches} "
+            f"(fused schedule declares {declared}): a launch group "
+            "de-fused or re-split in lowering — update the fusion plan "
+            "(ops/schedule.plan_fusion_groups) and regenerate the "
+            "golden in lockstep"
+        )
     executables = (
         len({c.cache_key for c in cfgs}) if cfgs is not None else len(sched)
     )
@@ -356,6 +377,7 @@ def audit_schedule(problem, backend: str = "pallas") -> dict:
         "buckets": rows,
         "executables": executables,
         "launches": int(sum(r["chunks"] for r in rows)),
+        "declared_launches": int(declared),
         "donation": {
             "large_buffers": total_large,
             "donated_large_buffers": total_donated,

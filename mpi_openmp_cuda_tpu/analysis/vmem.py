@@ -269,6 +269,48 @@ def audit_chooser_space(budget: int = VMEM_BUDGET_BYTES):
     return n, worst
 
 
+def audit_fused_configs(
+    problem, backend: str = "pallas", budget: int = VMEM_BUDGET_BYTES
+):
+    """Audit the FUSED production schedule's concrete launch-group
+    configs against the VMEM budget (r6): the fusion planner widens
+    member buckets to the group L2P, so every emitted (nbn, nbi, feed,
+    sb, l2s) pair is re-modelled here at the chunk parity the dispatch
+    actually picks.  Returns JSON-ready rows (one per launch group);
+    raises :class:`VmemBudgetError` on any over-budget group.  The
+    groups live inside :func:`iter_chooser_space`'s swept envelope, so
+    this is a pointed re-check of the live schedule, not a new pass."""
+    from ..ops.schedule import kernel_configs
+
+    cfgs = kernel_configs(problem, backend, buckets=True)
+    rows = []
+    for cfg in cfgs or []:
+        if cfg.formulation != "pallas":
+            continue
+        est = check_config(
+            nbn=cfg.l1p // 128,
+            nbi=cfg.l2p // 128,
+            feed=cfg.feed,
+            sb=cfg.sb,
+            pp=2 if cfg.cb % 2 == 0 else 1,
+            l2s=cfg.l2s,
+            budget=budget,
+        )
+        rows.append(
+            {
+                "bucket_keys": list(cfg.bucket_keys),
+                "l1p": cfg.l1p,
+                "l2p": cfg.l2p,
+                "sb": cfg.sb,
+                "l2s": cfg.l2s,
+                "feed": cfg.feed,
+                "total_bytes": est.total_bytes,
+                "headroom_bytes": est.headroom_bytes,
+            }
+        )
+    return rows
+
+
 def check_config(
     *,
     nbn: int,

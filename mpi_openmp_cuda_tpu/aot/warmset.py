@@ -23,9 +23,12 @@ An executable's identity has two halves:
 1. the top-K of ``analysis/costmodel.schedule_cost_sheet``'s hot-config
    ranking (built "for AOT warming"; pallas schedules only — the sheet
    prices the fused kernel),
-2. the problem's full production bucket schedule (one entry per bucket,
-   resolved through the same routing ``AlignmentScorer._score_local``
-   applies at dispatch time), and
+2. the problem's full production bucket schedule (one entry per LAUNCH
+   GROUP: since r6's launch fusion, ``production_schedule`` emits the
+   fusion planner's merged groups, so the warm set compiles the fused
+   executables — not the pre-fusion per-bucket ones — through the same
+   routing ``AlignmentScorer._score_local`` applies at dispatch time),
+   and
 3. the serve superblock shapes (every ``--serve`` dispatch is exactly
    ``rows_per_block`` padded rows per L2P bucket), so a batch-mode
    prewarm also warms a later serve replica of the same problem key.
@@ -166,7 +169,9 @@ def _resolve_entry_config(backend, val_flat, l1p, l2p, len1, lens):
 
 
 def _schedule_entries(problem, backend, val_flat) -> list[WarmEntry]:
-    """One entry per production-schedule bucket (source 2)."""
+    """One entry per production-schedule launch group (source 2; the
+    fused executables, since the schedule derivation IS the fusion
+    planner's output)."""
     from ..ops.schedule import production_schedule
 
     _, sched = production_schedule(problem, backend)

@@ -35,7 +35,7 @@ from ..resilience.watchdog import (
 from ..utils.platform import env_flag, env_float, env_int, env_str
 from ..utils.profiling import PhaseTimer, device_trace
 from .parse import load_problem
-from .pipeline import ChunkPipeline, PendingWindow
+from .pipeline import ChunkPipeline, FeedStager, PendingWindow
 from .printer import guarded_stdout, print_results, write_json_sidecar
 
 
@@ -709,14 +709,25 @@ def _run_streaming(
         # Dispatch/materialise (shared budget, --degrade chain, oracle
         # re-verification) live in io.pipeline, shared with --serve.
         pipe = ChunkPipeline(policy, deg)
+        # Feed overlap (r6): a one-chunk lookahead below stages chunk
+        # N+1's host->device transfers while chunk N computes.  Off on
+        # multi-host (the per-chunk collective order is the schedule;
+        # no speculative device traffic) and under --resume (the
+        # journal reduces each chunk to its missing subset, so a
+        # full-chunk prestage would mostly move dead bytes).
+        stager = FeedStager(
+            deg, enabled=False if (multi or journal is not None) else None
+        )
 
-        def _submit(start, codes):
+        def _submit(start, codes, staged=None):
             """Dispatch a chunk; returns (promise, start, codes, pend, rows,
             hashes, budget).  pend is None without a journal (whole chunk
             scored); with one, only hash-missing sequences are dispatched
             and rows pre-holds the journalled results.  budget is the
             chunk's shared retry counter: dispatch and materialise together
-            get args.retries retries, like the batch path."""
+            get args.retries retries, like the batch path.  ``staged`` is
+            the chunk's prestaged feed handle (or None): advisory and
+            single-use, see ChunkPipeline.dispatch."""
             budget = policy.new_budget()
             if journal is None:
                 if multi:
@@ -724,7 +735,11 @@ def _run_streaming(
                     # sharded dispatch's collectives.
                     dist.broadcast_chunk(codes)
                 promise = pipe.dispatch(
-                    header.seq1_codes, codes, header.weights, budget
+                    header.seq1_codes,
+                    codes,
+                    header.weights,
+                    budget,
+                    staged=staged,
                 )
                 return (promise, start, codes, None, None, None, budget)
             hashes = [seq_hash(c) for c in codes]
@@ -754,6 +769,7 @@ def _run_streaming(
                     [codes[j] for j in pend],
                     header.weights,
                     budget,
+                    staged=staged,
                 )
             return (promise, start, codes, pend, rows, hashes, budget)
 
@@ -819,14 +835,46 @@ def _run_streaming(
                 )
                 end_sent = False
                 drained_at = None
+                # One-chunk input lookahead: each iteration dispatches
+                # the HELD chunk, then stages the just-read chunk's
+                # host->device transfers (FeedStager — a no-op handle on
+                # multi/--resume) so they overlap the held chunk's
+                # compute, then lets the window finish the oldest entry.
+                pending_input = None
                 for start, codes in header.iter_chunks(args.stream):
                     if drain_requested():
                         # Preemption drain: stop ADMITTING chunks; the
                         # in-flight window below still materialises (and
-                        # journals) normally, then the run exits 75.
-                        drained_at = start
+                        # journals) normally, then the run exits 75.  A
+                        # held-but-undispatched lookahead chunk is NOT
+                        # admitted: the drain point is ITS start.
+                        if pending_input is not None:
+                            drained_at = pending_input[0]
+                            pending_input = None
+                        else:
+                            drained_at = start
                         break
-                    window.push(*_submit(start, codes))
+                    if pending_input is None:
+                        pending_input = (
+                            start,
+                            codes,
+                            stager.stage(
+                                header.seq1_codes, codes, header.weights
+                            ),
+                        )
+                        continue
+                    item = _submit(*pending_input)
+                    pending_input = (
+                        start,
+                        codes,
+                        stager.stage(
+                            header.seq1_codes, codes, header.weights
+                        ),
+                    )
+                    window.push(*item)
+                if pending_input is not None:
+                    window.push(*_submit(*pending_input))
+                    pending_input = None
                 if multi:
                     # End sentinel BEFORE the final materialise: the
                     # pipelined worker mirrors this exactly (it learns
@@ -1210,12 +1258,33 @@ def run(argv: list[str] | None = None) -> int:
             else:
                 _check_resume(args)
 
-        def _score_once(sc):
+        # Feed overlap (r6), batch tier: start the whole batch's
+        # host->device transfers (async device_put, one handle per
+        # launch group) before the scoring phase opens.  Local
+        # non-resume runs only — multi-host stages per-shard inside the
+        # sharded path, and --resume's reduced schedule plans different
+        # shapes.  Single-use: the primary attempt drains the handle,
+        # retries and the degraded chain re-stage from host.
+        batch_staged = None
+        if not (args.distributed and dist.process_count() > 1):
+            if journal is None:
+                batch_staged = FeedStager(deg).stage(
+                    problem.seq1_codes, problem.seq2_codes, problem.weights
+                )
+
+        def _score_once(sc, staged=None):
             if journal is not None:
                 # Workers run the identical reduced schedule without
                 # touching any journal file (record=False).
                 return journal.score_with_resume(
                     sc, problem, done=done, record=coordinator
+                )
+            if staged is not None and hasattr(sc, "prestage_codes"):
+                return sc.score_codes(
+                    problem.seq1_codes,
+                    problem.seq2_codes,
+                    problem.weights,
+                    staged=staged,
                 )
             return sc.score_codes(
                 problem.seq1_codes, problem.seq2_codes, problem.weights
@@ -1251,7 +1320,7 @@ def run(argv: list[str] | None = None) -> int:
                 results = run_degrading(
                     policy,
                     deg,
-                    lambda: _score_once(deg.scorer),
+                    lambda: _score_once(deg.scorer, batch_staged),
                     _score_once,
                     "scoring",
                     verify=_batch_verify if deg.enabled else None,

@@ -17,6 +17,13 @@ the dispatch/materialise contract instead of a copy:
   round trip; the window gives the prefetched copies time to land
   before ``finish`` needs them (measured 6.3x over batch mode with a
   window of one, r5).
+* :class:`FeedStager` — true feed overlap (r6): stage superblock
+  N+1's host→device transfers (async ``jax.device_put`` via
+  ``AlignmentScorer.prestage_codes``) while superblock N computes, for
+  the batch, ``--stream`` and serve-batcher paths alike.  Purely
+  advisory and single-use: the dispatch ignores a handle whose planned
+  shapes drifted, and retries always re-stage from host (donation
+  contract).
 """
 
 from __future__ import annotations
@@ -80,7 +87,9 @@ class ChunkPipeline:
             seq1_codes, codes, weights, rows
         )
 
-    def dispatch(self, seq1_codes, codes, weights, budget, links=None):
+    def dispatch(
+        self, seq1_codes, codes, weights, budget, links=None, staged=None
+    ):
         """Async-dispatch a chunk under the shared budget; on budget
         exhaustion with --degrade, fall down the backend chain with a
         synchronous rescore — MaterialisedRows keeps the promise
@@ -94,7 +103,15 @@ class ChunkPipeline:
         what lets the jit entry points donate their operands.  Staging
         here (above the retry boundary) would hand a retried attempt an
         already-donated buffer; ``make donation-audit`` flags exactly
-        that (restage_paths / stage-above-retry)."""
+        that (restage_paths / stage-above-retry).
+
+        ``staged`` (feed overlap) is an ``ops.dispatch.StagedFeed`` of
+        operands whose transfers a :class:`FeedStager` already started —
+        compatible with the donation anchor because the handle is
+        SINGLE-USE: the first attempt drains it, so a retried attempt
+        finds it empty and re-stages from the host arrays exactly as
+        before.  Only the primary async path consumes it; the degraded
+        and breaker-open paths score from host operands."""
         deg = self.degrader
         if self.breaker is not None and self.breaker.bypass_primary():
             # Breaker open: straight to the pinned degraded backend.
@@ -115,7 +132,7 @@ class ChunkPipeline:
                 deg,
                 self._guard(
                     lambda: deg.scorer.score_codes_async(
-                        seq1_codes, codes, weights
+                        seq1_codes, codes, weights, staged=staged
                     )
                 ),
                 lambda sc: sc.score_codes(seq1_codes, codes, weights),
@@ -192,3 +209,48 @@ class PendingWindow:
     def flush(self) -> None:
         while self._pending:
             self._finish(*self._pending.popleft())
+
+
+def feed_overlap_enabled() -> bool:
+    """Feed overlap (prestaging the next superblock's host→device
+    transfers) is ON by default; ``TPU_SEQALIGN_FEED_OVERLAP=0``
+    disables it (A/B hook, and the escape hatch if a runtime's
+    device_put is synchronous enough to serialise the pipeline)."""
+    from ..utils.platform import env_flag
+
+    return env_flag("TPU_SEQALIGN_FEED_OVERLAP")
+
+
+class FeedStager:
+    """Starts the NEXT chunk's host→device transfers while the current
+    chunk computes (feed overlap, r6).
+
+    Wraps ``degrader.scorer.prestage_codes`` — resolved at call time
+    like all pipeline scoring, so a mid-stream degradation stops
+    prestaging for the replaced backend automatically.  Every failure
+    mode is advisory: a backend without ``prestage_codes``, a planning
+    error, or disabled overlap all return None, and the dispatch then
+    stages from host exactly as before.  The returned handle must feed
+    AT MOST ONE :meth:`ChunkPipeline.dispatch` call (single-use
+    donation contract)."""
+
+    def __init__(self, degrader, enabled: bool | None = None):
+        self.degrader = degrader
+        self.enabled = (
+            feed_overlap_enabled() if enabled is None else bool(enabled)
+        )
+
+    def stage(self, seq1_codes, codes, weights):
+        if not self.enabled or not codes:
+            return None
+        scorer = getattr(self.degrader, "scorer", None)
+        prestage = getattr(scorer, "prestage_codes", None)
+        if prestage is None:
+            return None
+        try:
+            return prestage(seq1_codes, codes, weights)
+        except Exception:
+            # Prestaging is purely a latency optimisation — any failure
+            # resurfaces (if real) at dispatch, inside the chunk's
+            # shared retry budget, not here.
+            return None

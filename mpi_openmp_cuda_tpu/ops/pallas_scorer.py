@@ -171,12 +171,13 @@ MAX_I8_EXACT_WEIGHT = 127
 
 _FEED_DTYPES = {"i8": jnp.int8, "bf16": jnp.bfloat16, "f32": jnp.float32}
 
-# Offline A/B hook (scripts/f32_bench.py F32_AB=wide): force the
-# pre-r6 1-wide f32 walk.  NOT a production knob — the jit/pallas_call
-# caches key on static args only, so flipping it requires
-# _pallas_call.cache_clear() + a fresh jit trace, which the bench script
-# does between arms.
-_F32_WIDE1_AB = False
+# The pre-r6 1-wide f32 walk is selectable per call via the ``wide1``
+# STATIC argument of ``score_chunks_pallas`` (threaded down to _kernel);
+# scripts/f32_bench.py's F32_AB=wide arm passes ``wide1=True``.  It used
+# to be a module-level flag (``_F32_WIDE1_AB``) flipped around
+# ``_pallas_call.cache_clear()`` — bench-only mutable state that could
+# leak a stale jit trace into production dispatch; as a static argument
+# both variants key their own cache entries and coexist safely.
 
 
 def mxu_feed(val_flat) -> str:
@@ -392,6 +393,20 @@ def emittable_superblocks(nbn: int, nbi: int, feed: str) -> tuple[int, ...]:
     return tuple(sorted({1, _superblock(nbn), *divs}))
 
 
+def fused_emittable(nbn: int, nbi: int, feed: str, sb: int) -> bool:
+    """VMEM gate for one FUSED launch group: may the kernel run at the
+    group's width (``nbi`` = widest member bucket) and super-block
+    ``sb``?  ``emittable_superblocks`` admits the static fallback and
+    sb = 1 WITHOUT the budget check (legacy escape hatches for configs
+    the chooser never sees), so the fusion planner re-checks the chosen
+    width explicitly — a fused group must never widen its members into
+    a config the VMEM model rejects.  pp = 2 is the worst case the
+    dispatch can pick (even chunk)."""
+    from ..analysis.vmem import fits_budget
+
+    return fits_budget(nbn, nbi, feed, sb, pp=2)
+
+
 @functools.lru_cache(maxsize=256)
 def _choose_superblock_cached(
     nbn: int, nbi: int, len1: int, lens_hist: tuple, feed: str = "i8"
@@ -542,23 +557,33 @@ def kernel_vpu_pass_elems(
 
 
 def _kernel(
-    meta_ref, codes_ref, a_ref, out_ref, *, nbn, nbi, feed, pretiled, sb, pp
+    meta_ref, codes_ref, a_ref, out_ref, *, nbn, nbi, feed, pretiled, sb,
+    pp, wide1=False,
 ):
     """One grid cell scores ``pp`` pairs (amortising the per-cell grid
     overhead), each across all offset super-blocks, reducing every pair to
     one best candidate: out lanes [score, n, k, eq] (f32; eq = the
     positional k=0 score at offset 0, for the equal-length path and the
-    ring combine)."""
+    ring combine).
+
+    Launch fusion rides this kernel unchanged: the scalar-prefetched
+    ``meta_ref`` lens plane IS the per-cell bucket metadata — a fused
+    launch concatenates several length buckets' rows padded to the
+    group's L2P, and each pair's prefetched ``l2`` drives the
+    ``nbi_live`` truncation and the super-block skip, so lanes past a
+    member bucket's own width cost nothing and score nothing (the value
+    table's zeroed code-0 row/column self-masks the padding)."""
     for pj in range(pp):
         _pair(
             meta_ref, codes_ref, a_ref, out_ref, pj,
             nbn=nbn, nbi=nbi, feed=feed, pretiled=pretiled, sb=sb, pp=pp,
+            wide1=wide1,
         )
 
 
 def _pair(
     meta_ref, codes_ref, a_ref, out_ref, pj, *, nbn, nbi, feed, pretiled,
-    sb, pp
+    sb, pp, wide1=False,
 ):
     """Score pair slot ``pj`` of the current grid cell.  The derived
     dtypes and iota/ltri constants are rebuilt per call — they are pure
@@ -618,7 +643,7 @@ def _pair(
     # nbi == 1 (tiny-Seq2 buckets) keeps wide=1: there the second tile
     # is ALWAYS the zeroed overhang, so wide=2 doubles every stage for
     # nothing — interleaved A/B on input4 (sb=24): wide=1 +33% median.
-    wide = 1 if nbi == 1 or (feed == "f32" and _F32_WIDE1_AB) else 2
+    wide = 1 if nbi == 1 or (feed == "f32" and wide1) else 2
     # The carryfold stage-4 form only lowers at wide=2: at wide=1 Mosaic
     # hits "Not implemented: Sublane broadcast" in the folded reduction
     # (same limitation as the f32 branch), so wide=1 keeps the pre-fold
@@ -962,10 +987,12 @@ def _pallas_call(
     feed: str,
     sb: int,
     pp: int = 1,
+    wide1: bool = False,
 ):
     pretiled = _pretile_ok(nbn, nbi, feed, sb)
     kernel = functools.partial(
-        _kernel, nbn=nbn, nbi=nbi, feed=feed, pretiled=pretiled, sb=sb, pp=pp
+        _kernel, nbn=nbn, nbi=nbi, feed=feed, pretiled=pretiled, sb=sb,
+        pp=pp, wide1=wide1,
     )
     slots = (nbn // sb) * nbi
     bandw = sb * _BLK + _BLK
@@ -996,7 +1023,9 @@ def _pallas_call(
     )
 
 
-def _pallas_best(seq1ext, len1, rows, lens, val_flat, feed="f32", sb=None):
+def _pallas_best(
+    seq1ext, len1, rows, lens, val_flat, feed="f32", sb=None, wide1=False
+):
     """Run the fused kernel; returns per-pair best candidates
     ``(score, n, k, eq)``, each ``[B]`` (score/eq float32, n/k int32).
 
@@ -1077,7 +1106,7 @@ def _pallas_best(seq1ext, len1, rows, lens, val_flat, feed="f32", sb=None):
     # other datum (the r3 sequential matrix read pp=1 as -5.3% on
     # input3, same caveat about sequential A/Bs).
     pp = 2 if b % 2 == 0 else 1
-    out = _pallas_call(nbn, nbi, wneed, b, interpret, feed, sb, pp)(
+    out = _pallas_call(nbn, nbi, wneed, b, interpret, feed, sb, pp, wide1)(
         meta, codes, a_in
     )[0][:, 0, :]
     return (
@@ -1380,17 +1409,22 @@ def _pallas_best_packed(
     )
 
 
-def _pallas_rows(seq1ext, len1, rows, lens, val_flat, feed="f32", sb=None, l2s=None):
+def _pallas_rows(
+    seq1ext, len1, rows, lens, val_flat, feed="f32", sb=None, l2s=None,
+    wide1=False,
+):
     """Score a [B, L2P] padded batch with the fused kernel; returns [B, 3].
     ``l2s`` (dispatch-gated: ``pack_classes(feed, maxv)`` non-empty,
-    L2P == 128, all len2 <= l2s) routes to the row-packed kernel."""
+    L2P == 128, all len2 <= l2s) routes to the row-packed kernel.
+    ``wide1`` forces the 1-wide walk (f32 A/B benches only)."""
     if l2s is not None:
         best, bn, bk, eq = _pallas_best_packed(
             seq1ext, len1, rows, lens, val_flat, feed=feed, sb=sb, l2s=l2s
         )
     else:
         best, bn, bk, eq = _pallas_best(
-            seq1ext, len1, rows, lens, val_flat, feed=feed, sb=sb
+            seq1ext, len1, rows, lens, val_flat, feed=feed, sb=sb,
+            wide1=wide1,
         )
 
     # O(B)-scalar epilogue: equal-length / unsearchable selection (the
@@ -1413,7 +1447,7 @@ def _shapes_supported(l1p: int, l2p: int) -> bool:
 
 def score_chunks_pallas_body(
     seq1ext, len1, seq2_chunks, len2_chunks, val_flat, *, feed="f32", sb=None,
-    l2s=None,
+    l2s=None, wide1=False,
 ):
     """Chunked-batch entry, same contract as the XLA bodies:
     [NC, CB, L2P] -> [NC, CB, 3].  Falls back to the XLA matmul body for
@@ -1421,7 +1455,9 @@ def score_chunks_pallas_body(
     from ``mxu_feed(val_flat)`` on concrete weights (checked at dispatch
     sites; this body may be traced with abstract values).  ``l2s``
     routes to the row-packed kernel (dispatch-gated: packing class in
-    ``pack_classes(feed, maxv)``, L2P == 128, every len2 <= l2s)."""
+    ``pack_classes(feed, maxv)``, L2P == 128, every len2 <= l2s).
+    ``wide1`` (static) forces the pre-r6 1-wide f32 walk — an offline
+    A/B dimension (scripts/f32_bench.py), never set by dispatch."""
     nc, cb, l2p = seq2_chunks.shape
     l1p = seq1ext.shape[0] - l2p - 1
     if not _shapes_supported(l1p, l2p):
@@ -1446,6 +1482,7 @@ def score_chunks_pallas_body(
         feed=feed,
         sb=sb,
         l2s=l2s,
+        wide1=wide1,
     )
     return out.reshape(nc, cb, 3)
 
@@ -1455,7 +1492,7 @@ def score_chunks_pallas_body(
 # cross-checks this literal against the proof.
 score_chunks_pallas = jax.jit(
     score_chunks_pallas_body,
-    static_argnames=("feed", "sb", "l2s"),
+    static_argnames=("feed", "sb", "l2s", "wide1"),
     donate_argnums=(0, 2),
 )
 

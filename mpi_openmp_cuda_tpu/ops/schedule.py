@@ -14,6 +14,19 @@ accounts exactly the production schedule" invariant, now extended to
 decisions (formulation, MXU feed, super-block width, row-packing class)
 the same way the dispatch layer does at scoring time — the static facts
 the cost model prices and the AOT warm-set ranking is keyed on.
+
+Launch fusion (r6): ``plan_fusion_groups`` partitions the 128-aligned
+length buckets into LAUNCH GROUPS — contiguous runs of sorted bucket
+keys that share one ``pallas_call`` at the widest member's L2P — priced
+with the same super-block cost model the dispatch chooser minimises,
+plus the cost model's per-launch overhead term.  The fused kernel needs
+no new lowering: the lens plane is already scalar-prefetched per grid
+cell, so a merged launch is the existing lens-adaptive kernel over the
+concatenated rows padded to the group's L2P (per-pair ``nbi_live``
+truncation masks the extra lanes exactly).  ``production_schedule``
+emits one entry per launch group, and because every accounting plane
+derives from it, the cost sheet, trace audit, warm set and bench all
+follow the fused schedule automatically.
 """
 
 from __future__ import annotations
@@ -21,6 +34,132 @@ from __future__ import annotations
 import dataclasses
 
 import numpy as np
+
+# Near-tie band for the fusion planner: among launch partitions whose
+# modelled wall is within this fraction of the minimum, prefer the
+# FEWEST launches.  The in-model launch price (2 us) only counts the
+# dispatch floor; the measured between-launch loss on real hardware
+# (BENCH_r05: 0.217 measured vs 0.446 predicted MFU) is an order of
+# magnitude larger and unmodelled, so a modelled near-tie is a real win
+# for the fused side.
+FUSED_TIE_FRACTION = 0.02
+
+# Partition enumeration is 2^(k-1) over k sorted bucket keys; real
+# schedules have <= 4-5 buckets, anything past this cap falls back to
+# the unfused per-bucket schedule rather than an exponential host scan.
+_MAX_FUSABLE_BUCKETS = 10
+
+
+def _group_cost(keys, groups, sizes, len1, l1p, val_flat):
+    """Modelled wall of fusing buckets ``keys`` into ONE launch group at
+    the widest member's L2P: ``(wall_s, launches)``, or None when the
+    group cannot run on the fused kernel (off-kernel formulation at the
+    group width, or the group super-block over the VMEM budget)."""
+    from .dispatch import choose_chunk_dims, choose_pallas_formulation
+    from .pallas_scorer import (
+        choose_superblock,
+        fused_emittable,
+        model_constants,
+        superblock_model_cost,
+    )
+
+    l2p = max(keys)
+    nbn, nbi = l1p // 128, l2p // 128
+    fm = choose_pallas_formulation(val_flat, (), l2p)
+    if fm[0] != "pallas":
+        return None
+    feed = fm[1]
+    lens = [int(sizes[i]) for k in keys for i in groups[k]]
+    sb = choose_superblock(nbn, nbi, len1, lens, feed)
+    if not fused_emittable(nbn, nbi, feed, sb):
+        return None
+    hist: dict[int, int] = {}
+    for l2 in lens:
+        if l2 <= 0:
+            continue
+        l2r = -(-l2 // 128) * 128
+        hist[l2r] = hist.get(l2r, 0) + 1
+    base, per_sb, rate = model_constants(feed)
+    wall = superblock_model_cost(
+        nbn, nbi, len1, tuple(sorted(hist.items())), sb,
+        base=base, per_sb=per_sb, rate=rate,
+    )
+    from .dispatch import round_up
+
+    cb = choose_chunk_dims(l1p, l2p, len(lens), backend="pallas")
+    launches = round_up(len(lens), cb) // cb
+    return wall, launches
+
+
+def plan_fusion_groups(groups, sizes, len1, val_flat):
+    """Partition the bucket keys of ``groups`` into launch groups.
+
+    Returns a list of key tuples, sorted by first key — each tuple is
+    the set of ``plan_buckets`` keys that dispatch as ONE program (one
+    ``pallas_call`` per chunk).  Singletons reproduce the pre-fusion
+    per-bucket schedule exactly.
+
+    Only unpacked 128-aligned buckets fuse (the packed kernel's sub-128
+    class keys keep their own launches — "one per feed class"); every
+    candidate group must route to the pallas formulation at the GROUP
+    L2P and fit the VMEM budget at the group super-block.  Contiguous
+    partitions of the sorted keys are priced with the dispatch chooser's
+    own super-block cost model plus the cost model's launch-overhead
+    term; among partitions within :data:`FUSED_TIE_FRACTION` of the
+    cheapest, the planner picks the FEWEST launches (the cost model as
+    prior — the unmodelled between-launch loss favours fusion).
+    """
+    singletons = [(k,) for k in sorted(groups)]
+    fusable = [k for k in sorted(groups) if k % 128 == 0]
+    packed = [(k,) for k in sorted(groups) if k % 128 != 0]
+    if len(fusable) < 2 or len(fusable) > _MAX_FUSABLE_BUCKETS:
+        return singletons
+    try:
+        from ..analysis.costmodel import LAUNCH_OVERHEAD_S
+    except Exception:  # pragma: no cover - analysis plane always ships
+        LAUNCH_OVERHEAD_S = 2.0e-6
+    l1p = max(128, 128 * (-(-int(len1) // 128)))
+    # Every singleton must itself be priceable, or fusion planning has
+    # no comparable baseline — fall back to the unfused schedule.
+    cost_cache: dict[tuple, tuple | None] = {}
+
+    def cost(keys):
+        if keys not in cost_cache:
+            cost_cache[keys] = _group_cost(
+                keys, groups, sizes, len1, l1p, val_flat
+            )
+        return cost_cache[keys]
+
+    if any(cost((k,)) is None for k in fusable):
+        return singletons
+
+    n = len(fusable)
+    best: list[tuple[float, int, tuple]] = []
+    for mask in range(1 << (n - 1)):
+        parts, start = [], 0
+        for j in range(n - 1):
+            if mask & (1 << j):
+                parts.append(tuple(fusable[start : j + 1]))
+                start = j + 1
+        parts.append(tuple(fusable[start:]))
+        wall = 0.0
+        launches = 0
+        ok = True
+        for part in parts:
+            c = cost(part)
+            if c is None:
+                ok = False
+                break
+            wall += c[0] + c[1] * LAUNCH_OVERHEAD_S
+            launches += c[1]
+        if ok:
+            best.append((wall, launches, tuple(parts)))
+    if not best:
+        return singletons
+    w_min = min(w for w, _, _ in best)
+    near = [b for b in best if b[0] <= w_min * (1.0 + FUSED_TIE_FRACTION)]
+    _, _, parts = min(near, key=lambda b: (b[1], b[0]))
+    return sorted(packed + list(parts), key=lambda g: g[0])
 
 
 def production_schedule(problem, backend: str):
@@ -60,14 +199,25 @@ def production_schedule(problem, backend: str):
         if fm[0] == "pallas":
             classes = pack_classes(fm[1], max_abs_value(val))
             packable = bool(classes)
+    sizes = [c.size for c in problem.seq2_codes]
     groups = plan_buckets(
-        [c.size for c in problem.seq2_codes],
+        sizes,
         packable=packable,
         classes=classes or (8, 16, 32, 64),
     )
+    # Launch fusion (r6): partition the bucket keys into launch groups
+    # — the SAME planner the dispatch layer consults, so the schedule
+    # every accounting plane derives from is the schedule that runs.
+    if backend == "pallas":
+        group_keys = plan_fusion_groups(
+            groups, sizes, int(problem.seq1_codes.size), val
+        )
+    else:
+        group_keys = [(k,) for k in sorted(groups)]
     sched = []
-    for key in sorted(groups):
-        codes = [problem.seq2_codes[i] for i in groups[key]]
+    for gkeys in group_keys:
+        idx = sorted(i for k in gkeys for i in groups[k])
+        codes = [problem.seq2_codes[i] for i in idx]
         batch = pad_problem(problem.seq1_codes, codes)
         # Same chunk policy the dispatch layer applies: pallas-sized
         # chunks only when the kernel actually runs (wide weights route
@@ -91,9 +241,37 @@ def production_schedule(problem, backend: str):
                 "rows": rows.reshape(bp // cb, cb, batch.l2p),
                 "lens": lens.reshape(bp // cb, cb),
                 "body": body,
+                "bucket_keys": tuple(gkeys),
             }
         )
     return val, sched
+
+
+@dataclasses.dataclass(frozen=True)
+class FusedScheduleConfig:
+    """The launch structure of one production schedule, as declared by
+    the fusion planner: the bucket-key partition and the EXACT number of
+    ``pallas_call`` launches the lowered schedule must show.  This is
+    the contract the trace auditor's launch-budget gate enforces — a
+    schedule that lowers to more launches than it declared is a silent
+    de-fusion regression."""
+
+    groups: tuple  # tuple of bucket-key tuples, one per launch group
+    declared_launches: int  # exact lowered pallas_call count
+    feed: str | None  # MXU feed of the schedule; None when off-kernel
+
+
+def fused_schedule_config(problem, backend: str) -> FusedScheduleConfig:
+    """Resolve the declared launch structure of ``problem``'s production
+    schedule (the fusion planner's output, re-derived from the single
+    ``production_schedule`` derivation all accounting shares)."""
+    _, sched = production_schedule(problem, backend)
+    configs = kernel_configs(problem, backend)
+    return FusedScheduleConfig(
+        groups=tuple(p["bucket_keys"] for p in sched),
+        declared_launches=sum(p["lens"].shape[0] for p in sched),
+        feed=configs[0].feed if configs else None,
+    )
 
 
 @dataclasses.dataclass(frozen=True)
@@ -113,6 +291,11 @@ class BucketKernelConfig:
     sb: int | None  # offset-super-block width
     l2s: int | None  # row-packing class (packed kernel) or None
     chunk_lens: tuple  # per-chunk PADDED lens, tuple of int tuples
+    # plan_buckets keys fused into this launch group; () when the part
+    # was derived outside the bucketed schedule (buckets=False).  NOT
+    # part of cache_key — fusion changes the shapes, not the identity
+    # scheme.
+    bucket_keys: tuple = ()
 
     @property
     def cache_key(self) -> tuple:
@@ -160,7 +343,10 @@ def kernel_configs(problem, backend: str, buckets: bool = True):
     val_flat = value_table(problem.weights).reshape(-1)
     if buckets:
         _, sched = production_schedule(problem, backend)
-        parts = [(p["batch"], np.asarray(p["lens"])) for p in sched]
+        parts = [
+            (p["batch"], np.asarray(p["lens"]), p["bucket_keys"])
+            for p in sched
+        ]
     else:
         batch = pad_problem(problem.seq1_codes, problem.seq2_codes)
         cb = choose_chunk(
@@ -169,11 +355,11 @@ def kernel_configs(problem, backend: str, buckets: bool = True):
         )
         bp = round_up(batch.batch_size, cb)
         _, lens = pad_batch_rows(batch, bp)
-        parts = [(batch, lens.reshape(bp // cb, cb))]
+        parts = [(batch, lens.reshape(bp // cb, cb), ())]
 
     configs = []
     maxv = max_abs_value(val_flat)
-    for sub, lens_chunks in parts:
+    for sub, lens_chunks, bucket_keys in parts:
         fm = choose_pallas_formulation(val_flat, (sub.l1p, sub.l2p), sub.l2p)
         if fm[0] != "pallas":
             return None
@@ -197,6 +383,7 @@ def kernel_configs(problem, backend: str, buckets: bool = True):
                 sb=sb,
                 l2s=l2s,
                 chunk_lens=chunk_lens,
+                bucket_keys=tuple(bucket_keys),
             )
         )
     return configs

@@ -76,7 +76,7 @@ import threading
 import numpy as np
 
 from ..analysis.recompile import compile_count
-from ..io.pipeline import PendingWindow
+from ..io.pipeline import FeedStager, PendingWindow
 from ..obs.events import log_line, publish
 from ..obs.metrics import gauge as obs_gauge
 from ..obs.spans import span
@@ -152,6 +152,11 @@ class ServeLoop:
         self.window = PendingWindow(
             max(1, env_int("TPU_SEQALIGN_STREAM_DEPTH", 4)), self._finish
         )
+        # Feed overlap (r6): within a tick, block N+1's host->device
+        # transfers are staged while block N computes (_dispatch's
+        # ``nxt`` lookahead).  Advisory and single-use, like the stream
+        # path — see io.pipeline.FeedStager.
+        self.stager = FeedStager(getattr(pipeline, "degrader", None))
         # The pipeline's circuit breaker (None without --degrade): the
         # loop ticks it so open/half-open transitions stay deterministic.
         self.breaker = getattr(pipeline, "breaker", None)
@@ -255,12 +260,18 @@ class ServeLoop:
 
     # -- the scoring side --------------------------------------------------
 
-    def _dispatch(self, block) -> None:
+    def _dispatch(self, block, staged=None, nxt=None):
         """Async-dispatch one superblock under its own shared retry
         budget (the per-superblock watchdog deadline rides inside the
         scorer, unchanged from batch mode).  A failure that escapes the
         whole retry/degrade ladder quarantines instead of killing the
         loop.
+
+        ``staged`` is this block's prestaged feed handle (or None) and
+        ``nxt`` the NEXT planned block of the tick: after the async
+        dispatch goes out, ``nxt``'s host->device transfers are staged
+        so they overlap this block's compute, and the new handle is
+        returned for the caller to thread into the next call.
 
         With a fleet accepting (a live worker on the board), the block
         is OFFERED instead: the payload goes out under a fresh lease and
@@ -272,7 +283,7 @@ class ServeLoop:
                 self._check_poison(block)
             except Exception as e:
                 self._block_failed(block, e)
-                return
+                return None
             self.fleet.offer(block)
             publish(
                 "serve.batch.dispatch",
@@ -281,18 +292,24 @@ class ServeLoop:
                 depth=self.queue.depth(),
                 links=block.link_ids(),
             )
-            return
+            # Fleet path: no local compute to overlap with.
+            return None
         budget = self.policy.new_budget()
         links = block.link_ids()
         try:
             self._check_poison(block)
             promise = self.pipeline.dispatch(
                 block.seq1_codes, block.codes, block.weights, budget,
-                links=links,
+                links=links, staged=staged,
             )
         except Exception as e:
             self._block_failed(block, e)
-            return
+            return None
+        nstaged = (
+            self.stager.stage(nxt.seq1_codes, nxt.codes, nxt.weights)
+            if nxt is not None
+            else None
+        )
         publish(
             "serve.batch.dispatch",
             rows=block.real_rows,
@@ -301,6 +318,7 @@ class ServeLoop:
             links=links,
         )
         self.window.push(promise, block, budget)
+        return nstaged
 
     def _finish(self, promise, block, budget) -> None:
         """Materialise one superblock and demux rows to sessions by tag
@@ -544,8 +562,11 @@ class ServeLoop:
         self._journal_live()
         live = self._admit_sessions(sessions, now)
         if live:
-            for block in plan_blocks(live, self.rows_per_block):
-                self._dispatch(block)
+            blocks = list(plan_blocks(live, self.rows_per_block))
+            staged = None
+            for i, block in enumerate(blocks):
+                nxt = blocks[i + 1] if i + 1 < len(blocks) else None
+                staged = self._dispatch(block, staged=staged, nxt=nxt)
             self.window.flush()
         for sess in sessions:
             # Emits the done record for empty (n == 0) requests; a

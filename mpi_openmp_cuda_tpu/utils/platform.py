@@ -81,6 +81,14 @@ ENV_VARS: tuple[EnvVar, ...] = (
         "in-flight device batches in the streaming scorer",
     ),
     EnvVar(
+        "TPU_SEQALIGN_FEED_OVERLAP",
+        "flag",
+        True,
+        "double-buffer the host feed: prestage the next chunk's "
+        "host->device transfers while the current chunk computes "
+        "(0 disables; A/B hook)",
+    ),
+    EnvVar(
         "SEQALIGN_FAULTS",
         "str",
         None,

@@ -24,8 +24,8 @@ JSON line with per-sb walls, the static/best gap, and the cliff.
 r6 arms:
 
 * ``F32_AB=wide`` adds a 1-wide f32 program per sb (the pre-r6 walk,
-  forced via ``pallas_scorer._F32_WIDE1_AB`` with a
-  ``_pallas_call.cache_clear()`` between arms) measured in the SAME
+  selected per call via the kernel's static ``wide1`` argument — both
+  arms trace and cache their own kernels) measured in the SAME
   interleaved rounds — the A/B behind the kernel's 2-wide f32 gate.
 * ``F32_PACK=1`` adds a packed-vs-unpacked f32 pair on a tiny-Seq2
   (len2 <= 8, 64-pair) workload — validates that the row-packing win
@@ -50,7 +50,7 @@ import bench
 F32_WEIGHTS = [300, 7, 1, 2]
 
 
-def build_prog(problem, weights, feed, sb, l2s=None):
+def build_prog(problem, weights, feed, sb, l2s=None, wide1=False):
     """Compiled+warmed two-point progs for the whole-batch single program
     at (feed, sb) — same protocol as scripts/sb_refit.py."""
     import jax
@@ -79,6 +79,7 @@ def build_prog(problem, weights, feed, sb, l2s=None):
                 out = score_chunks_pallas_body(
                     s1, l1, jnp.roll(rows, i, axis=1),
                     jnp.roll(lens, i, axis=1), v, feed=feed, sb=sb, l2s=l2s,
+                    wide1=wide1,
                 )
                 return c + out.sum(), None
 
@@ -154,22 +155,14 @@ def main() -> None:
             problem, F32_WEIGHTS, "f32", sb
         )
     if os.environ.get("F32_AB") == "wide":
-        # The pre-r6 1-wide f32 walk, same shapes/weights, fresh traces:
-        # the module flag is read at trace time and the pallas_call
-        # wrapper is lru-cached, so both caches must be cleared around
-        # each arm or the flip silently reuses the other arm's kernel.
-        import mpi_openmp_cuda_tpu.ops.pallas_scorer as ps
-
-        ps._F32_WIDE1_AB = True
-        ps._pallas_call.cache_clear()
-        try:
-            for sb in sbs:
-                variants[f"f32w1-sb{sb}"], _ = build_prog(
-                    problem, F32_WEIGHTS, "f32", sb
-                )
-        finally:
-            ps._F32_WIDE1_AB = False
-            ps._pallas_call.cache_clear()
+        # The pre-r6 1-wide f32 walk, same shapes/weights: ``wide1`` is
+        # a STATIC kernel argument (part of the jit and pallas_call
+        # cache keys), so both arms trace their own kernels and coexist
+        # — no module state to flip, no cache_clear bracketing.
+        for sb in sbs:
+            variants[f"f32w1-sb{sb}"], _ = build_prog(
+                problem, F32_WEIGHTS, "f32", sb, wide1=True
+            )
     if os.environ.get("F32_PACK") == "1":
         # Packed-vs-unpacked f32 on a tiny-Seq2 workload: len2 <= 8 so
         # the l2s=8 class is legal for any in-range f32 maxv

@@ -60,6 +60,7 @@ def build_report() -> dict:
         audit_entry_points,
         audit_schedule,
     )
+    from mpi_openmp_cuda_tpu.analysis.vmem import audit_fused_configs
     from mpi_openmp_cuda_tpu.models.workload import (
         INPUT3_CLASS_NAME,
         input3_class_problem,
@@ -69,6 +70,10 @@ def build_report() -> dict:
     problem = input3_class_problem()
     sheet = schedule_cost_sheet(problem, BACKEND)
     trace = audit_schedule(problem, BACKEND)
+    # Fused launch groups widen member buckets to the group L2P: model
+    # every concrete group config against the VMEM budget (raises on an
+    # over-budget group — the audit fails before hardware would spill).
+    fused_vmem = audit_fused_configs(problem, BACKEND)
     entries = [
         {
             "entry": rep.entry,
@@ -89,6 +94,7 @@ def build_report() -> dict:
             "workload": INPUT3_CLASS_NAME,
             "cost_sheet": sheet,
             "trace_audit": trace,
+            "fused_vmem": fused_vmem,
             "entry_points": entries,
         },
     )
@@ -105,6 +111,8 @@ def golden_view(report: dict) -> dict:
         "feed": sheet["feed"],
         "launches": sheet["totals"]["launches"],
         "executables": sheet["totals"]["executables"],
+        "fused_groups": (sheet.get("fused") or {}).get("groups"),
+        "declared_launches": trace.get("declared_launches"),
         "predicted_mfu_vs_feed_roofline": sheet[
             "predicted_mfu_vs_feed_roofline"
         ],

@@ -67,7 +67,10 @@ def test_bench_emits_contract_json_line():
                         "mfu_vs_feed_roofline",
                         "vpu_probe_arith_gelems", "vpu_floor_us",
                         "wall_vs_vpu_floor", "formulation", "donation",
-                        "comms", "ranges"}
+                        "comms", "ranges",
+                        "feed_overlap", "launches",
+                        "distinct_executables", "fused_groups",
+                        "gap_attribution_total_s"}
     # r6: every record carries the DonationPlan it ran under — the
     # wired donate_argnums per entry and the committed pre-donation
     # MFU baseline (BENCH_r05) the TPU record's delta is quoted against.

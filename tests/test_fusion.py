@@ -1,0 +1,244 @@
+"""Launch-fusion (r6) exactness and feed-overlap window tests.
+
+Tentpole contract: the fused launch groups the planner emits
+(`ops/schedule.plan_fusion_groups`, consulted identically by
+`production_schedule` and the dispatch chooser) must be bit-exact
+against the host oracle AND against the singleton per-bucket dispatch
+they replace, on the committed class mix and on adversarial mixes
+(one pair per bucket, empty buckets, all-one-bucket).  The feed-overlap
+plane (`io.pipeline.FeedStager` + `PendingWindow`) must keep in-order
+demux with depth > 1 while injected ``chunk_scoring`` faults force the
+retries-re-stage path.
+"""
+
+import numpy as np
+import pytest
+
+from mpi_openmp_cuda_tpu.ops.dispatch import AlignmentScorer
+from mpi_openmp_cuda_tpu.ops.oracle import score_batch_oracle
+from mpi_openmp_cuda_tpu.ops.values import value_table
+
+WEIGHTS = [300, 7, 1, 2]  # fixture weights: i8 feed, pallas-eligible
+
+
+def _mix(lens, len1=260, seed=3):
+    rng = np.random.default_rng(seed)
+    seq1 = rng.integers(1, 27, size=len1).astype(np.int8)
+    seqs = [
+        rng.integers(1, 27, size=int(l)).astype(np.int8) for l in lens
+    ]
+    return seq1, seqs
+
+
+def _rows(scorer, seq1, seqs, staged=None):
+    return [
+        tuple(int(x) for x in r)
+        for r in scorer.score_codes(seq1, seqs, WEIGHTS, staged=staged)
+    ]
+
+
+def _force_singletons(monkeypatch):
+    """Pin the fusion planner to the pre-r6 one-launch-per-bucket plan
+    (the dispatch imports it lazily from ops.schedule, so patching the
+    module attribute reaches both consumers)."""
+    from mpi_openmp_cuda_tpu.ops import schedule as sched
+
+    monkeypatch.setattr(
+        sched,
+        "plan_fusion_groups",
+        lambda groups, sizes, len1, val_flat: [
+            (k,) for k in sorted(groups)
+        ],
+    )
+
+
+# Adversarial class mixes (ISSUE r6 satellite): every committed shape of
+# the bucket plane, sized so CPU lowering stays fast.  Lens stay >= 65
+# so the sub-128 packing classes don't absorb the mix — these tests pin
+# the 128-aligned fusion plane.  At len1=260 the planner FUSES the
+# (128, 256) bucket pair of the two-class mixes (verified against the
+# cost model), so the multi-key kernel path really executes here.
+MIXES = {
+    # The production regime in miniature: two len classes, enough rows
+    # that plan_buckets keeps them apart, fused into one launch group.
+    "two-buckets": [100] * 8 + [200] * 8,
+    # One pair per length class: below min_rows everywhere, so planning
+    # may merge arbitrarily — exactness must hold regardless.
+    "one-pair-per-bucket": [70, 140, 210, 250],
+    # "Empty buckets": only the extreme classes of the regime present.
+    "empty-mid-buckets": [70] * 8 + [250] * 8,
+    # All rows in ONE bucket: fusion must degenerate to a single
+    # (unchanged) launch.
+    "all-one-bucket": [180] * 12,
+    # Straggler: a lone long row riding a short herd.
+    "straggler": [80] * 9 + [250],
+}
+
+
+@pytest.mark.parametrize("name", sorted(MIXES))
+def test_fused_dispatch_matches_oracle_and_singletons(name, monkeypatch):
+    lens = MIXES[name]
+    seq1, seqs = _mix(lens)
+    want = score_batch_oracle(seq1, seqs, WEIGHTS)
+    fused = _rows(AlignmentScorer("pallas"), seq1, seqs)
+    assert fused == want, f"fused dispatch drifted from oracle on {name}"
+    _force_singletons(monkeypatch)
+    single = _rows(AlignmentScorer("pallas"), seq1, seqs)
+    assert fused == single, (
+        f"fused dispatch differs from per-bucket singletons on {name}"
+    )
+
+
+def test_fused_dispatch_with_prestaged_feed_matches_oracle():
+    """The staged-feed path (prestage_codes -> StagedFeed -> dispatch)
+    must consume every staged launch group and stay bit-exact; the
+    handle must be DRAINED afterwards (single-use donation contract)."""
+    lens = MIXES["two-buckets"]
+    seq1, seqs = _mix(lens)
+    scorer = AlignmentScorer("pallas")
+    staged = scorer.prestage_codes(seq1, seqs, WEIGHTS)
+    assert staged is not None and len(staged) >= 1
+    # The mix is designed to FUSE: at least one staged launch group
+    # spans multiple bucket keys.
+    assert any(k is not None and len(k) > 1 for k in staged._parts)
+    got = _rows(scorer, seq1, seqs, staged=staged)
+    assert got == score_batch_oracle(seq1, seqs, WEIGHTS)
+    assert len(staged) == 0, "staged feed must be fully drained"
+    # A drained handle is a no-op: the same call re-stages from host.
+    again = _rows(scorer, seq1, seqs, staged=staged)
+    assert again == got
+
+
+def test_prestage_shape_drift_is_ignored():
+    """A handle staged for DIFFERENT operands must be rejected by the
+    shape check, never fed to the kernel."""
+    seq1, seqs = _mix(MIXES["all-one-bucket"])
+    other_seq1, other_seqs = _mix([96] * 12, len1=400, seed=9)
+    scorer = AlignmentScorer("pallas")
+    stale = scorer.prestage_codes(other_seq1, other_seqs, WEIGHTS)
+    assert stale is not None
+    got = _rows(scorer, seq1, seqs, staged=stale)
+    assert got == score_batch_oracle(seq1, seqs, WEIGHTS)
+
+
+def test_fused_schedule_config_production_mix():
+    """The input3-class production schedule must declare the committed
+    fused partition: 4 buckets lowering to exactly 2 launches (the
+    acceptance bar: <= 2 pallas_call launches, was 4)."""
+    from mpi_openmp_cuda_tpu.models.workload import input3_class_problem
+    from mpi_openmp_cuda_tpu.ops.schedule import fused_schedule_config
+
+    problem = input3_class_problem()
+    cfg = fused_schedule_config(problem, "pallas")
+    assert cfg.declared_launches <= 2
+    assert len(cfg.groups) == cfg.declared_launches
+    # Every production bucket key appears exactly once across groups.
+    flat = [k for g in cfg.groups for k in g]
+    assert sorted(flat) == sorted(set(flat))
+    assert cfg.feed == "i8"
+
+
+def test_fusion_planner_singleton_fallbacks():
+    """Non-pallas backends and unpriceable mixes keep the pre-fusion
+    one-group-per-bucket plan."""
+    from mpi_openmp_cuda_tpu.models.workload import input3_class_problem
+    from mpi_openmp_cuda_tpu.ops.schedule import (
+        fused_schedule_config,
+        plan_fusion_groups,
+    )
+
+    problem = input3_class_problem()
+    xla = fused_schedule_config(problem, "xla")
+    assert all(len(g) == 1 for g in xla.groups)
+    # Gather-regime weights (val > int16 ceiling): the formulation gate
+    # must refuse every multi-key group.
+    val = value_table([40000, 7, 1, 2]).astype(np.int32).reshape(-1)
+    groups = {384: [0, 1], 640: [2, 3]}
+    sizes = [380, 384, 600, 640]
+    keys = plan_fusion_groups(groups, sizes, 1489, val)
+    assert keys == [(384,), (640,)]
+
+
+@pytest.mark.no_chaos
+def test_pending_window_inorder_demux_under_faults():
+    """depth > 1 feed-overlap window: chunks finish IN PUSH ORDER and
+    bit-exact even when injected ``chunk_scoring:fail`` faults force
+    sync rescore retries mid-window, with staged feed handles in play
+    (retries re-stage from host — the donation contract)."""
+    from mpi_openmp_cuda_tpu.io.pipeline import (
+        ChunkPipeline,
+        FeedStager,
+        PendingWindow,
+    )
+    from mpi_openmp_cuda_tpu.resilience.degrade import BackendDegrader
+    from mpi_openmp_cuda_tpu.resilience.faults import (
+        activate_faults,
+        deactivate_faults,
+    )
+    from mpi_openmp_cuda_tpu.resilience.policy import RetryPolicy
+
+    rng = np.random.default_rng(17)
+    seq1 = rng.integers(1, 27, size=220).astype(np.int8)
+    chunks = [
+        [
+            rng.integers(1, 27, size=int(l)).astype(np.int8)
+            for l in rng.integers(60, 130, size=5)
+        ]
+        for _ in range(5)
+    ]
+    scorer = AlignmentScorer("pallas")
+    policy = RetryPolicy(retries=3, backoff_base=0, log=lambda m: None)
+    deg = BackendDegrader(scorer, lambda b: scorer, enabled=False)
+    pipe = ChunkPipeline(policy, deg)
+    stager = FeedStager(deg, enabled=True)
+
+    finished = []
+
+    def _finish(promise, idx, codes, budget):
+        rows = pipe.materialise(promise, seq1, codes, WEIGHTS, budget)
+        finished.append((idx, [tuple(int(x) for x in r) for r in rows]))
+
+    window = PendingWindow(3, _finish)
+    deactivate_faults()
+    activate_faults("chunk_scoring:fail=2")
+    try:
+        staged = None
+        for i, codes in enumerate(chunks):
+            budget = policy.new_budget()
+            promise = pipe.dispatch(
+                seq1, codes, WEIGHTS, budget, staged=staged
+            )
+            staged = (
+                stager.stage(seq1, chunks[i + 1], WEIGHTS)
+                if i + 1 < len(chunks)
+                else None
+            )
+            window.push(promise, i, codes, budget)
+        window.flush()
+    finally:
+        deactivate_faults()
+
+    assert [idx for idx, _ in finished] == list(range(len(chunks)))
+    for idx, rows in finished:
+        assert rows == score_batch_oracle(seq1, chunks[idx], WEIGHTS), (
+            f"chunk {idx} drifted under injected faults"
+        )
+
+
+def test_feed_overlap_env_gate(monkeypatch):
+    from mpi_openmp_cuda_tpu.io.pipeline import (
+        FeedStager,
+        feed_overlap_enabled,
+    )
+
+    monkeypatch.setenv("TPU_SEQALIGN_FEED_OVERLAP", "0")
+    assert not feed_overlap_enabled()
+
+    class _Deg:
+        scorer = AlignmentScorer("pallas")
+
+    seq1, seqs = _mix(MIXES["two-buckets"])
+    assert FeedStager(_Deg()).stage(seq1, seqs, WEIGHTS) is None
+    monkeypatch.setenv("TPU_SEQALIGN_FEED_OVERLAP", "1")
+    assert feed_overlap_enabled()
+    assert FeedStager(_Deg()).stage(seq1, seqs, WEIGHTS) is not None

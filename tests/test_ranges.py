@@ -294,5 +294,5 @@ class TestBenchRangesRecord:
         rec = bench.ranges_record(input3_class_problem(), "pallas")
         assert rec["constants_ok"] == rec["constants"] == 18
         assert rec["entries_exact"] == rec["entries"] == 15
-        assert rec["production_buckets"] == 4
+        assert rec["production_buckets"] == 2  # fused launch groups (r6)
         assert rec["findings"] == 0
