@@ -18,7 +18,7 @@ PY_CFLAGS  := $(shell $(PYCONFIG) --includes)
 PY_LDFLAGS := $(shell $(PYCONFIG) --ldflags --embed)
 INPUT      ?= /root/reference/input5.txt
 
-.PHONY: build run run2 runOn2 test chaos chaos-kill analyze schedule-audit concurrency-audit donation-audit comms-audit ranges-audit metrics-smoke serve-smoke serve-chaos fleet-chaos aot-smoke trace-smoke bench bench-table bench-gather check clean
+.PHONY: build run run2 runOn2 test chaos chaos-kill analyze schedule-audit concurrency-audit donation-audit comms-audit ranges-audit exitpath-audit metrics-smoke serve-smoke serve-chaos fleet-chaos aot-smoke trace-smoke bench bench-table bench-gather check clean
 
 build: final
 
@@ -151,6 +151,19 @@ comms-audit:
 # CPU-only, zero devices, a few seconds.
 ranges-audit:
 	JAX_PLATFORMS=cpu $(PYTHON) scripts/ranges_audit.py
+
+# Failure-path gate (docs/ARCHITECTURE.md §9): whole-program
+# exception-flow analysis over the raise/except/finally propagation
+# graph — prove every production raise reaches exactly one legal sink
+# (RetryPolicy taxonomy / typed wire reply / sysexits map / reasoned
+# `# advisory:` swallow), every cli/serve exit path passes the
+# finally-first flush, exit 75 is deadline/drain-rooted only, every
+# fault-registry site still fires, and diff the sink inventory against
+# the committed golden (tests/golden/exitpath_audit.json; regenerate
+# deliberately with scripts/exitpath_audit.py --update).  Pure AST
+# walking — no devices, under a second.
+exitpath-audit:
+	JAX_PLATFORMS=cpu $(PYTHON) scripts/exitpath_audit.py
 
 # Observability smoke gate (docs/ARCHITECTURE.md §10): one CLI run on
 # the tiny fixture with --metrics --metrics-out, then schema-validate

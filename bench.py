@@ -746,6 +746,27 @@ def ranges_record(problem, backend):
     }
 
 
+def exitflow_record():
+    """The failure-path cert's headline numbers next to measured MFU:
+    every production raise site classified to a legal sink, the
+    advisory-swallow inventory size, and zero findings.  Pure host AST
+    walking — safe to call anywhere; a new unclassified raise or
+    unmarked swallow must show up as a bench-visible number, not only
+    as an audit failure."""
+    from mpi_openmp_cuda_tpu.analysis.exitflow import audit_exitflow
+
+    report = audit_exitflow()
+    counts = report["counts"]
+    return {
+        "sinks": dict(report["sinks"]),
+        "raise_sites": counts["raise_sites"],
+        "production_raises": counts["production_raises"],
+        "broad_handlers": counts["broad_handlers"],
+        "advisory_markers": counts["advisory_markers"],
+        "findings": counts["findings"],
+    }
+
+
 def comms_record(problem, backend):
     """Modelled comms next to measured MFU: the collective inventory
     totals over the mesh specs the current device count can lower, plus
@@ -1088,6 +1109,16 @@ def main() -> None:
     except Exception as e:  # noqa: BLE001 - diagnostic only
         print(
             f"[bench] WARNING: ranges section failed ({e})",
+            file=sys.stderr,
+        )
+    # Exitflow section (never fatal): the failure-path cert rides every
+    # record so a new swallow or an unclassified raise lands next to
+    # the MFU number whose failure path it would silently eat.
+    try:
+        record["exitflow"] = exitflow_record()
+    except Exception as e:  # noqa: BLE001 - diagnostic only
+        print(
+            f"[bench] WARNING: exitflow section failed ({e})",
             file=sys.stderr,
         )
     pred_mfu = record.get("predicted_mfu_vs_feed_roofline")

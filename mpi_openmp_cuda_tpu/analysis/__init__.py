@@ -53,6 +53,16 @@ runnable on CPU-only CI (``make analyze``):
   the retry/degrade/rescue re-dispatch ladders), emitting the
   machine-checked ``DonationPlan`` that the ``donate_argnums`` wiring
   and traceaudit's enforced donation gate are derived from.
+* :mod:`.exitflow` — a failure-path certifier: the whole-program
+  raise/except/finally propagation graph over the intra-package call
+  graph, proving every production-reachable raise site terminates in
+  exactly one legal sink (the RetryPolicy transient/fatal ladder, a
+  typed serve wire-error reply, the ``io/cli.py`` sysexits map, or a
+  reasoned ``# advisory:`` swallow marker), that every exit path in
+  ``io/cli.py`` / ``serve/loop.py`` passes through the finally-first
+  flush, that exit 75 is reachable only from deadline/drain-rooted
+  causes, and that every fault-registry site still names a fire point
+  the graph can reach.
 * :mod:`.ranges` — a value-range certifier: abstract interpretation
   over every scoring jaxpr in an interval domain (one-hot and
   congruence refinements, widening-to-fixpoint loops, ``pallas_call``
@@ -176,6 +186,17 @@ class RangeCertError(SeqcheckError):
     the entry/bucket (or constant row) and the interval evidence."""
 
 
+class ExitFlowError(SeqcheckError):
+    """The failure-path certifier (analysis/exitflow.py) found an
+    exception-flow hazard: a raise site whose exception can escape the
+    production call graph without reaching a classifier, a broad
+    swallow without a reasoned ``# advisory:`` marker, a shadowed
+    (double-classified) handler arm, an exit path that bypasses the
+    finally-first flush, an exit-75 mapping not rooted in a
+    deadline/drain cause, or a fault-registry site with no reachable
+    fire point.  The message names the site and the escape path."""
+
+
 __all__ = [
     "SeqcheckError",
     "ContractViolation",
@@ -193,4 +214,5 @@ __all__ = [
     "DataflowError",
     "CollectiveAuditError",
     "RangeCertError",
+    "ExitFlowError",
 ]

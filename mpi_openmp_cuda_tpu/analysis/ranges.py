@@ -1173,7 +1173,9 @@ class _Interp:
                 return None
             trip = int(max(0, trip))
             return trip if trip <= _MAX_TRIP_UNROLL else None
-        except Exception:  # noqa: BLE001 - recognition only, never fatal
+        except Exception:  # noqa: BLE001
+            # advisory: trip-bound recognition only — an unrecognised
+            # loop shape falls back to widening: wider, never wrong.
             return None
 
     def _record_loop_carries(self, carry, outvars):
@@ -1254,7 +1256,9 @@ class _Interp:
         if mesh is not None and hasattr(mesh, "shape"):
             try:
                 self.axis_sizes.update(dict(mesh.shape))
-            except Exception:  # noqa: BLE001 - mesh introspection only
+            except Exception:  # noqa: BLE001
+                # advisory: mesh introspection only — unknown axis sizes
+                # widen the collective results instead of failing the cert.
                 pass
         jx, consts = self._sub_jaxpr(eqn.params, "jaxpr")
         if jx is None or len(jx.invars) != len(ins):

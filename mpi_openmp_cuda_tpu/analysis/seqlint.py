@@ -88,6 +88,18 @@ SEQ013   every numeric-bound literal in traced gate/kernel code (the
          exactly the "hand-derived once, asserted forever" constant
          the value-range certifier (``analysis/ranges.py``) exists to
          retire — wire it through ``ops/bounds.py`` or name its proof.
+SEQ014   every broad handler (``except:`` / ``except Exception``) in a
+         classified module proves it is not a silent swallow: the body
+         re-raises, routes the event through ``log_line``, forwards the
+         bound exception into a classifier call (``_block_failed(b, e)``,
+         ``_is_resumable(e)`` — the retry/quarantine ladders), or
+         carries a reasoned ``# advisory: <why>`` marker saying why
+         swallowing is the contract.  A bare ``# advisory:`` with no
+         reason text documents nothing and stays a finding.  The lexical
+         twin of the exception-flow certifier's ``swallow-unmarked``
+         finding (``analysis/exitflow.py``, ``make exitpath-audit``) —
+         cheap enough to run on every ``make analyze``, while exitflow
+         proves the whole propagation graph behind it.
 =======  ==================================================================
 
 Suppression: append ``# seqlint: disable=SEQ00N`` to the offending line
@@ -180,6 +192,12 @@ _MODULE_CLASSES: dict[str, tuple[str, ...]] = {
     # rows are what SEQ013's `# cert:` markers must name — the pass and
     # the rule land together; it PROVES bounds, it never gates on one).
     "analysis/ranges.py": (ROLE_HOST,),
+    # The exception-flow certifier: host-side AST walking over the
+    # raise/except/finally propagation graph (explicit row because its
+    # swallow-unmarked finding is what SEQ014's `# advisory:` markers
+    # answer — the pass and the rule land together; it CLASSIFIES
+    # handlers, it never swallows in one).
+    "analysis/exitflow.py": (ROLE_HOST,),
     # -- directory defaults ------------------------------------------------
     # The AOT warm plane is host-side orchestration whose diagnostics
     # ride the event bus; its timers (compile walls) are measurements,
@@ -278,6 +296,12 @@ _NODONATE_RE = re.compile(r"#\s*nodonate:\s*(\S.*)?$")
 #: SEQ013's proof marker: must name a RangeCert ``derived_constants``
 #: row (a bare ``# cert:`` proves nothing and stays a finding).
 _CERT_RE = re.compile(r"#\s*cert:\s*(\S+)?")
+
+#: SEQ014's swallow marker: must carry a non-empty reason (a bare
+#: ``# advisory:`` documents nothing and stays a finding).  Keep in
+#: sync with ``analysis.exitflow._ADVISORY_RE`` — the propagation-graph
+#: certifier reads the SAME markers when classifying handler sinks.
+_ADVISORY_RE = re.compile(r"#\s*advisory:\s*(\S.*)?$")
 
 #: SEQ013's certified numeric-bound set — every hand overflow constant
 #: the value-range certifier re-derives (analysis/ranges.py
@@ -520,6 +544,104 @@ class _Linter(ast.NodeVisitor):
             "ops/bounds.py or name the RangeCert derived_constants row "
             "that proves it (analysis/ranges.py, make ranges-audit)",
         )
+
+    # -- SEQ014: broad handlers prove they are not silent swallows ---------
+
+    @staticmethod
+    def _seq014_broad(node: ast.ExceptHandler) -> bool:
+        """``except:`` / ``except Exception`` — the handler shapes wide
+        enough to swallow ANYTHING the body raises."""
+        t = node.type
+        if t is None:
+            return True
+        if isinstance(t, ast.Attribute):
+            t = ast.Name(id=t.attr)
+        return isinstance(t, ast.Name) and t.id in (
+            "Exception",
+            "BaseException",
+        )
+
+    @staticmethod
+    def _seq014_own_stmts(node: ast.ExceptHandler):
+        """The handler's OWN statements — nested def/lambda bodies run
+        later, not in the except arm, so a raise or log_line inside one
+        proves nothing about this handler."""
+        todo = list(node.body)
+        while todo:
+            stmt = todo.pop()
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            yield stmt
+            todo.extend(
+                child
+                for child in ast.iter_child_nodes(stmt)
+                if isinstance(child, ast.stmt)
+            )
+
+    def visit_ExceptHandler(self, node: ast.ExceptHandler):
+        if self.unclassified or not self._seq014_broad(node):
+            self.generic_visit(node)
+            return
+        routed = False
+        for stmt in self._seq014_own_stmts(node):
+            if isinstance(stmt, ast.Raise):
+                self.generic_visit(node)
+                return  # re-raise (or typed replacement): not a swallow
+            for sub in ast.walk(stmt):
+                if not isinstance(sub, ast.Call):
+                    continue
+                f = sub.func
+                name = (
+                    f.id
+                    if isinstance(f, ast.Name)
+                    else f.attr
+                    if isinstance(f, ast.Attribute)
+                    else None
+                )
+                if name == "log_line":
+                    routed = True
+                # Forwarding the BOUND exception into a call hands the
+                # event to a classifier (the retry/quarantine ladders:
+                # `_block_failed(block, e)`, `_is_resumable(e)`) — a
+                # direct Name argument, not an f-string mention, which
+                # merely formats the message.
+                if node.name is not None and any(
+                    isinstance(a, ast.Name) and a.id == node.name
+                    for a in [*sub.args, *(k.value for k in sub.keywords)]
+                ):
+                    routed = True
+        if routed:
+            self.generic_visit(node)
+            return
+        end = node.body[-1].end_lineno or node.lineno
+        for text in self._lines[node.lineno - 1 : end]:
+            m = _ADVISORY_RE.search(text)
+            if m is None:
+                continue
+            if m.group(1):
+                self.generic_visit(node)
+                return  # reasoned marker: swallowing IS the contract
+            self._emit(
+                "SEQ014",
+                node,
+                "bare `# advisory:` marker on a broad except arm gives "
+                "no reason — say WHY swallowing is the contract here "
+                "(latency optimisation, best-effort diagnostic, ...) so "
+                "the exception-flow certifier can audit the swallow "
+                "(analysis/exitflow.py, make exitpath-audit)",
+            )
+            self.generic_visit(node)
+            return
+        self._emit(
+            "SEQ014",
+            node,
+            "broad `except Exception` handler neither re-raises, routes "
+            "through log_line, nor carries a reasoned `# advisory: "
+            "<why>` marker — a silent swallow is exactly the failure "
+            "path the exception-flow certifier exists to retire "
+            "(analysis/exitflow.py, make exitpath-audit)",
+        )
+        self.generic_visit(node)
 
     # -- SEQ011: module-level jit entries declare donation -----------------
 

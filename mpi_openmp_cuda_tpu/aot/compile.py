@@ -120,6 +120,8 @@ def _executable_bytes(compiled) -> int | None:
             blob = blob[0]
         return len(blob)
     except Exception:
+        # advisory: serialized-size probe only — the compile itself
+        # already succeeded; None just hides the bytes column.
         return None
 
 

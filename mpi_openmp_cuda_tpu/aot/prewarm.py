@@ -97,6 +97,8 @@ def prewarm(
         try:
             wall_s, nbytes = compile_entry(entry)
         except Exception as err:
+            # advisory: one failed warm compile is inventory, not an
+            # error — the entry stays cold and run-time compile covers it.
             failed += 1
             inc("aot.failed")
             log_line(

@@ -125,7 +125,7 @@ def backend_fingerprint() -> dict:
         import jaxlib
 
         jaxlib_version = getattr(jaxlib, "__version__", jax.__version__)
-    except Exception:  # pragma: no cover - jaxlib always ships with jax
+    except ImportError:  # pragma: no cover - jaxlib always ships with jax
         jaxlib_version = jax.__version__
     fp = {
         "jax": jax.__version__,

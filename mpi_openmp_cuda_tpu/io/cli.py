@@ -527,6 +527,8 @@ def _run_prewarm(args, timer, *, problem=None, backend=None) -> bool:
             )
         return True
     except Exception as e:
+        # advisory: prewarm is warm-up, not correctness — scoring simply
+        # proceeds with cold compiles.
         print(
             f"mpi_openmp_cuda_tpu: warning: prewarm failed ({e})",
             file=sys.stderr,
@@ -1390,6 +1392,8 @@ def run(argv: list[str] | None = None) -> int:
             try:
                 obs_export.flush_trace(tracer, trace_out, exit_code=rc)
             except Exception as flush_err:  # pragma: no cover - FS-dependent
+                # advisory: a failed trace flush must never mask the
+                # run's own verdict.
                 print(
                     "mpi_openmp_cuda_tpu: warning: trace not written "
                     f"({flush_err})",
@@ -1408,6 +1412,8 @@ def run(argv: list[str] | None = None) -> int:
                     ),
                 )
             except Exception as flush_err:  # pragma: no cover - FS-dependent
+                # advisory: a failed report flush must never mask the
+                # run's own verdict.
                 print(
                     "mpi_openmp_cuda_tpu: warning: run report not written "
                     f"({flush_err})",
@@ -1432,4 +1438,13 @@ def run(argv: list[str] | None = None) -> int:
 
 
 def main() -> None:
-    sys.exit(run())
+    try:
+        rc = run()
+    except (KeyError, ValueError) as e:
+        # Only the pre-arm plumbing can get here (a mis-declared env read
+        # in utils.platform, a malformed env value): run()'s ladder maps
+        # everything after the flush try is entered.  Usage-class verdict
+        # with the actionable message, not a traceback.
+        print(f"mpi_openmp_cuda_tpu: usage: {e}", file=sys.stderr)
+        rc = EX_USAGE
+    sys.exit(rc)

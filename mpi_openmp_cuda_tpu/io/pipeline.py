@@ -197,7 +197,7 @@ class PendingWindow:
             try:
                 promise.prefetch()
             except Exception:
-                # Prefetch is purely a latency optimisation: a
+                # advisory: prefetch is purely a latency optimisation: a
                 # device->host copy that cannot start here resurfaces at
                 # result(), inside the chunk's shared retry budget,
                 # instead of killing the pipeline from an advisory call.
@@ -250,7 +250,7 @@ class FeedStager:
         try:
             return prestage(seq1_codes, codes, weights)
         except Exception:
-            # Prestaging is purely a latency optimisation — any failure
+            # advisory: prestaging is purely a latency optimisation — any
             # resurfaces (if real) at dispatch, inside the chunk's
             # shared retry budget, not here.
             return None

@@ -51,6 +51,8 @@ def arm_observability(
 
         compile_count()  # registering the listener is its side effect
     except Exception:
+        # advisory: the recompile listener is observability only —
+        # scoring never depends on it being armed.
         pass
     return registry, recorder
 

@@ -146,6 +146,8 @@ class FlightRecorder:
             )
             return path
         except Exception:
+            # advisory: the dump is post-mortem best-effort — failing to
+            # write it must not mask the fault that triggered it.
             return None
 
 

@@ -726,6 +726,80 @@ def validate_report(rec) -> None:
                 "entries_exact/production_buckets/signed_survivors/"
                 f"findings ints, got {counts!r}"
             )
+    elif kind == "exitpath-audit":
+        # scripts/exitpath_audit.py's exception-flow certification
+        # report (analysis/exitflow.py).
+        sinks = rec.get("sinks")
+        if not isinstance(sinks, dict) or not all(
+            isinstance(k, str) and isinstance(v, int)
+            for k, v in (sinks or {}).items()
+        ):
+            problems.append(
+                f"sinks: want a str->int sink inventory, got {sinks!r}"
+            )
+        modules = rec.get("raise_modules")
+        if not isinstance(modules, dict) or not all(
+            isinstance(k, str) and isinstance(v, int)
+            for k, v in (modules or {}).items()
+        ):
+            problems.append(
+                "raise_modules: want a str->int per-module raise map, "
+                f"got {modules!r}"
+            )
+        advisory = rec.get("advisory")
+        if not isinstance(advisory, list) or not all(
+            isinstance(a, str) for a in advisory or []
+        ):
+            problems.append(
+                f"advisory: want a list of marker strs, got {advisory!r}"
+            )
+        flush = rec.get("flush")
+        if not isinstance(flush, dict):
+            problems.append(f"flush: want an object, got {flush!r}")
+        else:
+            for mod, f in flush.items():
+                if (
+                    not isinstance(f, dict)
+                    or not isinstance(f.get("function"), str)
+                    or not isinstance(f.get("flush_try"), list)
+                    or not isinstance(f.get("flush_calls"), list)
+                    or not isinstance(f.get("protected_returns"), int)
+                ):
+                    problems.append(
+                        f"flush[{mod}]: want function str, flush_try/"
+                        "flush_calls lists, protected_returns int, "
+                        f"got {f!r}"
+                    )
+        faults = rec.get("fault_sites")
+        if not isinstance(faults, dict) or not all(
+            isinstance(faults.get(k), int)
+            for k in faults or {}
+        ):
+            problems.append(
+                f"fault_sites: want a str->int summary, got {faults!r}"
+            )
+        if not isinstance(rec.get("findings"), list):
+            problems.append(
+                f"findings: want a list, got {rec.get('findings')!r}"
+            )
+        counts = rec.get("counts")
+        if not isinstance(counts, dict) or not all(
+            isinstance(counts.get(k), int)
+            for k in (
+                "raise_sites",
+                "production_raises",
+                "production_functions",
+                "broad_handlers",
+                "wire_reply_handlers",
+                "advisory_markers",
+                "findings",
+            )
+        ):
+            problems.append(
+                "counts: want raise_sites/production_raises/"
+                "production_functions/broad_handlers/wire_reply_handlers/"
+                f"advisory_markers/findings ints, got {counts!r}"
+            )
     elif kind == "comms-audit":
         # scripts/comms_audit.py's collective-safety & comms-cost report.
         entries = rec.get("entries")

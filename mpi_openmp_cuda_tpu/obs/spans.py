@@ -62,7 +62,8 @@ class SpanRecorder:
                 try:
                     fn(path, start, dur)
                 except Exception:
-                    # A broken observer must never fail the timed work.
+                    # advisory: a broken observer must never fail the
+                    # timed work.
                     pass
 
     def phases(self) -> list[tuple[str, float]]:
@@ -130,6 +131,6 @@ def fence(tree) -> None:
         return
     try:
         import jax
-    except Exception:
+    except ImportError:
         return
     jax.block_until_ready(tree)

@@ -126,6 +126,8 @@ def modelled_launch_wall_s(len1: int, lens) -> float:
                 best = wall
         return best + LAUNCH_OVERHEAD_S if best > 0.0 else 0.0
     except Exception:
+        # advisory: the modelled-wall column is a cost-model estimate —
+        # 0.0 drops the column, the measured trace stands on its own.
         return 0.0
 
 

@@ -167,6 +167,8 @@ def resolve_auto_backend() -> str:
         on_tpu = jax.default_backend() == "tpu"
         multi_host = jax.process_count() > 1
     except Exception:
+        # advisory: backend probe during auto-resolution — a jax-less or
+        # unreadied runtime resolves to the reference backend.
         on_tpu = False
         multi_host = False
     if on_tpu:
@@ -531,6 +533,8 @@ def staged_matches(
             and tuple(val_dev.shape) == tuple(val_shape)
         )
     except Exception:
+        # advisory: staged-shape probe only — False re-stages the
+        # buffers through the normal path.
         return False
 
 

@@ -116,7 +116,7 @@ def plan_fusion_groups(groups, sizes, len1, val_flat):
         return singletons
     try:
         from ..analysis.costmodel import LAUNCH_OVERHEAD_S
-    except Exception:  # pragma: no cover - analysis plane always ships
+    except ImportError:  # pragma: no cover - analysis plane always ships
         LAUNCH_OVERHEAD_S = 2.0e-6
     l1p = max(128, 128 * (-(-int(len1) // 128)))
     # Every singleton must itself be priceable, or fusion planning has

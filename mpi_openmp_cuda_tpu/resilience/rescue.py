@@ -279,7 +279,8 @@ class CoordinationBoard:
                 key, int(timeout * 1000)
             )
         except Exception:
-            return None  # timeout == lost worker; the ledger names it
+            # advisory: timeout == lost worker; the ledger names it.
+            return None
         return value if value else None  # zero-length post reads as missing
 
     def claim(self, key: str, value: str) -> bool:
@@ -291,18 +292,22 @@ class CoordinationBoard:
             self._client().key_value_set(key, value)
             return True
         except Exception:
+            # advisory: a rejected set IS the lost claim — False tells
+            # the caller another worker won.
             return False
 
     def delete(self, key: str) -> None:
         try:
             self._client().key_value_delete(key)
         except Exception:
-            pass  # best-effort: a stale key is fenced by epoch anyway
+            pass  # advisory: best-effort — a stale key is fenced by epoch
 
     def keys(self, prefix: str) -> list[str]:
         try:
             pairs = self._client().key_value_dir_get(prefix)
         except Exception:
+            # advisory: an unreadable dir reads as empty — the scan
+            # simply retries on the next tick.
             return []
         return sorted(k for k, _v in pairs)
 

@@ -753,6 +753,8 @@ class FleetWorker:
         try:
             rows = self._score_offer(offer)
         except Exception as e:
+            # advisory: the claim stays leased — lease expiry re-dispatches
+            # the superblock; a worker must not die on one bad block.
             log_line(
                 f"mpi_openmp_cuda_tpu: fleet: worker {self.wid}: "
                 f"superblock {bid} failed ({e}); leaving it to lease "

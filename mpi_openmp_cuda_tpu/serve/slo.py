@@ -125,6 +125,8 @@ class RequestCostModel:
                     total += self.pair_wall_s(len(seq1), len(s))
             return total
         except Exception:
+            # advisory: admission cost estimate only — 0.0 admits the
+            # request and the scorer's own contracts still gate it.
             return 0.0
 
 

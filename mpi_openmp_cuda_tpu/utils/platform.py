@@ -543,6 +543,8 @@ def enable_compilation_cache() -> None:
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.2)
         jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
     except Exception as e:  # pragma: no cover - depends on local FS/jax
+        # advisory: the persistent cache is a speed-up — compiles still
+        # happen, just uncached; the log line says why.
         from ..obs.events import log_line
 
         log_line(

@@ -292,6 +292,37 @@ def _pass_ranges() -> str:
     )
 
 
+def _pass_exitflow() -> str:
+    from mpi_openmp_cuda_tpu.analysis.exitflow import run_or_raise
+
+    report = run_or_raise()
+    counts = report["counts"]
+    for kind, n in report["sinks"].items():
+        print(f"  sink {kind:<14s} {n}")
+    for mod, f in report["flush"].items():
+        lo, hi = f["flush_try"]
+        print(
+            f"  flush {mod} {f['function']}(): try {lo}-{hi}, "
+            f"{f['protected_returns']} protected returns"
+        )
+    fs = report["fault_sites"]
+    print(
+        f"  faults: {fs.get('registered', 0)} registered, "
+        f"{fs.get('reachable_fire_points', 0)}/{fs.get('fire_points', 0)} "
+        "fire points reachable"
+    )
+    print(
+        f"clean: {counts['production_raises']}/{counts['raise_sites']} "
+        f"production raise sites classified, {counts['broad_handlers']} "
+        f"broad handlers, {counts['advisory_markers']} advisory markers, "
+        "0 findings"
+    )
+    return (
+        f"{counts['production_raises']} raise sites -> "
+        f"{len(report['sinks'])} sink kinds, 0 findings"
+    )
+
+
 PASSES = [
     ("seqlint", _pass_seqlint),
     ("lock graph", _pass_lockgraph),
@@ -303,6 +334,7 @@ PASSES = [
     ("interleave", _pass_interleave),
     ("collectives", _pass_collectives),
     ("ranges", _pass_ranges),
+    ("exitflow", _pass_exitflow),
     ("ruff", _tool_pass("ruff", ["ruff", "check", "mpi_openmp_cuda_tpu"])),
     ("mypy", _tool_pass("mypy", ["mypy", "mpi_openmp_cuda_tpu"])),
 ]

@@ -1,0 +1,209 @@
+#!/usr/bin/env python3
+"""`make exitpath-audit` driver: the failure-path certifier on CPU.
+
+One pass over the live tree, deterministic, golden-pinned
+(``analysis/exitflow.py``): a whole-program exception-flow dataflow
+walk over the raise/except/finally propagation graph through the
+intra-package call graph, proving
+
+1. **Sink totality** — every production raise site's exception reaches
+   exactly ONE legal sink: the RetryPolicy transient/fatal taxonomy, a
+   typed serve wire-error reply, the sysexits mapping in ``io/cli.py``
+   (64 usage / 65 fatal / 75 resumable), or a reasoned ``# advisory:``
+   swallow marker.  An escape is ``unclassified-raise``; two sinks for
+   one exception type is ``double-classified``; an unmarked broad
+   swallow is ``swallow-unmarked``.
+2. **Flush-on-every-exit** — every exit path in ``io/cli.py run()``
+   and ``serve/loop.py run_serve()`` passes through the finally-first
+   flush block (``flush-bypass``), so a failed or preempted run still
+   leaves its report behind.
+3. **Exit-75 rooting** — ``EX_TEMPFAIL`` is reachable only from
+   deadline/drain-rooted causes via a ``__cause__``-chain predicate
+   (``tempfail-unrooted``): 75 means "resume me", and a non-resumable
+   root wearing it would loop a scheduler forever.
+4. **Fault-registry liveness** — every ``resilience/faults.py``
+   registry site names a fire point reachable from the production
+   graph (``fault-site-unreachable``), so ``make chaos`` can never go
+   quietly vacuous after a rename.
+
+The committed golden (``tests/golden/exitpath_audit.json``) pins the
+sink inventory, the per-module raise counts, the advisory-marker
+inventory, the flush/fault summaries, and the headline counts — so a
+new swallow, a re-routed exception, or a dropped fault site must be
+re-proved and committed.
+
+Exit 0 iff the audit has zero findings, the report is schema-valid,
+and nothing drifted from the golden.  Pure AST walking — no jax
+import, no devices, well under a second.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+# The pass itself never imports jax, but the report envelope
+# (obs/metrics.py) may transitively — keep CI runs device-free.
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+GOLDEN_PATH = os.path.join(REPO, "tests", "golden", "exitpath_audit.json")
+
+
+def build_report() -> dict:
+    """The full enveloped exception-flow report."""
+    from mpi_openmp_cuda_tpu.analysis.exitflow import audit_exitflow
+    from mpi_openmp_cuda_tpu.obs.metrics import wrap_report
+
+    return wrap_report("exitpath-audit", audit_exitflow())
+
+
+def golden_view(report: dict) -> dict:
+    """The drift-gated subset: the sink inventory, per-module raise
+    counts, the advisory-marker inventory, and the flush/fault
+    summaries — static facts of the tree.  Flush line spans are
+    deliberately NOT pinned (any edit above the try would churn them);
+    the protected-return counts and flush-call names are."""
+    return {
+        "sinks": dict(report["sinks"]),
+        "raise_modules": dict(report["raise_modules"]),
+        "advisory": list(report["advisory"]),
+        "flush": {
+            mod: {
+                "function": f["function"],
+                "flush_calls": sorted(f["flush_calls"]),
+                "protected_returns": f["protected_returns"],
+            }
+            for mod, f in report["flush"].items()
+        },
+        "fault_sites": dict(report["fault_sites"]),
+        "findings": len(report["findings"]),
+        "counts": dict(report["counts"]),
+    }
+
+
+def diff_views(want: dict, got: dict) -> list[str]:
+    """Field-by-field drift rows (empty = match)."""
+    rows: list[str] = []
+    for key in sorted(set(want) | set(got)):
+        w, g = want.get(key), got.get(key)
+        if w != g:
+            rows.append(f"  {key}: golden {json.dumps(w)} != got {json.dumps(g)}")
+    return rows
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument(
+        "--update",
+        action="store_true",
+        help="rewrite the committed golden baseline from this run "
+        "(commit it together with the change that explains the drift)",
+    )
+    parser.add_argument(
+        "--out",
+        default=None,
+        help="also write the full enveloped report JSON to this path "
+        "(CI uploads it as the failure artifact)",
+    )
+    args = parser.parse_args()
+
+    from mpi_openmp_cuda_tpu.obs.metrics import validate_report
+
+    report = build_report()
+    failed = False
+
+    print("== schema ==")
+    try:
+        validate_report(report)
+        print("valid: kind=exitpath-audit")
+    except ValueError as exc:
+        print(f"FAIL: {exc}")
+        failed = True
+
+    print("\n== sink inventory ==")
+    for kind, n in report["sinks"].items():
+        print(f"  {kind:<14s} {n}")
+    counts = report["counts"]
+    print(
+        f"  ({counts['production_raises']} production raise sites of "
+        f"{counts['raise_sites']} total, "
+        f"{counts['production_functions']} production functions)"
+    )
+
+    print("\n== flush contract ==")
+    for mod, f in report["flush"].items():
+        lo, hi = f["flush_try"]
+        print(
+            f"  {mod} {f['function']}(): flush try lines {lo}-{hi}, "
+            f"{f['protected_returns']} protected returns, "
+            f"calls {', '.join(sorted(f['flush_calls']))}"
+        )
+
+    print("\n== fault registry ==")
+    fs = report["fault_sites"]
+    print(
+        f"  {fs.get('registered', 0)} registered sites, "
+        f"{fs.get('fire_points', 0)} fire points, "
+        f"{fs.get('reachable_fire_points', 0)} reachable from production"
+    )
+
+    print(f"\n== advisory markers ({len(report['advisory'])}) ==")
+    for row in report["advisory"]:
+        print(f"  {row}")
+
+    for f in report["findings"]:
+        print(f"  FINDING [{f['kind']}] {f['module']}:{f['line']}: {f['detail']}")
+        failed = True
+
+    if args.out:
+        with open(args.out, "w") as fh:
+            json.dump(report, fh, indent=1, sort_keys=True)
+            fh.write("\n")
+        print(f"\nreport written to {args.out}")
+
+    view = golden_view(report)
+    if args.update:
+        if failed:
+            print("\nrefusing --update: the run itself failed")
+            return 1
+        os.makedirs(os.path.dirname(GOLDEN_PATH), exist_ok=True)
+        with open(GOLDEN_PATH, "w") as fh:
+            json.dump(view, fh, indent=1, sort_keys=True)
+            fh.write("\n")
+        print(f"\ngolden updated: {GOLDEN_PATH}")
+        return 0
+
+    print("\n== golden drift ==")
+    if not os.path.exists(GOLDEN_PATH):
+        print(
+            f"FAIL: no committed golden at {GOLDEN_PATH} "
+            "(run scripts/exitpath_audit.py --update and commit it)"
+        )
+        return 1
+    with open(GOLDEN_PATH) as fh:
+        want = json.load(fh)
+    rows = diff_views(want, view)
+    if rows:
+        print(f"FAIL: {len(rows)} field(s) drifted from the golden:")
+        print("\n".join(rows))
+        print(
+            "either fix the regression, or regenerate deliberately with "
+            "scripts/exitpath_audit.py --update and commit the new "
+            "baseline with the change that explains it"
+        )
+        return 1
+    print("match: exception-flow cert equals the committed golden")
+    if failed:
+        print("\nexitpath-audit: FAIL")
+        return 1
+    print("\nexitpath-audit: OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -67,7 +67,7 @@ def test_bench_emits_contract_json_line():
                         "mfu_vs_feed_roofline",
                         "vpu_probe_arith_gelems", "vpu_floor_us",
                         "wall_vs_vpu_floor", "formulation", "donation",
-                        "comms", "ranges",
+                        "comms", "ranges", "exitflow",
                         "feed_overlap", "launches",
                         "distinct_executables", "fused_groups",
                         "gap_attribution_total_s"}
@@ -100,6 +100,16 @@ def test_bench_emits_contract_json_line():
     assert ranges["production_buckets"] >= 1
     assert ranges["signed_survivors"] >= 1
     assert ranges["findings"] == 0
+    # PR 18: the record carries the failure-path cert it ran under —
+    # every production raise classified to a legal sink, every broad
+    # swallow advisory-marked, zero findings.
+    exitflow = rec["exitflow"]
+    assert exitflow["findings"] == 0
+    assert exitflow["production_raises"] >= 100
+    assert exitflow["advisory_markers"] >= 20
+    assert {"retry-policy", "wire-reply", "exit-map", "advisory"} <= set(
+        exitflow["sinks"]
+    )
     assert rec["e2e_first_run_s"] >= 0 and rec["e2e_warm_s"] >= 0
     # Cold start spans process start -> first result, so it bounds the
     # first in-process run from above; no SEQALIGN_PREWARM in this env.

@@ -1006,3 +1006,158 @@ class TestSeq013CertMarkers:
             abs(bounds.INT32_PACKED_SENTINEL),
         ):
             assert v in seqlint._CERT_LITERALS, v
+
+
+class TestSeq014BroadSwallows:
+    """Broad except arms must prove they are not silent swallows:
+    re-raise, log_line, forwarding the bound exception into a
+    classifier, or a reasoned `# advisory:` marker (SEQ014)."""
+
+    def test_unmarked_broad_swallow(self, tmp_path):
+        findings = _lint_snippet(
+            tmp_path,
+            "ops/foo.py",
+            """
+            def probe():
+                try:
+                    risky()
+                except Exception:
+                    return None
+            """,
+        )
+        assert [f.code for f in findings] == ["SEQ014"]
+
+    def test_bare_except_swallow(self, tmp_path):
+        findings = _lint_snippet(
+            tmp_path,
+            "serve/foo.py",
+            """
+            def probe():
+                try:
+                    risky()
+                except:  # noqa: E722
+                    pass
+            """,
+        )
+        assert [f.code for f in findings] == ["SEQ014"]
+
+    def test_bare_advisory_marker_is_a_finding(self, tmp_path):
+        # A marker with no reason text documents nothing — exactly the
+        # bare-`# cert:` / bare-`# nodonate:` precedent.
+        findings = _lint_snippet(
+            tmp_path,
+            "obs/foo.py",
+            """
+            def probe():
+                try:
+                    risky()
+                except Exception:
+                    # advisory:
+                    return None
+            """,
+        )
+        assert [f.code for f in findings] == ["SEQ014"]
+        assert "no reason" in findings[0].message
+
+    def test_base_exception_swallow(self, tmp_path):
+        findings = _lint_snippet(
+            tmp_path,
+            "resilience/foo.py",
+            """
+            def probe():
+                try:
+                    risky()
+                except BaseException:
+                    return None
+            """,
+        )
+        assert [f.code for f in findings] == ["SEQ014"]
+
+    def test_nested_def_raise_does_not_satisfy(self, tmp_path):
+        # A raise inside a nested def runs LATER, not in the except
+        # arm — it proves nothing about this handler's swallow.
+        findings = _lint_snippet(
+            tmp_path,
+            "ops/foo.py",
+            """
+            def probe():
+                try:
+                    risky()
+                except Exception:
+                    def fail():
+                        raise RuntimeError("later")
+                    return fail
+            """,
+        )
+        assert [f.code for f in findings] == ["SEQ014"]
+
+    def test_reasoned_marker_is_clean(self, tmp_path):
+        assert not _lint_snippet(
+            tmp_path,
+            "ops/foo.py",
+            """
+            def probe():
+                try:
+                    risky()
+                except Exception:
+                    # advisory: best-effort probe only — None falls back
+                    return None
+            """,
+        )
+
+    def test_reraise_log_line_and_forwarding_are_clean(self, tmp_path):
+        assert not _lint_snippet(
+            tmp_path,
+            "serve/foo.py",
+            """
+            def a():
+                try:
+                    risky()
+                except Exception:
+                    raise
+
+            def b():
+                try:
+                    risky()
+                except Exception as e:
+                    log_line(f"failed ({e})")
+
+            def c(block):
+                try:
+                    risky()
+                except Exception as e:
+                    _block_failed(block, e)
+            """,
+        )
+
+    def test_narrow_handlers_are_out_of_scope(self, tmp_path):
+        assert not _lint_snippet(
+            tmp_path,
+            "ops/foo.py",
+            """
+            def probe():
+                try:
+                    risky()
+                except (OSError, ValueError):
+                    return None
+            """,
+        )
+
+    def test_suppression_honoured(self, tmp_path):
+        assert not _lint_snippet(
+            tmp_path,
+            "ops/foo.py",
+            """
+            def probe():
+                try:
+                    risky()
+                except Exception:  # seqlint: disable=SEQ014
+                    return None
+            """,
+        )
+
+    def test_exitflow_pass_is_classified_host(self):
+        # The certifier CLASSIFIES handlers (it never swallows in one),
+        # so it lives under the host role on purpose.
+        roles = seqlint.module_roles("pkg/analysis/exitflow.py")
+        assert roles == (seqlint.ROLE_HOST,)
