@@ -18,7 +18,7 @@ PY_CFLAGS  := $(shell $(PYCONFIG) --includes)
 PY_LDFLAGS := $(shell $(PYCONFIG) --ldflags --embed)
 INPUT      ?= /root/reference/input5.txt
 
-.PHONY: build run run2 runOn2 test chaos chaos-kill analyze schedule-audit concurrency-audit donation-audit comms-audit ranges-audit exitpath-audit metrics-smoke serve-smoke serve-chaos fleet-chaos aot-smoke trace-smoke bench bench-table bench-gather check clean
+.PHONY: build run run2 runOn2 test chaos chaos-kill analyze schedule-audit concurrency-audit donation-audit comms-audit ranges-audit exitpath-audit metrics-smoke serve-smoke serve-chaos fleet-chaos load-smoke aot-smoke trace-smoke bench bench-table bench-gather check clean
 
 build: final
 
@@ -187,6 +187,17 @@ serve-smoke:
 # CPU-only, seconds.
 serve-chaos:
 	JAX_PLATFORMS=cpu $(PYTHON) scripts/serve_chaos.py
+
+# Open-loop load tier (docs/ARCHITECTURE.md §12.10): boot --serve, run
+# the traffic factory through calibrate -> 2x -> 5x saturation phases
+# (constant/burst arrival processes, deadline mix, captured schedule),
+# gate answered-or-typed survival + goodput retention + the serve-load
+# bench record schema, then close the loop: refit the admission cost
+# scale and budget from the trace's measured launch walls and replay
+# the IDENTICAL captured schedule under the refit knobs, gating the
+# p99 queue-wait improvement.  CPU-only, a couple of minutes.
+load-smoke:
+	JAX_PLATFORMS=cpu $(PYTHON) scripts/load_smoke.py
 
 # Fleet chaos tier (docs/ARCHITECTURE.md §8.6): a real coordinator
 # (--serve --fleet-board) plus real --fleet-worker subprocesses over a

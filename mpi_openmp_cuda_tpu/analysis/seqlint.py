@@ -198,6 +198,12 @@ _MODULE_CLASSES: dict[str, tuple[str, ...]] = {
     # answer — the pass and the rule land together; it CLASSIFIES
     # handlers, it never swallows in one).
     "analysis/exitflow.py": (ROLE_HOST,),
+    # The load plane's one wall-clock module: schedule pacing and
+    # socket reads are measurements against a prebuilt open-loop
+    # schedule, not decisions, so SEQ005 does not apply to it — while
+    # the rest of load/ (arrival/workload/replay/gates/report/refit)
+    # is schedule ARITHMETIC and stays deterministic below.
+    "load/driver.py": (ROLE_HOST,),
     # -- directory defaults ------------------------------------------------
     # The AOT warm plane is host-side orchestration whose diagnostics
     # ride the event bus; its timers (compile walls) are measurements,
@@ -209,6 +215,10 @@ _MODULE_CLASSES: dict[str, tuple[str, ...]] = {
     "serve/": (ROLE_SERVE,),
     "analysis/": (ROLE_HOST,),
     "io/": (ROLE_HOST,),
+    # Open-loop load generation: seeded-RNG schedules, never wall-clock
+    # in decision paths — SEQ005 enforces the package docstring's
+    # determinism claim (driver.py excepted above).
+    "load/": (ROLE_DETERMINISTIC,),
     "models/": (ROLE_HOST,),
     "obs/": (ROLE_HOST,),
     "utils/": (ROLE_HOST,),

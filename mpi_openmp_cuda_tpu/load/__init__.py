@@ -1,0 +1,47 @@
+"""Load plane: deterministic open-loop traffic against the serve plane
+(ROADMAP Open item 4; docs/ARCHITECTURE.md §12.10).
+
+The serve plane's overload defences — cost-aware admission, shed
+hysteresis, deadlines, breakers, fleet redispatch — all predate this
+package, but every smoke that exercised them was *closed-loop*: clients
+waited for replies before sending more, so the offered rate politely
+collapsed to whatever the server could absorb and the defences were
+never driven past their knees.  Production traffic does not wait.  This
+package generates the open-loop regime — an arrival schedule fixed
+BEFORE the run, replayed against the wire no matter how the server
+responds — and closes the measure-model-refit loop on the admission
+plane the same way PR 3 closed it on the kernel chooser:
+
+* :mod:`.arrival` — seeded arrival-time schedules (constant / poisson /
+  burst / ramp); pure arithmetic over an injected seed, never
+  wall-clock (seqlint SEQ005, role ``deterministic``);
+* :mod:`.workload` — seeded request synthesis: seq2 length mix,
+  problem-key diversity (distinct weights+seq1 compile keys), deadline
+  mix;
+* :mod:`.replay` — request-trace record/replay at k× speed: a captured
+  schedule is a JSONL artifact, and re-running it is the controlled
+  A/B the refit loop needs;
+* :mod:`.driver` — the only wall-clock module: hundreds of concurrent
+  ndjson socket clients paced to the schedule (open-loop: a slow
+  server changes nothing about send times), every request classified
+  into a typed outcome;
+* :mod:`.gates` — machine-checked overload-survival gates: every
+  request answered or typed-rejected (no silent drops, no resets),
+  goodput retention past saturation, shed/breaker transition sequences
+  legal under the PR-9 hysteresis contract;
+* :mod:`.report` — the official ``formulation="serve-load"`` bench
+  record in the obs run-report envelope;
+* :mod:`.refit` — the closing loop: refit ``RequestCostModel`` scale
+  and the admission budget from measured launch gap rows (obs/trace)
+  and queue-wait percentiles, static model as the audited prior, drift
+  beyond tolerance reported as a finding, tuned knobs fed back through
+  the env registry (``SEQALIGN_SERVE_COST_SCALE``,
+  ``SEQALIGN_SERVE_COST_BUDGET_S``).
+
+``scripts/load_smoke.py`` (``make load-smoke``) drives the whole loop:
+calibrate the pre-saturation plateau, hold 2× and 5× saturation,
+enforce the survival gates, emit the serve-load record, refit, replay
+the same trace with the refit knobs, and require the p99 queue-wait to
+improve.  The package is pure library + stdlib (no jax import), so the
+generator can price and schedule without touching the accelerator.
+"""

@@ -454,6 +454,52 @@ def validate_report(rec) -> None:
     elif kind == "bench":
         if "metric" not in rec or "value" not in rec:
             problems.append("bench report: want metric and value fields")
+        if rec.get("formulation") == "serve-load":
+            # The load harness's official record (load/report.py):
+            # goodput + the SLO surface are schema, not convention.
+            for field in ("goodput_rps", "offered_rps", "duration_s"):
+                if not _is_finite_num(rec.get(field)):
+                    problems.append(
+                        f"serve-load report: {field}: want a finite "
+                        f"number, got {rec.get(field)!r}"
+                    )
+            reqs = rec.get("requests")
+            req_fields = (
+                "offered", "done", "rejected", "failed", "missing",
+                "reset",
+            )
+            if not isinstance(reqs, dict) or not all(
+                isinstance(reqs.get(k), int) for k in req_fields
+            ):
+                problems.append(
+                    f"serve-load report: requests: want int "
+                    f"{'/'.join(req_fields)}, got {reqs!r}"
+                )
+            for section in ("latency_s", "queue_wait_s"):
+                pct = rec.get(section)
+                if not isinstance(pct, dict) or not all(
+                    _is_finite_num(pct.get(k))
+                    for k in ("p50", "p90", "p99")
+                ):
+                    problems.append(
+                        f"serve-load report: {section}: want p50/p90/"
+                        f"p99 numbers, got {pct!r}"
+                    )
+            for field in ("shed_rate", "deadline_miss_rate"):
+                v = rec.get(field)
+                if not _is_finite_num(v) or not 0.0 <= float(v) <= 1.0:
+                    problems.append(
+                        f"serve-load report: {field}: want a rate in "
+                        f"[0, 1], got {v!r}"
+                    )
+            arr = rec.get("arrival")
+            if not isinstance(arr, dict) or not isinstance(
+                arr.get("process"), str
+            ) or not _is_finite_num(arr.get("rate_rps")):
+                problems.append(
+                    f"serve-load report: arrival: want an object with "
+                    f"process + rate_rps, got {arr!r}"
+                )
     elif kind == "schedule-audit":
         # scripts/schedule_audit.py's cost-sheet + trace-audit report.
         sheet = rec.get("cost_sheet")

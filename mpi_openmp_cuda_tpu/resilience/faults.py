@@ -99,6 +99,11 @@ rejected for them too:
 ``overload-burst``          this request arrives as part of a modelled burst
                             that exhausts the admission bucket on its own
                             (a typed ``overloaded`` rejection)
+``burst:overload``          sustained open-loop overload: this request is
+                            priced at 5x its modelled wall — the saturation
+                            regime the load harness (``load/``) drives for
+                            real, injectable here so the fleet-chaos tier can
+                            hold 5x while murdering workers
 ==========================  ==================================================
 
 Fleet marker sites (serve/fleet.py) shape worker-side failures the same
@@ -138,6 +143,9 @@ SERVE_SITES = frozenset(
         "dead-socket-midstream",
         "poison-session",
         "overload-burst",
+        # Colon-joined like the fleet sites: "burst" rides the grammar
+        # re-partition in parse_spec.
+        "burst:overload",
     }
 )
 
@@ -226,7 +234,7 @@ def parse_spec(spec: str) -> dict[str, SiteFaults]:
             continue
         site, sep, body = entry.partition(":")
         site = site.strip()
-        if site in ("hang", "kill", "zombie", "board", "lease"):
+        if site in ("hang", "kill", "zombie", "board", "lease", "burst"):
             # Survival/fleet sites carry a colon in the NAME
             # (hang:dispatch, zombie:fleet-worker): re-partition so the
             # first body segment joins the site.

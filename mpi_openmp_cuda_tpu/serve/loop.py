@@ -531,7 +531,10 @@ class ServeLoop:
                 )
         elif self.queue.depth() == 0:
             self.controller.note_idle()
-        self.controller.update_state()
+        # The tick timestamp marks the controller's drain-rate window
+        # (the measured retry_after_s hint); shed decisions stay
+        # clock-free inside.
+        self.controller.update_state(now)
         sessions = []
         for item in items:
             try:

@@ -14,10 +14,18 @@ Determinism contract (seqlint SEQ005, role ``deterministic``): pricing
 is pure host arithmetic over the request's lengths; the bucket refills
 on *completions*, not on a wall-clock rate, so the same submission
 sequence admits and rejects identically on every run.  The only
-time-derived inputs are the queue-wait observations the serve loop
-hands to :meth:`AdmissionController.observe_wait` (computed from the
-injectable ServeClock it already owns) — the controller itself never
-reads a clock.
+time-derived inputs are values the serve loop hands in from the
+injectable ServeClock it already owns — the queue-wait observations
+(:meth:`AdmissionController.observe_wait`) and the per-tick timestamp
+(:meth:`AdmissionController.update_state`) — the controller itself
+never reads a clock.  Those timestamps feed ONLY the ``retry_after_s``
+back-off *hint* (the measured bucket-drain rate); every admit/reject
+decision remains clock-free.
+
+The static cost model is an audited prior: ``load/refit.py`` refits it
+from measured launch gap rows, and the refit multiplier feeds back in
+through ``SEQALIGN_SERVE_COST_SCALE`` (env registry) — prices stay the
+modelled wall × one run-constant scale, so determinism is untouched.
 
 Shedding is a three-state machine, escalating one state per serve-loop
 tick on the p90 of recent queue waits and de-escalating with
@@ -27,10 +35,11 @@ hysteresis::
     accept <---(p90 < shed_wait_s/2)---- shed-new <--(p90 < .../2)--
 
 ``shed-new`` and ``drain-only`` both reject new admissions with a typed
-``overloaded`` error (``retry_after_s`` = the modelled wall of the
-outstanding work — an honest back-off hint); ``drain-only``
-additionally tells the loop to stop gathering (window 0) so the queue
-drains at full tilt.
+``overloaded`` error (``retry_after_s`` = the outstanding modelled wall
+divided by the *measured* completion-refill rate when one is available
+— an honest back-off hint proportional to actual saturation);
+``drain-only`` additionally tells the loop to stop gathering (window 0)
+so the queue drains at full tilt.
 """
 
 from __future__ import annotations
@@ -42,6 +51,7 @@ from ..obs.events import publish
 from ..obs.metrics import percentile as _percentile
 from ..resilience.faults import scheduled as _fault_scheduled
 from ..utils.constants import BUF_SIZE_SEQ1, BUF_SIZE_SEQ2
+from ..utils.platform import env_float
 
 _BLK = 128
 
@@ -53,6 +63,10 @@ _SHED_ORDER = (SHED_ACCEPT, SHED_NEW, SHED_DRAIN)
 
 # Queue-wait observations the shed percentile is computed over.
 DEFAULT_WAIT_WINDOW = 32
+
+# Per-tick (timestamp, released-total) marks the live bucket-drain
+# estimate is computed over: ~DRAIN_WINDOW serve-loop ticks of history.
+DRAIN_WINDOW = 16
 
 # The percentile driving shed transitions: one slow straggler must not
 # shed, a slow tail must.
@@ -95,12 +109,24 @@ class RequestCostModel:
     prices optimistically and lets the deadline checkpoints catch the
     rest.  Prices are memoised per block-count pair (the whole space is
     ~24x16 entries), so steady-state pricing is a dict lookup.
+
+    ``scale`` is the measured-load refit multiplier (the load harness's
+    closing loop): the modelled wall stays the audited prior, and a
+    refit run feeds ``measured/modelled`` back through the env registry
+    (``SEQALIGN_SERVE_COST_SCALE``, default 1.0 = trust the prior) so
+    the bucket prices in calibrated rather than theoretical seconds.
+    Run-constant, so admission stays deterministic per run.
     """
 
-    def __init__(self):
+    def __init__(self, *, scale: float | None = None):
         self._pair_wall: dict[tuple[int, int], float] = {}
+        if scale is None:
+            scale = env_float("SEQALIGN_SERVE_COST_SCALE", 1.0)
+        self.scale = max(0.0, float(scale)) or 1.0
 
     def pair_wall_s(self, len1: int, len2: int) -> float:
+        """UNSCALED modelled wall of one pair — the audited prior the
+        refit loop measures against."""
         nbn = max(1, _ceil_div(min(int(len1), BUF_SIZE_SEQ1), _BLK))
         nbi = max(1, _ceil_div(min(int(len2), BUF_SIZE_SEQ2), _BLK))
         key = (nbn, nbi)
@@ -123,7 +149,7 @@ class RequestCostModel:
             for s in seq2:
                 if isinstance(s, str) and s:
                     total += self.pair_wall_s(len(seq1), len(s))
-            return total
+            return total * self.scale
         except Exception:
             # advisory: admission cost estimate only — 0.0 admits the
             # request and the scorer's own contracts still gate it.
@@ -165,6 +191,14 @@ class AdmissionController:
         self._waits: collections.deque[float] = collections.deque(
             maxlen=max(1, int(wait_window))
         )
+        # Live drain estimate: lifetime released cost + per-tick
+        # (loop timestamp, released total) marks.  The timestamps are
+        # handed IN by the loop (update_state(now=...)) — never read
+        # here — and feed only the retry_after_s hint, not decisions.
+        self._released_total_s = 0.0
+        self._drain_marks: collections.deque[tuple[float, float]] = (
+            collections.deque(maxlen=DRAIN_WINDOW)
+        )
 
     @property
     def state(self) -> str:
@@ -173,11 +207,36 @@ class AdmissionController:
     def outstanding_s(self) -> float:
         return self._outstanding_s
 
+    def drain_rate(self) -> float:
+        """Measured completion-refill rate: modelled-cost seconds
+        released per wall second over the recent tick window (0.0 until
+        two ticks with completions between them have been observed)."""
+        with self._lock:
+            return self._drain_rate_locked()
+
+    def _drain_rate_locked(self) -> float:
+        if len(self._drain_marks) < 2:
+            return 0.0
+        t0, r0 = self._drain_marks[0]
+        t1, r1 = self._drain_marks[-1]
+        if t1 <= t0 or r1 <= r0:
+            return 0.0
+        return (r1 - r0) / (t1 - t0)
+
     def retry_after_s(self) -> float:
-        """Client back-off hint: the modelled wall of everything already
-        admitted (what must drain before new work fits), floored so a
-        zero-cost rejection still backs off."""
-        return round(max(0.05, self._outstanding_s), 3)
+        """Client back-off hint: the wall seconds until the outstanding
+        work drains at the MEASURED completion-refill rate (the live
+        token-bucket drain estimate) — so back-off is proportional to
+        actual saturation, not the cost model's optimism.  Before any
+        drain has been measured (cold start, first overload tick) it
+        falls back to the static prior — the modelled wall of the
+        outstanding work itself — and is floored so a zero-cost
+        rejection still backs off."""
+        with self._lock:
+            outstanding = self._outstanding_s
+            rate = self._drain_rate_locked()
+        hint = outstanding / rate if rate > 0.0 else outstanding
+        return round(max(0.05, hint), 3)
 
     def admit(self, raw: dict) -> tuple[str | None, float]:
         """Price one raw request and charge the bucket.  Returns
@@ -189,6 +248,11 @@ class AdmissionController:
             # Chaos marker: this request arrives as part of a modelled
             # burst that exhausts the bucket on its own.
             cost = cost + self.budget_s + 1.0
+        if _fault_scheduled("burst:overload"):
+            # Chaos marker: sustained open-loop overload — this request
+            # arrives priced at 5x its modelled wall, the saturation
+            # regime the load harness drives for real.
+            cost = cost * 5.0
         with self._lock:
             if self._state != SHED_ACCEPT:
                 return self._state, cost
@@ -209,6 +273,7 @@ class AdmissionController:
         abandoned, or rejected at validation)."""
         with self._lock:
             self._outstanding_s = max(0.0, self._outstanding_s - cost_s)
+            self._released_total_s += max(0.0, float(cost_s))
 
     def observe_wait(self, wait_s: float) -> None:
         """One popped request's queue wait (admission to pop)."""
@@ -222,10 +287,18 @@ class AdmissionController:
         with self._lock:
             self._waits.append(0.0)
 
-    def update_state(self) -> str:
+    def update_state(self, now: float | None = None) -> str:
         """One tick's shed transition (main loop thread only): move at
-        most one state toward where the wait percentile points."""
+        most one state toward where the wait percentile points.
+
+        ``now`` is the loop's ServeClock timestamp for this tick; it
+        marks the drain-rate window for :meth:`retry_after_s` and
+        touches no transition decision (those stay clock-free)."""
         with self._lock:
+            if now is not None:
+                self._drain_marks.append(
+                    (float(now), self._released_total_s)
+                )
             p = _percentile(self._waits, _WAIT_PCTL)
             cur = _SHED_ORDER.index(self._state)
             if p >= 4.0 * self.shed_wait_s:

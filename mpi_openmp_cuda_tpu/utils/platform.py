@@ -211,6 +211,17 @@ ENV_VARS: tuple[EnvVar, ...] = (
         "retry_after_s",
     ),
     EnvVar(
+        "SEQALIGN_SERVE_COST_SCALE",
+        "float",
+        1.0,
+        "admission cost-model refit multiplier (the load harness's "
+        "closing loop): request prices are the modelled superblock "
+        "wall x this scale, so a measured-load refit (load/refit.py, "
+        "scripts/load_smoke.py) can calibrate the bucket to observed "
+        "walls while the static model stays the audited prior; 1.0 = "
+        "trust the prior",
+    ),
+    EnvVar(
         "SEQALIGN_SERVE_SHED_WAIT_S",
         "float",
         30.0,

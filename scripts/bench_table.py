@@ -173,25 +173,38 @@ def load_bench_records(path: str) -> list[dict]:
     shared run-report envelope (``kind="bench"``) that bench.py emits
     since the observability plane landed.  Wrapped records are gated
     through :func:`validate_report` and unwrapped so callers see one
-    shape either way.
+    shape either way.  The file itself may be ndjson (one record per
+    line, bench.py stdout captures) or a single pretty-printed document
+    (scripts/load_smoke.py's ``serve_load_record.json``).
     """
     from mpi_openmp_cuda_tpu.obs.metrics import validate_report
 
-    records = []
+    def _unwrap(rec: dict) -> dict:
+        if "schema" in rec:
+            validate_report(rec)
+            rec = {
+                k: v
+                for k, v in rec.items()
+                if k not in ("schema", "schema_version", "kind", "meta")
+            }
+        return rec
+
     with open(path, encoding="utf-8") as fh:
-        for line in fh:
-            line = line.strip()
-            if not line.startswith("{"):
-                continue
-            rec = json.loads(line)
-            if "schema" in rec:
-                validate_report(rec)
-                rec = {
-                    k: v
-                    for k, v in rec.items()
-                    if k not in ("schema", "schema_version", "kind", "meta")
-                }
-            records.append(rec)
+        text = fh.read()
+    try:
+        doc = json.loads(text)
+    except json.JSONDecodeError:
+        doc = None
+    if isinstance(doc, dict):
+        return [_unwrap(doc)]
+    if isinstance(doc, list):
+        return [_unwrap(rec) for rec in doc if isinstance(rec, dict)]
+    records = []
+    for line in text.splitlines():
+        line = line.strip()
+        if not line.startswith("{"):
+            continue
+        records.append(_unwrap(json.loads(line)))
     return records
 
 
@@ -201,6 +214,47 @@ def recorded_row(rec: dict) -> str:
         f"| {rec['metric']} | {rec['value']:.4g} {rec.get('unit', '')} "
         f"| {f'{vs:.3g}x' if isinstance(vs, (int, float)) else 'n/a'} |"
     )
+
+
+def _pctl_cell(pctls: dict) -> str:
+    return "/".join(
+        f"{float(pctls.get(p, 0.0)) * 1e3:.0f}" for p in ("p50", "p90", "p99")
+    )
+
+
+def serve_load_row(rec: dict) -> str:
+    """One row of the serve-load table (load/report.py record shape)."""
+    arr = rec.get("arrival") or {}
+    reqs = rec.get("requests") or {}
+    retention = rec.get("goodput_retention")
+    answered = (
+        reqs.get("done", 0) + reqs.get("rejected", 0) + reqs.get("failed", 0)
+    )
+    offered = max(1, reqs.get("offered", 1))
+    return (
+        f"| {arr.get('process', '?')} @ {arr.get('rate_rps', 0.0):.1f} req/s "
+        f"(k={arr.get('speedup_k', 1.0):.3g}, {arr.get('clients', '?')} cl) "
+        f"| {rec.get('offered_rps', 0.0):.3g} "
+        f"| {rec.get('goodput_rps', 0.0):.3g} "
+        f"| {answered}/{offered} "
+        f"| {_pctl_cell(rec.get('latency_s') or {})} "
+        f"| {_pctl_cell(rec.get('queue_wait_s') or {})} "
+        f"| {rec.get('shed_rate', 0.0) * 100:.1f}% "
+        f"| {rec.get('deadline_miss_rate', 0.0) * 100:.1f}% "
+        f"| {rec.get('batch_fill_ratio', 0.0):.2f} "
+        f"| {f'{retention:.2f}x' if isinstance(retention, (int, float)) else 'n/a'} |"
+    )
+
+
+def print_serve_load_table(records: list[dict]) -> None:
+    print(
+        "| Arrival (open-loop) | Offered req/s | Goodput req/s "
+        "| Answered | Latency p50/p90/p99 ms | Queue-wait p50/p90/p99 ms "
+        "| Shed | Deadline miss | Batch fill | Retention |"
+    )
+    print("|---|---|---|---|---|---|---|---|---|---|")
+    for rec in records:
+        print(serve_load_row(rec))
 
 
 def main() -> None:
@@ -213,15 +267,30 @@ def main() -> None:
         "--from-json",
         metavar="PATH",
         help="tabulate previously recorded bench.py output (either blob "
-        "shape: bare record or run-report envelope) instead of measuring",
+        "shape: bare record or run-report envelope) instead of measuring; "
+        "serve-load records (scripts/load_smoke.py) render as their own "
+        "goodput/latency/queue-wait table next to the kernel rows",
     )
     args = ap.parse_args()
 
     if args.from_json:
-        print("| Metric | Value | vs baseline |")
-        print("|---|---|---|")
-        for rec in load_bench_records(args.from_json):
-            print(recorded_row(rec))
+        records = load_bench_records(args.from_json)
+        # Serve-load records (load/report.py) carry a whole SLO surface,
+        # not one scalar — render them as their own table next to the
+        # kernel rows so goodput and queue-wait sit beside elem/s.
+        serve_load = [
+            r for r in records if r.get("formulation") == "serve-load"
+        ]
+        kernel = [r for r in records if r.get("formulation") != "serve-load"]
+        if kernel:
+            print("| Metric | Value | vs baseline |")
+            print("|---|---|---|")
+            for rec in kernel:
+                print(recorded_row(rec))
+        if serve_load:
+            if kernel:
+                print()
+            print_serve_load_table(serve_load)
         return
 
     print("| Config | Hardware | Measured | vs est. reference (2.0e9 elem/s) |")

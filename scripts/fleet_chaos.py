@@ -29,6 +29,12 @@ stalled along the way:
   and answers every request — replies byte-identical to the clean
   fleetless baseline, the dead generation's board debris fenced and
   swept;
+* **burst-overload**: sustained 5x admission overload
+  (``burst:overload``) while the claiming worker is SIGKILLed — the
+  bucket sheds every excess request with a TYPED ``overloaded`` +
+  retry-hint reply (zero drops, zero doubles), and the one admitted
+  request still survives the kill via re-dispatch within one lease
+  window, byte-identical to the clean run;
 * **usage**: ``--fleet-worker`` (or ``--fleet-standby``) without
   ``--fleet-board`` is a hard exit 64.
 
@@ -123,12 +129,12 @@ def _parse_records(text, *, tolerant=False):
 
 
 def _run_coordinator(out_dir, name, *, board=None, faults=None,
-                     env_extra=None, expect_kill=False):
+                     env_extra=None, expect_kill=False, reqs=REQS):
     """One pipe-mode --serve subprocess (the fleet coordinator when
     ``board`` is set); returns (rc, records, report, stderr)."""
     reqfile = os.path.join(out_dir, f"{name}.ndjson")
     with open(reqfile, "w", encoding="utf-8") as fh:
-        for raw in REQS:
+        for raw in reqs:
             fh.write(json.dumps(raw) + "\n")
     report_path = os.path.join(out_dir, f"{name}.report.json")
     argv = [
@@ -535,6 +541,136 @@ def scenario_coordinator_kill(out_dir, baseline, problems):
     _stale_key_gate(name, board, problems)
 
 
+def scenario_burst_overload(out_dir, baseline, problems):
+    """Sustained 5x overload while a worker is murdered: the admission
+    bucket sheds TYPED rejections only, the one admitted request
+    survives the kill -9 + re-dispatch exactly once, and nothing is
+    dropped or doubled.
+
+    Staging makes both halves deterministic.  Admission: the env scale
+    prices the baseline request at exactly 1.0 modelled-second against
+    a 2.0 s budget, so r1 (bucket empty — always admits) charges half
+    the budget; ``burst:overload:fail=8,after=1`` skips r1's probe and
+    prices every follower at 5x (5.0 s > the 1.0 s remaining), so all
+    eight shed with ``overloaded`` + the retry hint while r1 is still
+    outstanding on the fleet.  Fleet: same relief staging as
+    kill-worker — the doomed worker is the only member at dispatch,
+    claims r1's superblock and dies; the survivor enlists inside the
+    8 s lease window, the death is declared, and the block re-dispatches
+    to it within one lease expiry."""
+    name = "burst-overload"
+    from mpi_openmp_cuda_tpu.serve.slo import RequestCostModel
+
+    prior_s = RequestCostModel(scale=1.0).request_cost_s(REQS[0])
+    if prior_s <= 0.0:
+        problems.append(
+            f"{name}: the cost model priced the baseline request at "
+            f"{prior_s}; cannot stage the bucket"
+        )
+        return
+    fleet_env = {
+        "SEQALIGN_LEASE_S": "8",
+        "SEQALIGN_FLEET_WORKERS": "2",
+        "SEQALIGN_SERVE_COST_SCALE": f"{1.0 / prior_s:.9g}",
+        "SEQALIGN_SERVE_COST_BUDGET_S": "2.0",
+    }
+    overload = [
+        {"id": f"o{i}", "weights": WEIGHTS, "seq1": SEQ1, "seq2": ["TTTT"]}
+        for i in range(1, 9)
+    ]
+    board = os.path.join(out_dir, f"{name}.board")
+    doomed, doomed_log = _spawn_worker(
+        out_dir, board, f"{name}-doomed",
+        faults="kill:fleet-worker:fail=1",
+    )
+    survivor = survivor_log = None
+    try:
+        if not _wait_registered(board, 1):
+            problems.append(f"{name}: doomed worker never registered")
+            return
+        import threading
+
+        def _relieve():
+            doomed.wait()
+            nonlocal survivor, survivor_log
+            survivor, survivor_log = _spawn_worker(
+                out_dir, board, f"{name}-survivor"
+            )
+
+        relief = threading.Thread(target=_relieve, daemon=True)
+        relief.start()
+        rc, records, report, stderr = _run_coordinator(
+            out_dir, name, board=board,
+            faults="burst:overload:fail=8,after=1",
+            env_extra=fleet_env,
+            reqs=[REQS[0]] + overload,
+        )
+        relief.join(timeout=30)
+    finally:
+        doomed_rc = _reap(doomed, doomed_log)
+        if survivor is not None:
+            _reap(survivor, survivor_log)
+    if rc != 0:
+        problems.append(f"{name}: coordinator exit code: want 0, got {rc}")
+        sys.stderr.write(stderr)
+    if "Traceback" in stderr:
+        problems.append(f"{name}: coordinator crashed (Traceback on stderr)")
+    if doomed_rc != -signal.SIGKILL:
+        problems.append(
+            f"{name}: doomed worker must die by SIGKILL, got rc {doomed_rc}"
+        )
+    if report is None:
+        problems.append(f"{name}: no readable run report")
+    else:
+        try:
+            validate_report(report)
+        except ValueError as e:
+            problems.append(f"{name}: {e}")
+        if report["gauges"].get("shed_state") != "accept":
+            problems.append(
+                f"{name}: bucket sheds must not trip the wait-driven shed "
+                f"machine: want shed_state 'accept', got "
+                f"{report['gauges'].get('shed_state')!r}"
+            )
+    # Exactly once, nothing dropped, nothing doubled: r1's transcript is
+    # byte-identical to the clean fleetless run even though its worker
+    # was murdered mid-score; every overload id gets exactly one TYPED
+    # rejection with the retry hint.
+    got = _by_id(records)
+    if got.get("r1") != baseline.get("r1"):
+        problems.append(
+            f"{name}: r1 must survive the kill byte-identical to the "
+            f"clean run; want {baseline.get('r1')}, got {got.get('r1')}"
+        )
+    for raw in overload:
+        oid = raw["id"]
+        recs = [r for r in records if r.get("id") == oid]
+        if len(recs) != 1:
+            problems.append(
+                f"{name}: {oid}: want exactly one reply, got {len(recs)}: "
+                f"{recs}"
+            )
+            continue
+        rec = recs[0]
+        if rec.get("error") != "overloaded":
+            problems.append(
+                f"{name}: {oid}: want a typed 'overloaded' shed, got {rec}"
+            )
+        ra = rec.get("retry_after_s")
+        if not isinstance(ra, (int, float)) or ra <= 0:
+            problems.append(
+                f"{name}: {oid}: overloaded shed lacks a positive "
+                f"retry_after_s hint, got {ra!r}"
+            )
+    _counter_gates(name, report, {
+        "serve_shed": 8,
+        "fleet_joins": 2,
+        "fleet_deaths": 1,
+        "fleet_redispatches": 1,
+    }, problems)
+    _stale_key_gate(name, board, problems)
+
+
 def scenario_usage(out_dir, problems):
     """--fleet-worker / --fleet-standby without --fleet-board: exit 64."""
     name = "usage"
@@ -566,6 +702,7 @@ def main() -> int:
         scenario_torn_post(out_dir, baseline, problems)
         scenario_lease_stall(out_dir, baseline, problems)
         scenario_coordinator_kill(out_dir, baseline, problems)
+        scenario_burst_overload(out_dir, baseline, problems)
     scenario_usage(out_dir, problems)
     if problems:
         for p in problems:
@@ -574,6 +711,7 @@ def main() -> int:
     print(
         "fleet-chaos: OK (kill -9 redispatch, zombie fence, torn post, "
         "lease stall, coordinator kill -9 -> standby takeover, "
+        "burst overload under worker kill, "
         f"usage gates; artifacts={out_dir})"
     )
     return 0

@@ -212,6 +212,138 @@ class TestShedMachine:
         assert c.outstanding_s() == 1.0  # the rejected charge came back
 
 
+# -- measured drain-rate back-off hint ---------------------------------------
+
+
+class TestDrainEstimate:
+    """``retry_after_s`` from the MEASURED completion-refill rate:
+    ``update_state(now)`` marks the tick window (timestamps handed in,
+    never read), ``release`` grows the lifetime refill total, and the
+    hint is outstanding work over that measured rate — falling back to
+    the modelled outstanding wall until a drain has been observed."""
+
+    def test_hint_is_outstanding_over_measured_rate(self):
+        c = _controller(budget=100.0)
+        c.admit({"cost": 30.0})
+        c.update_state(10.0)  # mark (t=10, released 0)
+        c.release(5.0)
+        c.release(5.0)
+        c.update_state(20.0)  # mark (t=20, released 10) → 1.0 cost-s/s
+        assert c.drain_rate() == pytest.approx(1.0)
+        # 20 modelled-seconds outstanding at 1.0/s → a 20 s hint.
+        assert c.retry_after_s() == pytest.approx(20.0)
+
+    def test_single_mark_falls_back_to_modelled_outstanding(self):
+        c = _controller(budget=100.0)
+        c.admit({"cost": 7.0})
+        c.update_state(1.0)  # one mark is a point, not a rate
+        assert c.drain_rate() == 0.0
+        assert c.retry_after_s() == pytest.approx(7.0)
+
+    def test_marks_without_completions_keep_the_fallback(self):
+        c = _controller(budget=100.0)
+        c.admit({"cost": 7.0})
+        c.update_state(1.0)
+        c.update_state(2.0)  # ticks passed, nothing drained
+        assert c.drain_rate() == 0.0
+        assert c.retry_after_s() == pytest.approx(7.0)
+
+    def test_rate_spans_first_to_last_mark(self):
+        c = _controller(budget=100.0)
+        c.update_state(0.0)
+        c.release(4.0)
+        c.update_state(2.0)
+        c.release(4.0)
+        c.update_state(4.0)  # (0, 0) .. (4, 8) → 2.0 cost-s/s
+        assert c.drain_rate() == pytest.approx(2.0)
+
+
+# -- hysteresis under bursty open-loop arrivals ------------------------------
+
+
+class TestBurstyHysteresis:
+    """The shed machine under the load plane's *burst* arrival shape
+    (``load/arrival.burst_times``) on a fake tick clock: whole groups
+    land at once, queue waits spike, the gaps go idle.  The contract
+    under that shape: escalation moves ONE state per tick (never
+    teleports, however hard the p90 jumps), the hysteresis band holds
+    between bursts, and the idle tail decays all the way back."""
+
+    def _simulate(self, offsets, *, shed, window=8):
+        """Tick-stepped single-server queue simulation, feeding the
+        controller exactly what the serve loop would each tick: one
+        ``observe_wait`` per popped request, ``note_idle`` on an empty
+        queue, one ``update_state(now)``.  Service is one request per
+        tick; waits are arrival-to-pop on the fake clock.  Runs until
+        the backlog is drained AND enough idle ticks have flushed the
+        wait window for the decay path to finish."""
+        c = _controller(shed=shed, window=window)
+        pending = sorted(offsets)
+        queue: list = []
+        states = []
+        t = 0.0
+        idle = 0
+        while t < 500.0:  # safety bound; real runs end far earlier
+            while pending and pending[0] <= t:
+                queue.append(pending.pop(0))
+            if queue:
+                c.observe_wait(t - queue.pop(0))
+                idle = 0
+            else:
+                c.note_idle()
+                idle += 1
+            states.append(c.update_state(t))
+            t += 1.0
+            if not pending and not queue and idle >= window + 4:
+                break
+        return states
+
+    def test_burst_waves_escalate_stepwise_and_decay(self):
+        from mpi_openmp_cuda_tpu.load.arrival import burst_times
+
+        # Two 20-deep bursts at an average 2 req/s (groups 10 s apart);
+        # 1 req/tick service means waits climb past 4x the 4 s
+        # threshold, so the machine is driven all the way to drain-only.
+        offsets = burst_times(40, 2.0, burst_size=20)
+        states = self._simulate(offsets, shed=4.0)
+        assert SHED_NEW in states and SHED_DRAIN in states
+        order = (SHED_ACCEPT, SHED_NEW, SHED_DRAIN)
+        for prev, cur in zip([SHED_ACCEPT] + states, states):
+            assert abs(order.index(cur) - order.index(prev)) <= 1, (
+                f"teleported {prev} -> {cur} in {states}"
+            )
+        # The idle tail decayed the machine back to accept.
+        assert states[-1] == SHED_ACCEPT
+
+    def test_mild_bursts_stay_in_the_hysteresis_band(self):
+        from mpi_openmp_cuda_tpu.load.arrival import burst_times
+
+        # 4-deep bursts every 8 s: each group drains (1 req/tick) well
+        # before the next lands, so the worst wait is 3 ticks < the
+        # 8 s threshold and the machine never leaves accept.
+        offsets = burst_times(16, 0.5, burst_size=4)
+        states = self._simulate(offsets, shed=8.0)
+        assert set(states) == {SHED_ACCEPT}
+
+    def test_sustained_bursts_hold_shed_between_groups(self):
+        from mpi_openmp_cuda_tpu.load.arrival import burst_times
+
+        # 12-deep bursts every 6 s against 1 req/tick service: the
+        # queue never clears between groups, waits sit above the 4 s
+        # threshold but below 4x it — the machine reaches shed-new and
+        # HOLDS there through the gaps (no accept/shed flapping) until
+        # the schedule ends and the backlog drains.
+        offsets = burst_times(36, 2.0, burst_size=12)
+        states = self._simulate(offsets, shed=4.0)
+        first_shed = states.index(SHED_NEW)
+        last_shed = len(states) - 1 - states[::-1].index(SHED_NEW)
+        mid = states[first_shed:last_shed + 1]
+        assert SHED_ACCEPT not in mid, (
+            f"shed machine flapped back to accept mid-overload: {states}"
+        )
+        assert states[-1] == SHED_ACCEPT  # but the tail still decays
+
+
 # -- circuit breaker ---------------------------------------------------------
 
 
