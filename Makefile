@@ -18,7 +18,7 @@ PY_CFLAGS  := $(shell $(PYCONFIG) --includes)
 PY_LDFLAGS := $(shell $(PYCONFIG) --ldflags --embed)
 INPUT      ?= /root/reference/input5.txt
 
-.PHONY: build run run2 runOn2 test chaos chaos-kill analyze schedule-audit concurrency-audit donation-audit comms-audit ranges-audit exitpath-audit metrics-smoke serve-smoke serve-chaos fleet-chaos load-smoke aot-smoke trace-smoke bench bench-table bench-gather check clean
+.PHONY: build run run2 runOn2 test chaos chaos-kill analyze schedule-audit concurrency-audit donation-audit comms-audit ranges-audit exitpath-audit metrics-smoke serve-smoke serve-chaos fleet-chaos fleet-trace-smoke load-smoke aot-smoke trace-smoke bench bench-table bench-gather check clean
 
 build: final
 
@@ -209,6 +209,16 @@ load-smoke:
 # loss, no doubles).  CPU-only, under a minute.
 fleet-chaos:
 	JAX_PLATFORMS=cpu $(PYTHON) scripts/fleet_chaos.py
+
+# Fleet observability smoke gate (docs/ARCHITECTURE.md §10): a real
+# coordinator (--serve --port 0 --telemetry-port 0 --fleet-board) plus
+# two --fleet-worker subprocesses, one SIGKILLed mid-run — gate trace-id
+# propagation onto worker launches, the five-phase board attribution
+# (totals == sums), worker-labelled /metrics federation for both
+# workers, the dead worker's collected flight-recorder tape, and the
+# merged per-worker Perfetto tracks.  CPU-only, seconds.
+fleet-trace-smoke:
+	JAX_PLATFORMS=cpu $(PYTHON) scripts/fleet_trace_smoke.py
 
 # Tracing-tier smoke gate (docs/ARCHITECTURE.md §10): boot --serve
 # --port 0 --telemetry-port 0 --trace-out, run 2 coalescing clients,

@@ -100,6 +100,15 @@ SEQ014   every broad handler (``except:`` / ``except Exception``) in a
          finding (``analysis/exitflow.py``, ``make exitpath-audit``) —
          cheap enough to run on every ``make analyze``, while exitflow
          proves the whole propagation graph behind it.
+SEQ015   every WORK-UNIT board post in the serving plane carries trace
+         context: a ``json.dumps({...})`` dict literal with both
+         ``"bid"`` and ``"rows"`` keys (the fleet offer/result payload
+         shape — a superblock crossing a process boundary) must also
+         carry a ``"traces"`` key, so the admission-minted trace ids
+         survive the hop and the coordinator's merged timeline can link
+         remote launches back to their requests.  Control posts
+         (claims, heartbeats, checkpoints, registrations) carry no rows
+         and are out of scope.
 =======  ==================================================================
 
 Suppression: append ``# seqlint: disable=SEQ00N`` to the offending line
@@ -1118,6 +1127,34 @@ class _Linter(ast.NodeVisitor):
                     "(serve/clock.py) so tests drive a fake clock and "
                     "drain signals stay bounded",
                 )
+
+        # SEQ015: work-unit board posts must carry trace context.  The
+        # payload shape IS the signature: a serialized dict literal with
+        # both "bid" and "rows" is a superblock crossing the board (the
+        # fleet offer/result protocol) and must propagate "traces" too.
+        if self.in_serve:
+            is_dumps = (
+                isinstance(func, ast.Attribute)
+                and func.attr == "dumps"
+                and isinstance(func.value, ast.Name)
+                and func.value.id == "json"
+            ) or (isinstance(func, ast.Name) and func.id == "dumps")
+            if is_dumps and node.args and isinstance(node.args[0], ast.Dict):
+                keys = {
+                    k.value
+                    for k in node.args[0].keys
+                    if isinstance(k, ast.Constant)
+                    and isinstance(k.value, str)
+                }
+                if {"bid", "rows"} <= keys and "traces" not in keys:
+                    self._emit(
+                        "SEQ015",
+                        node,
+                        "work-unit board payload (bid + rows) without a "
+                        "`traces` key; propagate the admission-minted "
+                        "trace ids over the board so the fleet timeline "
+                        "links remote launches back to their requests",
+                    )
 
         # SEQ010: blocking ops lexically under a held serve lock.
         self._check_seq010(node)

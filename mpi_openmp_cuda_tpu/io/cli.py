@@ -1081,12 +1081,21 @@ def run(argv: list[str] | None = None) -> int:
         # --serve arms it unconditionally: the flight recorder must be
         # taping before the first request so a later wedge has history.
         obs_on, metrics_out, heartbeat_s, trace_out = _build_obs(args)
-        if obs_on or args.serve or args.fleet_standby:
+        if obs_on or args.serve or args.fleet_standby or args.fleet_worker:
+            # A --fleet-worker always arms trace + flightrec: its board
+            # snapshots (metrics, recent trace events, the tape the
+            # coordinator collects post-mortem) need armed planes to
+            # have any content.
             registry, recorder = arm_observability(
-                with_trace=bool(trace_out),
+                with_trace=bool(trace_out) or bool(args.fleet_worker),
                 flightrec_depth=(
                     env_int("SEQALIGN_FLIGHTREC_DEPTH", 256)
-                    if (args.serve or args.fleet_standby or obs_on)
+                    if (
+                        args.serve
+                        or args.fleet_standby
+                        or args.fleet_worker
+                        or obs_on
+                    )
                     else 0
                 ),
             )

@@ -88,7 +88,8 @@ class ChunkPipeline:
         )
 
     def dispatch(
-        self, seq1_codes, codes, weights, budget, links=None, staged=None
+        self, seq1_codes, codes, weights, budget, links=None, staged=None,
+        trace_ctx=None,
     ):
         """Async-dispatch a chunk under the shared budget; on budget
         exhaustion with --degrade, fall down the backend chain with a
@@ -96,6 +97,9 @@ class ChunkPipeline:
         contract for :meth:`materialise`.  ``links`` is the list of
         request ids riding this launch (serve mode; None in batch/
         stream), recorded on the trace plane's launch span.
+        ``trace_ctx`` is the propagated fleet stamp (originating trace
+        ids, worker id, lease epoch) a --fleet-worker threads onto its
+        launch rows; None everywhere else so local rows are unchanged.
 
         Donation anchor: ``seq1_codes``/``codes`` stay HOST arrays all
         the way down this ladder — every (re)dispatch re-stages fresh
@@ -149,6 +153,7 @@ class ChunkPipeline:
             links=links or (),
             len1=seq1_codes.size,
             lens=[c.size for c in codes],
+            ctx=trace_ctx,
         )
         return promise
 

@@ -167,3 +167,56 @@ def gather_fleet(
             continue
         if isinstance(snap, dict):
             reg.record_fleet(w, snap)
+
+
+# -- the fleet observability plane (serve/fleet.py) ------------------------
+
+
+def post_worker_snapshot(
+    board, wid: str, t_board: float, *, beat: int = 0, trace_limit: int = 2000
+) -> None:
+    """Fleet-worker side of the serve-fleet obs plane: post ONE bounded
+    observability snapshot to ``obs_snapshot_key(wid)``, overwritten in
+    place each cadence (the board holds only the newest).  The payload
+    bundles the registry snapshot (metrics federation), the newest
+    trace events (timeline merge), the flight-recorder tape (post-
+    mortem collection when this worker is declared dead), and the
+    clock-bridge pair: ``t_board`` (the worker's ServeClock reading,
+    sampled by the caller immediately before this call) next to
+    ``t_trace_us`` (its trace clock, sampled here back-to-back) — the
+    coordinator subtracts the pair to map trace timestamps onto board
+    time, then its offset estimate maps board time across processes.
+
+    Same absence-over-negotiation stance as :func:`post_host_snapshot`:
+    planes that are not armed simply leave their key out."""
+    from ..resilience.membership import obs_snapshot_key
+    from .flightrec import active_flightrec
+    from .trace import active_trace
+
+    snap: dict = {
+        "wid": str(wid),
+        "pid": os.getpid(),
+        "beat": int(beat),
+        "t_board": float(t_board),
+    }
+    reg = _metrics.active_metrics()
+    if reg is not None:
+        snap["metrics"] = reg.snapshot()
+    tracer = active_trace()
+    if tracer is not None:
+        snap["t_trace_us"] = tracer.now_us()
+        snap["trace"] = {"events": tracer.snapshot_events(trace_limit)}
+    rec = active_flightrec()
+    if rec is not None:
+        snap["tape"] = rec.snapshot_tape()
+    board.post(obs_snapshot_key(str(wid)), json.dumps(snap))
+
+
+def collect_worker_snapshot(board, wid: str) -> dict | None:
+    """Coordinator side: the newest snapshot a worker posted, or None
+    when missing/torn/alien (absence over negotiation — a worker that
+    never armed its obs plane, or died before its first post, simply
+    contributes nothing)."""
+    from ..resilience.membership import read_obs_snapshot
+
+    return read_obs_snapshot(board, str(wid))

@@ -46,6 +46,10 @@ DUMP_TRIGGERS = {
     "watchdog.expiry": "watchdog-expiry",
     "breaker.open": "breaker-open",
     "worker.dead": "worker-dead",
+    # Failover events: a standby taking over or a deposed leader being
+    # fenced is exactly the moment the pre-incident tape matters.
+    "leader.takeover": "leader-takeover",
+    "leader.fenced": "leader-fenced",
 }
 
 
@@ -99,6 +103,16 @@ class FlightRecorder:
                 "name": path,
                 "dur_s": round(dur, 9),
             })
+
+    def snapshot_tape(self, limit: int | None = None) -> list[dict]:
+        """The newest ``limit`` ring entries, detached — the bounded
+        tape a fleet worker posts with its observability snapshot so
+        the coordinator can collect it post-mortem."""
+        with self._lock:
+            tape = list(self._events)
+        if limit is not None:
+            tape = tape[-int(limit):]
+        return [dict(e) for e in tape]
 
     # -- dumping -----------------------------------------------------------
 
@@ -179,3 +193,43 @@ def dump_active(reason: str) -> str | None:
     if rec is not None:
         return rec.dump(reason)
     return None
+
+
+def dump_fleet_tape(wid: str, events, reason: str) -> str | None:
+    """Write a tape COLLECTED from a fleet worker (its last posted
+    observability snapshot) as a ``kind="flightrec"`` envelope in the
+    same dump directory — the coordinator calls this when it declares
+    the worker dead, so the worker's final seconds survive its own
+    inability to dump.  Never raises; returns the path or None."""
+    try:
+        evs = [
+            dict(e) for e in events
+            if isinstance(e, dict)
+            and e.get("kind") in ("event", "span")
+            and e.get("name")
+        ]
+        rec = FlightRecorder(depth=max(1, len(evs)))
+        rec_body = wrap_report("flightrec", {
+            "reason": f"{reason}:{wid}",
+            "depth": rec.depth,
+            "dropped": 0,
+            "events": evs,
+            "worker": str(wid),
+        })
+        dump_dir = rec._dump_dir()
+        os.makedirs(dump_dir, exist_ok=True)
+        path = os.path.join(
+            dump_dir, f"fleet-tape-{wid}-{os.getpid()}-{reason}.json"
+        )
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            f.write(json.dumps(rec_body, indent=2, sort_keys=True) + "\n")
+        os.replace(tmp, path)
+        log_line(
+            f"mpi_openmp_cuda_tpu: collected fleet tape "
+            f"({len(evs)} events) from {wid} to {path} ({reason})"
+        )
+        return path
+    except Exception:
+        # advisory: post-mortem best-effort, same contract as dump().
+        return None

@@ -250,6 +250,10 @@ class MetricsRegistry:
             self.inc("fleet_depositions")
         elif event == "board.gc":
             self.inc("fleet_gc_swept", int(fields.get("count", 0)))
+        elif event == "fleet.score.start":
+            self.inc("fleet_scores_started")
+        elif event == "fleet.tape.collected":
+            self.inc("fleet_tapes_collected")
         elif event == "serve.request.duplicate":
             self.inc("serve_duplicates")
         elif event.startswith("breaker."):
@@ -1020,4 +1024,76 @@ def to_prometheus(snapshot: dict, *, prefix: str = "seqalign") -> str:
         lines.append(_help_line(m, "uptime_seconds", "Uptime in seconds"))
         lines.append(f"# TYPE {m} gauge")
         lines.append(f"{m} {_fmt_num(up)}")
+    return "\n".join(lines) + "\n"
+
+
+def fleet_to_prometheus(
+    fleet: dict, *, prefix: str = "seqalign", skip_heads=()
+) -> str:
+    """Federated exposition of gathered per-worker registry snapshots
+    (``registry.fleet``): the same families :func:`to_prometheus`
+    renders for the local process, each sample labelled with its
+    ``worker="wid"`` origin so one coordinator scrape covers the whole
+    fleet.  HELP/TYPE lines are emitted once per family (Prometheus
+    rejects duplicates) and suppressed for families in ``skip_heads``
+    (the ones the local exposition already declared), samples once per
+    worker.  Histograms federate as their count/sum plus
+    min/max/percentile gauges — per-worker cumulative buckets would
+    multiply the payload for little signal."""
+    lines: list[str] = []
+    seen: set[str] = set(skip_heads)
+
+    def _head(m: str, name: str, mtype: str, fallback: str) -> None:
+        if m not in seen:
+            seen.add(m)
+            lines.append(_help_line(m, name, fallback))
+            lines.append(f"# TYPE {m} {mtype}")
+
+    for wid in sorted(fleet):
+        snap = fleet[wid]
+        if not isinstance(snap, dict):
+            continue
+        lab = f'worker="{wid}"'
+        counters = snap.get("counters") or {}
+        for name in sorted(counters):
+            m = f"{prefix}_{name.replace('.', '_')}_total"
+            _head(m, name, "counter", f"Total {name.replace('_', ' ')}")
+            lines.append(f"{m}{{{lab}}} {_fmt_num(counters[name])}")
+        gauges = snap.get("gauges") or {}
+        for name in sorted(gauges):
+            v = gauges[name]
+            m = f"{prefix}_{name.replace('.', '_')}"
+            if isinstance(v, (int, float)) and not isinstance(v, bool):
+                _head(m, name, "gauge", f"Current {name.replace('_', ' ')}")
+                lines.append(f"{m}{{{lab}}} {_fmt_num(v)}")
+            else:
+                _head(
+                    f"{m}_info", name, "gauge",
+                    f"Current {name.replace('_', ' ')}",
+                )
+                lines.append(f'{m}_info{{{lab},value="{v}"}} 1')
+        hists = snap.get("histograms") or {}
+        for name in sorted(hists):
+            h = hists[name]
+            if not isinstance(h, dict) or "count" not in h:
+                continue
+            m = f"{prefix}_{name.replace('.', '_')}"
+            _head(
+                m, name, "summary",
+                f"Distribution of {name.replace('_', ' ')}",
+            )
+            lines.append(f"{m}_count{{{lab}}} {_fmt_num(h['count'])}")
+            lines.append(f"{m}_sum{{{lab}}} {_fmt_num(h.get('sum', 0))}")
+            for field in ("min", "max", "p50", "p90", "p99"):
+                if field in h:
+                    mf = f"{m}_{field}"
+                    _head(mf, name, "gauge", f"{field} of {name}")
+                    lines.append(f"{mf}{{{lab}}} {_fmt_num(h[field])}")
+        up = snap.get("uptime_s")
+        if up is not None:
+            m = f"{prefix}_uptime_seconds"
+            _head(m, "uptime_seconds", "gauge", "Uptime in seconds")
+            lines.append(f"{m}{{{lab}}} {_fmt_num(up)}")
+    if not lines:
+        return ""
     return "\n".join(lines) + "\n"

@@ -34,7 +34,7 @@ import http.server
 import json
 import threading
 
-from .metrics import active_metrics, to_prometheus
+from .metrics import active_metrics, fleet_to_prometheus, to_prometheus
 from .trace import active_trace
 
 #: Transient-retry budget for lock-free registry snapshots (see module
@@ -55,6 +55,39 @@ def live_snapshot() -> dict:
         except RuntimeError:
             continue
     return reg.snapshot()
+
+
+def live_fleet() -> dict:
+    """A detached copy of the gathered per-worker snapshots
+    (``registry.fleet`` — empty when unarmed or no fleet), retried
+    across concurrent mutation like :func:`live_snapshot`."""
+    reg = active_metrics()
+    if reg is None or not reg.fleet:
+        return {}
+    for _ in range(_SNAPSHOT_TRIES - 1):
+        try:
+            return dict(reg.fleet)
+        except RuntimeError:
+            continue
+    return dict(reg.fleet)
+
+
+def render_metrics() -> str:
+    """The full ``/metrics`` body: the local registry's exposition plus
+    the federated per-worker families (``worker="wid"`` labels) when
+    the coordinator has gathered fleet snapshots.  Fleet HELP/TYPE
+    heads are suppressed for families the local section already
+    declared — one declaration per family, samples per origin."""
+    local = to_prometheus(live_snapshot())
+    fleet = live_fleet()
+    if not fleet:
+        return local
+    heads = {
+        ln.split()[2]
+        for ln in local.splitlines()
+        if ln.startswith("# TYPE ")
+    }
+    return local + fleet_to_prometheus(fleet, skip_heads=heads)
 
 
 def answer_cmd(cmd: str, status: dict | None = None) -> dict:
@@ -131,7 +164,7 @@ class TelemetryServer:
                     self._reply(
                         200,
                         "text/plain; version=0.0.4; charset=utf-8",
-                        to_prometheus(live_snapshot()),
+                        render_metrics(),
                     )
                 elif path == "/healthz":
                     self._reply_json(

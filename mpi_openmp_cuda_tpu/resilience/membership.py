@@ -59,6 +59,7 @@ new leader's board GC.  Death is terminal here too: a deposed leader
 from __future__ import annotations
 
 import json
+import math
 from dataclasses import dataclass
 
 from ..obs.events import publish
@@ -93,6 +94,14 @@ def result_key(bid: str, epoch: int) -> str:
 
 def shutdown_key() -> str:
     return f"{_ROOT}/shutdown"
+
+
+def obs_snapshot_key(wid: str) -> str:
+    """One bounded observability snapshot per worker (metrics + recent
+    trace events + the flight-recorder tape), overwritten in place —
+    the coordinator's federation/merge source and the post-mortem tape
+    it collects when the worker is declared dead."""
+    return f"{_ROOT}/obssnap/{wid}"
 
 
 #: Leader-lease key namespace: one claim key per generation (the
@@ -407,6 +416,100 @@ class LeaderLease:
             self._watch_tick = tick
             return False
         return tick - self._watch_tick >= self.deadline_ticks
+
+
+def read_obs_snapshot(board, wid: str) -> dict | None:
+    """Read one worker's observability snapshot with the torn-post
+    guarantee plus identity validation: a snapshot that is absent,
+    torn, or stamped with a DIFFERENT worker id (an alien post — a key
+    collision or a confused writer) reads as missing.  Observability is
+    best-effort by construction: missing is never fatal."""
+    post = board_read_json(board, obs_snapshot_key(wid))
+    if post is None:
+        return None
+    if post.get("wid") != wid:
+        return None
+    return post
+
+
+class ClockOffsetEstimator:
+    """Deterministic per-worker clock-offset estimates from offer/claim
+    echo pairs.
+
+    The coordinator stamps each offer with its own clock (``t_post``),
+    the claiming worker echoes its clock (``t_echo``) in the claim
+    payload, and the coordinator reads the claim at ``t_seen``.  One
+    such pair bounds the worker clock against the coordinator clock the
+    way one NTP exchange does: the echo happened somewhere inside
+    ``[t_post, t_seen]``, so the midpoint estimate
+
+        ``offset = t_echo - (t_post + t_seen) / 2``
+
+    is wrong by at most half the round trip.  The estimator keeps the
+    minimum-RTT pair per worker — the tightest bound seen — which makes
+    the estimate a deterministic function of the observed pairs (same
+    pairs, same verdict: the change-under-tick discipline of the rest
+    of this module, applied to clock alignment).  No clock is read
+    here (SEQ005); every timestamp is caller-supplied.
+    """
+
+    def __init__(self):
+        # wid -> (rtt_s, offset_s) of the best (minimum-RTT) pair.
+        self._best: dict[str, tuple[float, float]] = {}
+
+    def observe(self, wid: str, t_post, t_echo, t_seen) -> None:
+        """Fold one echo pair in.  Non-numeric or causally impossible
+        pairs (``t_seen < t_post``) are dropped — a torn claim must not
+        corrupt the estimate."""
+        try:
+            t_post = float(t_post)
+            t_echo = float(t_echo)
+            t_seen = float(t_seen)
+        except (TypeError, ValueError):
+            return
+        if not (math.isfinite(t_post) and math.isfinite(t_echo)
+                and math.isfinite(t_seen)):
+            return
+        rtt = t_seen - t_post
+        if rtt < 0.0:
+            return
+        offset = t_echo - (t_post + t_seen) / 2.0
+        best = self._best.get(str(wid))
+        if best is None or rtt < best[0]:
+            self._best[str(wid)] = (rtt, offset)
+
+    def offset(self, wid: str) -> float | None:
+        """Worker-minus-coordinator clock offset (seconds), or None
+        before any echo pair has been observed for ``wid``."""
+        best = self._best.get(str(wid))
+        return best[1] if best is not None else None
+
+    def uncertainty(self, wid: str) -> float | None:
+        """Half the best pair's round trip: the estimate's error bound."""
+        best = self._best.get(str(wid))
+        return best[0] / 2.0 if best is not None else None
+
+    def to_coordinator(self, wid: str, t_worker) -> float | None:
+        """Map one worker-clock timestamp onto the coordinator clock
+        (None while the worker's offset is still unknown)."""
+        off = self.offset(wid)
+        if off is None:
+            return None
+        try:
+            return float(t_worker) - off
+        except (TypeError, ValueError):
+            return None
+
+    def snapshot(self) -> dict[str, dict]:
+        """JSON-ready per-worker estimates (the run report / bench
+        table's ``clock_offsets`` rows)."""
+        return {
+            wid: {
+                "offset_s": round(offset, 9),
+                "rtt_s": round(rtt, 9),
+            }
+            for wid, (rtt, offset) in sorted(self._best.items())
+        }
 
 
 def write_checkpoint(board, gen: int, state: dict) -> None:

@@ -54,6 +54,7 @@ from __future__ import annotations
 
 import collections
 import json
+import math
 import os
 import re
 import threading
@@ -61,19 +62,30 @@ import threading
 import numpy as np
 
 from ..obs.events import log_line, publish
+from ..obs.export import collect_worker_snapshot, post_worker_snapshot
+from ..obs.flightrec import dump_fleet_tape
+from ..obs.metrics import active_metrics
 from ..obs.metrics import gauge as obs_gauge
+from ..obs.spans import span
+from ..obs.trace import (
+    active_trace,
+    trace_board_phase,
+    trace_clock_offsets,
+)
 from ..resilience.drain import drain_requested
 from ..resilience.faults import fire as _fault_fire
 from ..resilience.faults import scheduled as _fault_scheduled
 from ..resilience.membership import (
     FLEET_PREFIX,
     OFFER_PREFIX,
+    ClockOffsetEstimator,
     LeaseTable,
     Membership,
     board_read_json,
     ckpt_key,
     claim_key,
     heartbeat_key,
+    obs_snapshot_key,
     offer_key,
     result_key,
     shutdown_key,
@@ -84,6 +96,12 @@ from .clock import ServeClock
 
 #: Coordinator board-poll cadence: one membership/lease tick per poll.
 _POLL_S = 0.05
+
+#: Coordinator obs-gather cadence, in pump ticks: how often live
+#: workers' posted observability snapshots are folded into the local
+#: registry/tracer.  Snapshots overwrite in place on the board, so a
+#: slow gather loses granularity, never correctness.
+_OBS_GATHER_TICKS = 5
 
 
 def lease_ticks_for(lease_s=None, poll_s=_POLL_S) -> int:
@@ -123,6 +141,35 @@ def _pause(clock, seconds: float, predicate=None) -> None:
     cond = threading.Condition()
     with cond:
         clock.block_until(cond, predicate or (lambda: False), seconds)
+
+
+def _block_traces(block) -> list[str]:
+    """The admission-minted trace ids riding a superblock (empty for
+    blocks built without tags — unit-test stubs, replayed journals)."""
+    fn = getattr(block, "link_traces", None)
+    return [str(t) for t in (fn() if fn is not None else ())]
+
+
+def _block_links(block) -> list[str]:
+    """The request ids riding a superblock (same stance as above)."""
+    fn = getattr(block, "link_ids", None)
+    return [str(r) for r in (fn() if fn is not None else ())]
+
+
+def _offer_traces(offer: dict) -> list[str]:
+    """The trace ids an offer propagated (empty for old-protocol or
+    hand-crafted offers — the worker still scores them)."""
+    return [str(t) for t in (offer.get("traces") or ())]
+
+
+def _finite(x) -> float:
+    """Coerce one phase delta to a finite float (0.0 for anything
+    else) — the board-phase gate requires every row finite."""
+    try:
+        v = float(x)
+    except (TypeError, ValueError):
+        return 0.0
+    return v if math.isfinite(v) else 0.0
 
 
 class LeadershipLostError(RuntimeError):
@@ -195,6 +242,14 @@ class FleetCoordinator:
         self._gc_marks: dict[str, int] = {}  # sweepable key -> tick marked
         self._gc_fenced: set[str] = set()  # stale-gen keys already counted
         self._ckpt_blob: str | None = None  # change-cache for checkpoint()
+        # Fleet observability plane (this PR): deterministic per-worker
+        # clock offsets from offer/claim echo pairs, per-block phase
+        # timestamps (overwritten on re-offer — the phase row describes
+        # the attempt that actually finished), and the dead workers
+        # whose flight-recorder tape was already collected.
+        self.offsets = ClockOffsetEstimator()
+        self._phase_marks: dict[str, dict] = {}
+        self._tapes_collected: set[str] = set()
 
     # -- dispatch side -----------------------------------------------------
 
@@ -228,6 +283,12 @@ class FleetCoordinator:
         return bid
 
     def _post_offer(self, bid: str, epoch: int, block) -> None:
+        """The offer is a WORK UNIT crossing a process boundary, so it
+        carries its trace context (seqlint SEQ015): the admission-minted
+        trace ids and request ids riding this superblock, plus the
+        coordinator-clock post time — the first half of the offer/claim
+        echo pair the clock-offset estimator feeds on."""
+        t_offer = float(self.clock.now())
         self.board.post(
             offer_key(bid),
             json.dumps({
@@ -236,8 +297,12 @@ class FleetCoordinator:
                 "weights": [int(w) for w in block.weights],
                 "seq1": np.asarray(block.seq1_codes).tolist(),
                 "rows": [np.asarray(c).tolist() for c in block.codes],
+                "traces": _block_traces(block),
+                "links": _block_links(block),
+                "t_offer": t_offer,
             }),
         )
+        self._phase_marks[bid] = {"epoch": int(epoch), "t_offer": t_offer}
 
     # -- the per-tick pump -------------------------------------------------
 
@@ -305,6 +370,9 @@ class FleetCoordinator:
                 f"mpi_openmp_cuda_tpu: fleet: worker {wid} missed its "
                 "heartbeat deadline; re-dispatching its superblocks"
             )
+            # Tape first, re-dispatch second: the dead worker's last
+            # posted snapshot is the only record of what it was doing.
+            self._collect_tape(wid)
             for lease in self.membership_held(wid):
                 self._redispatch(lease.bid, "worker-dead")
         for bid in list(self.blocks):
@@ -326,6 +394,8 @@ class FleetCoordinator:
             )
             self._redispatch(lease.bid, "lease-expired")
         self._gc(tick)
+        if tick % _OBS_GATHER_TICKS == 0:
+            self._gather_obs()
         obs_gauge("fleet_workers", self.membership.live_count())
 
     def membership_held(self, wid: str):
@@ -347,13 +417,16 @@ class FleetCoordinator:
                 self._retired.append((bid, int(post["epoch"])))
                 self.board.delete(offer_key(bid))
                 self._demux(rows, block)
+                self._note_phases(bid, post, block)
                 return
         if lease.holder is None:
             claim = board_read_json(
                 self.board, claim_key(bid, lease.epoch)
             )
             if claim is not None and claim.get("wid"):
-                self.leases.note_claim(bid, str(claim["wid"]), tick)
+                wid = str(claim["wid"])
+                self.leases.note_claim(bid, wid, tick)
+                self._note_claim_echo(bid, wid, claim)
 
     def _fence_stale(self, bid: str, current: int) -> None:
         """Probe every PREVIOUS epoch's result key: a post there is a
@@ -402,6 +475,142 @@ class FleetCoordinator:
             return None
         return rows
 
+    # -- fleet observability: clock offsets, board phases, gather ----------
+
+    def _note_claim_echo(self, bid: str, wid: str, claim: dict) -> None:
+        """Feed the offer/claim echo pair to the clock-offset estimator
+        (NTP-style midpoint: the worker's ``t_claim`` echo against this
+        clock's post/seen bracket) and remember the claim times for the
+        block's eventual phase row.  Old-protocol claims without the
+        echo simply contribute nothing — absence over negotiation."""
+        marks = self._phase_marks.get(bid)
+        if marks is None or "t_claim" not in claim:
+            return
+        t_seen = float(self.clock.now())
+        self.offsets.observe(wid, marks["t_offer"], claim["t_claim"], t_seen)
+        marks["wid"] = wid
+        marks["t_claim_w"] = claim["t_claim"]
+        marks["t_claim_seen"] = t_seen
+        trace_clock_offsets(self.offsets.snapshot())
+
+    def _note_phases(self, bid: str, post: dict, block) -> None:
+        """One demuxed fleet superblock → one five-phase breakdown row
+        on the trace plane (offer-posted → claimed → score-started →
+        result-posted → demuxed).  Worker-stamped times are mapped onto
+        this clock through the estimated offset; worker-to-worker
+        deltas need no mapping (the offset cancels).  Every delta is
+        clamped finite and non-negative, and ``total`` is the SUM of
+        the four intervals — totals==sums holds by construction."""
+        marks = self._phase_marks.pop(bid, None)
+        if marks is None:
+            return
+        wid = str(post.get("wid") or marks.get("wid") or "")
+        t_demux = float(self.clock.now())
+        off = self.offsets.offset(wid)
+
+        def to_local(t_worker, fallback):
+            mapped = (
+                self.offsets.to_coordinator(wid, t_worker)
+                if t_worker is not None
+                else None
+            )
+            return mapped if mapped is not None else fallback
+
+        t_offer = float(marks["t_offer"])
+        t_claim = to_local(
+            marks.get("t_claim_w"), marks.get("t_claim_seen", t_offer)
+        )
+        t_score = to_local(post.get("t_score"), t_claim)
+        t_post = to_local(post.get("t_post"), t_score)
+        phases = {
+            "offer_to_claim": max(0.0, _finite(t_claim - t_offer)),
+            "claim_to_score": max(0.0, _finite(t_score - t_claim)),
+            "score_to_post": max(0.0, _finite(t_post - t_score)),
+            "post_to_demux": max(0.0, _finite(t_demux - t_post)),
+        }
+        phases = {k: round(v, 9) for k, v in phases.items()}
+        phases["total"] = round(sum(phases.values()), 9)
+        trace_board_phase({
+            "bid": bid,
+            "worker": wid,
+            "epoch": int(marks.get("epoch", 0)),
+            "traces": _block_traces(block),
+            "request_ids": _block_links(block),
+            "clock_offset_s": round(off, 9) if off is not None else None,
+            "phases": phases,
+        })
+
+    def _gather_obs(self) -> None:
+        """Fold live workers' posted observability snapshots into the
+        local planes: metrics into the registry's fleet section (the
+        federated ``/metrics`` families), trace events into offset-
+        aligned per-worker Perfetto tracks.  Best-effort per worker —
+        a missing, torn, or alien snapshot contributes nothing."""
+        reg = active_metrics()
+        tracer = active_trace()
+        if reg is None and tracer is None:
+            return
+        for wid, view in list(self.membership.workers.items()):
+            if not view.alive:
+                continue
+            snap = collect_worker_snapshot(self.board, wid)
+            if snap is None:
+                continue
+            if reg is not None and isinstance(snap.get("metrics"), dict):
+                reg.record_fleet(wid, snap["metrics"])
+            if tracer is not None:
+                self._merge_track(tracer, wid, snap)
+
+    def _merge_track(self, tracer, wid: str, snap: dict) -> None:
+        """Install one worker's trace events as a per-worker track,
+        shifted onto this tracer's timeline: worker trace-clock →
+        worker board-clock (the snapshot's back-to-back bridge pair) →
+        coordinator board-clock (the offer/claim offset estimate) →
+        coordinator trace-clock (a local bridge pair, sampled here).
+        Without an offset estimate the track is skipped — alignment is
+        deterministic or absent, never guessed."""
+        trace = snap.get("trace")
+        if not isinstance(trace, dict):
+            return
+        events = trace.get("events")
+        if not isinstance(events, list) or not events:
+            return
+        off = self.offsets.offset(wid)
+        if off is None:
+            return
+        try:
+            t_board_w = float(snap["t_board"])
+            t_trace_us_w = float(snap["t_trace_us"])
+        except (KeyError, TypeError, ValueError):
+            return
+        shift_us = (
+            (t_board_w * 1e6 - t_trace_us_w)
+            - off * 1e6
+            + (tracer.now_us() - self.clock.now() * 1e6)
+        )
+        tracer.set_worker_track(wid, events, shift_us)
+
+    def _collect_tape(self, wid: str) -> None:
+        """Post-mortem: pull the flight-recorder tape out of a dead
+        worker's LAST posted snapshot and dump it locally — the tape a
+        SIGKILLed worker could never write itself.  Once per worker;
+        the snapshot key itself is swept by GC after the grace window."""
+        if wid in self._tapes_collected:
+            return
+        self._tapes_collected.add(wid)
+        snap = collect_worker_snapshot(self.board, wid)
+        tape = snap.get("tape") if isinstance(snap, dict) else None
+        if not tape:
+            return
+        path = dump_fleet_tape(wid, tape, "worker-dead")
+        if path is not None:
+            publish(
+                "fleet.tape.collected",
+                worker=wid,
+                events=len(tape),
+                path=path,
+            )
+
     # -- re-dispatch + local fallback --------------------------------------
 
     def _redispatch(self, bid: str, reason: str) -> None:
@@ -449,6 +658,7 @@ class FleetCoordinator:
         quarantine ladder).  The lease was already bumped, so any
         straggler's later post lands fenced."""
         block = self.blocks.pop(bid)
+        self._phase_marks.pop(bid, None)  # local scoring has no phases
         lease = self.leases.get(bid)
         self._retired.append((bid, lease.epoch))
         self.leases.retire(bid)
@@ -506,6 +716,13 @@ class FleetCoordinator:
             if view is not None and not view.alive:
                 return "sweep"  # a dead worker's registration/beat
             return "keep"  # live, or not yet observed (still joining)
+        if kind == "obssnap":
+            view = self.membership.workers.get(parts[-1])
+            if view is not None and not view.alive:
+                # Swept only past the grace window (gc_ticks), which is
+                # after the death-tick tape collection by construction.
+                return "sweep"
+            return "keep"  # a live worker's snapshot, overwritten in place
         if kind in ("leader", "leaderhb", "ckpt"):
             gen = _gen_of(parts[-1])
             if gen is not None and gen < self.gen:
@@ -651,6 +868,11 @@ class FleetWorker:
         self._done: set[tuple[str, int]] = set()
         self._zombie = False  # chaos: freeze heartbeats, earn the verdict
         self._zombie_done = False
+        # Observability-snapshot cadence, expressed in heartbeats so the
+        # snapshot rides the existing pulse thread (one board write per
+        # cadence, overwriting in place — the board holds one snapshot).
+        snap_s = env_float("SEQALIGN_FLEET_OBSSNAP_S", 0.25)
+        self._snap_beats = max(1, round(snap_s / self.poll_s))
 
     def register(self) -> None:
         self.board.post(
@@ -671,6 +893,22 @@ class FleetWorker:
             # outcome, reached without killing the heartbeat thread.
             pass
 
+    def post_obs_snapshot(self) -> None:
+        """Post this worker's bounded observability snapshot (metrics +
+        recent trace events + the flight-recorder tape) next to its
+        heartbeat.  Best-effort, same stance as the beat: a board that
+        cannot take the write costs granularity, never the worker.  The
+        RuntimeError arm covers snapshotting the registry while the
+        scoring thread mutates it (the telemetry module's documented
+        lock-free-copy hazard) — the next cadence simply retries."""
+        try:
+            post_worker_snapshot(
+                self.board, self.wid, float(self.clock.now()),
+                beat=self._beat,
+            )
+        except (OSError, RuntimeError):
+            pass
+
     def should_exit(self) -> bool:
         return (
             drain_requested()
@@ -685,6 +923,8 @@ class FleetWorker:
         while not stop.is_set():
             if not self._zombie:
                 self.heartbeat()
+                if self._beat % self._snap_beats == 0:
+                    self.post_obs_snapshot()
             _pause(self.clock, self.poll_s, stop.is_set)
 
     def run(self) -> int:
@@ -706,6 +946,16 @@ class FleetWorker:
                     _pause(self.clock, self.poll_s, drain_requested)
         finally:
             stop.set()
+            # The leader's clean-completion sweep (gc_final) runs BEFORE
+            # the shutdown key lands, so a heartbeat-cadence snapshot
+            # posted in that window would outlive the run and trip the
+            # no-stale-keys gate — the worker retires its own snapshot
+            # once the pulse thread has stopped posting.
+            pulse.join(timeout=2 * self.poll_s + 1.0)
+            try:
+                self.board.delete(obs_snapshot_key(self.wid))
+            except OSError:
+                pass  # advisory: a vanished board costs hygiene, not the run
 
     def step(self) -> bool:
         """Scan the offer board once; claim and score anything new.
@@ -729,7 +979,13 @@ class FleetWorker:
                 continue  # someone else holds this epoch
             if not self.board.claim(
                 claim_key(bid, epoch),
-                json.dumps({"wid": self.wid, "epoch": epoch}),
+                # t_claim echoes the offer on THIS worker's clock — the
+                # second half of the estimator's offer/claim pair.
+                json.dumps({
+                    "wid": self.wid,
+                    "epoch": epoch,
+                    "t_claim": float(self.clock.now()),
+                }),
             ):
                 continue  # lost the race: exactly one winner per epoch
             self._done.add((bid, epoch))
@@ -750,8 +1006,12 @@ class FleetWorker:
         # after the claim and before any result lands.
         _fault_fire("fleet_score")
         zombie = _fault_scheduled("zombie:fleet-worker")
+        t_score = float(self.clock.now())
+        publish(
+            "fleet.score.start", block=bid, epoch=epoch, worker=self.wid
+        )
         try:
-            rows = self._score_offer(offer)
+            rows = self._score_offer(offer, epoch)
         except Exception as e:
             # advisory: the claim stays leased — lease expiry re-dispatches
             # the superblock; a worker must not die on one bad block.
@@ -769,6 +1029,12 @@ class FleetWorker:
             "epoch": int(epoch),
             "wid": self.wid,
             "rows": rows.tolist(),
+            # The result is the work unit coming BACK over the board:
+            # echo the propagated trace ids (SEQ015) and stamp the
+            # score/post times for the coordinator's phase breakdown.
+            "traces": _offer_traces(offer),
+            "t_score": t_score,
+            "t_post": float(self.clock.now()),
         })
         if _fault_scheduled("board:torn-post"):
             # Chaos: a writer dying mid-post on a non-atomic board —
@@ -793,7 +1059,7 @@ class FleetWorker:
             # dead worker has no further business claiming fresh work.
             self._zombie_done = True
 
-    def _score_offer(self, offer: dict):
+    def _score_offer(self, offer: dict, epoch: int = 0):
         # np.asarray keeps these HOST-side: the donation-safety pass
         # (analysis/dataflow.py) proves this root re-stages device
         # buffers at _score_local on every retry, so the jit entry
@@ -802,11 +1068,26 @@ class FleetWorker:
         codes = [np.asarray(r, dtype=np.int8) for r in offer["rows"]]
         weights = [int(w) for w in offer["weights"]]
         budget = self.policy.new_budget()
-        promise = self.pipeline.dispatch(seq1, codes, weights, budget)
-        return np.asarray(
-            self.pipeline.materialise(promise, seq1, codes, weights, budget),
-            dtype=np.int64,
-        )
+        # The propagated context: worker-side spans and launch rows are
+        # stamped with the ORIGINATING request trace ids plus this
+        # worker's identity and lease epoch, so the coordinator's merged
+        # timeline links its admission spans to the remote launches.
+        links = [str(r) for r in (offer.get("links") or ())]
+        ctx = {
+            "traces": _offer_traces(offer),
+            "worker": self.wid,
+            "epoch": int(epoch),
+        }
+        with span("score.fleet.superblock"):
+            promise = self.pipeline.dispatch(
+                seq1, codes, weights, budget, links=links, trace_ctx=ctx
+            )
+            return np.asarray(
+                self.pipeline.materialise(
+                    promise, seq1, codes, weights, budget
+                ),
+                dtype=np.int64,
+            )
 
     def _outlive_lease(self, bid: str, epoch: int) -> None:
         """Chaos zombie: sit on the scored result (heartbeats stopped —

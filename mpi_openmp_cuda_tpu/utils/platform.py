@@ -332,6 +332,17 @@ ENV_VARS: tuple[EnvVar, ...] = (
         "was counted first",
     ),
     EnvVar(
+        "SEQALIGN_FLEET_OBSSNAP_S",
+        "float",
+        0.25,
+        "fleet worker observability-snapshot cadence in seconds: how "
+        "often a --fleet-worker posts its bounded metrics + trace + "
+        "flight-recorder snapshot to the board (overwritten in place); "
+        "the coordinator federates these into per-worker /metrics "
+        "families, merged Perfetto tracks, and the post-mortem tape it "
+        "collects when the worker is declared dead",
+    ),
+    EnvVar(
         "JAX_COORDINATOR_ADDRESS",
         "str",
         None,

@@ -246,6 +246,54 @@ def serve_load_row(rec: dict) -> str:
     )
 
 
+def _pctl(vals: list, q: float) -> float:
+    """Nearest-rank percentile — same convention as load/report.py."""
+    if not vals:
+        return 0.0
+    vs = sorted(vals)
+    return vs[min(len(vs) - 1, max(0, round(q * (len(vs) - 1))))]
+
+
+def print_fleet_tables(ga: dict) -> None:
+    """The fleet-observability section of a run report: per-worker
+    launch counts and clock offsets, then board-phase percentiles
+    across every fleet-scored superblock (``gap_attribution``'s
+    ``board_phases`` rows — see obs/trace.py)."""
+    from mpi_openmp_cuda_tpu.obs.trace import BOARD_PHASES
+
+    rows = [r for r in ga.get("board_phases", ()) if isinstance(r, dict)]
+    offsets = ga.get("clock_offsets") or {}
+    by_worker: dict[str, list[dict]] = {}
+    for r in rows:
+        by_worker.setdefault(str(r.get("worker", "?")), []).append(r)
+    print("| Worker | Fleet superblocks | Clock offset ms | Echo RTT ms |")
+    print("|---|---|---|---|")
+    for wid in sorted(by_worker):
+        off = offsets.get(wid) or {}
+
+        def _ms(key):
+            v = off.get(key)
+            return f"{float(v) * 1e3:.3g}" if isinstance(v, (int, float)) else "n/a"
+
+        print(
+            f"| {wid} | {len(by_worker[wid])} "
+            f"| {_ms('offset_s')} | {_ms('rtt_s')} |"
+        )
+    print()
+    print("| Board phase | p50 ms | p90 ms | total s |")
+    print("|---|---|---|---|")
+    totals = ga.get("board_phase_totals") or {}
+    for name in BOARD_PHASES:
+        vals = [
+            float(r.get("phases", {}).get(name, 0.0)) for r in rows
+        ]
+        print(
+            f"| {name} | {_pctl(vals, 0.50) * 1e3:.3g} "
+            f"| {_pctl(vals, 0.90) * 1e3:.3g} "
+            f"| {float(totals.get(name, sum(vals))):.4g} |"
+        )
+
+
 def print_serve_load_table(records: list[dict]) -> None:
     print(
         "| Arrival (open-loop) | Offered req/s | Goodput req/s "
@@ -281,7 +329,16 @@ def main() -> None:
         serve_load = [
             r for r in records if r.get("formulation") == "serve-load"
         ]
-        kernel = [r for r in records if r.get("formulation") != "serve-load"]
+        # A fleet coordinator's run report carries no scalar metric — its
+        # table IS the board-phase attribution section.
+        fleet = [
+            r for r in records
+            if (r.get("gap_attribution") or {}).get("board_phases")
+        ]
+        kernel = [
+            r for r in records
+            if r.get("formulation") != "serve-load" and r not in fleet
+        ]
         if kernel:
             print("| Metric | Value | vs baseline |")
             print("|---|---|---|")
@@ -291,6 +348,10 @@ def main() -> None:
             if kernel:
                 print()
             print_serve_load_table(serve_load)
+        for rec in fleet:
+            if kernel or serve_load:
+                print()
+            print_fleet_tables(rec["gap_attribution"])
         return
 
     print("| Config | Hardware | Measured | vs est. reference (2.0e9 elem/s) |")

@@ -228,7 +228,11 @@ def _stale_key_gate(name, board, problems):
     every offer/claim/result/checkpoint key and no torn ``.tmp.`` file
     may survive anywhere — only the worker registry (worker/hb), the
     shutdown beacon, and the leader generation record (leader/leaderhb)
-    are legitimate leftovers."""
+    are legitimate leftovers.  Observability snapshots (``obssnap/``)
+    are deliberately NOT on the keep list: the leader's final sweep
+    deletes dead workers' snapshots and each surviving worker retires
+    its own on the shutdown beacon, so one landing here means the
+    fleet observability plane leaked board state."""
     root = os.path.join(board, "seqalign", "fleet")
     keep = ("worker", "hb", "leader", "leaderhb", "shutdown")
     leftovers = []

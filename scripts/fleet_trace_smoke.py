@@ -1,0 +1,423 @@
+"""End-to-end smoke gate for the fleet observability plane
+(``make fleet-trace-smoke``).
+
+Boots a REAL fleet over a shared ``FileBoard``: one coordinator
+(``--serve --port 0 --telemetry-port 0 --fleet-board``) plus two
+``--fleet-worker`` subprocesses.  Fires a first wave of loopback
+clients so fleet superblocks flow, scrapes the coordinator's
+``/metrics`` until the federated plane exposes BOTH workers, then
+SIGKILLs one worker mid-run, fires a second wave (scored by the
+survivor alone), and SIGTERMs the coordinator.  Gates what the fleet
+observability plane promises:
+
+* **trace propagation**: every launch in the surviving worker's trace
+  artifact carries at least one admission-minted trace id plus the
+  worker/epoch stamp;
+* **board-phase attribution**: the coordinator's ``gap_attribution``
+  grows one row per fleet-scored superblock, each with the five finite
+  board phases (offer→claim→score→post→demux) whose total equals the
+  sum, a non-empty trace-id list, and a per-worker clock offset;
+* **metrics federation**: the live ``/metrics`` scrape exposes
+  ``worker="..."``-labelled families for both workers next to the
+  local plane;
+* **fleet flight recorder**: the murdered worker's last posted tape is
+  collected into a schema-valid ``fleet-tape-*`` dump;
+* **merged timeline**: the coordinator's trace artifact carries at
+  least one offset-aligned per-worker track (``seqalign-worker``
+  process metadata).
+
+Exit 0 on success, 1 with every problem listed on failure — same
+all-problems-at-once reporting style as trace_smoke and fleet_chaos.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import math
+import os
+import re
+import signal
+import socket
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+import urllib.request
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from mpi_openmp_cuda_tpu.obs.metrics import validate_report  # noqa: E402
+from mpi_openmp_cuda_tpu.obs.trace import BOARD_PHASES  # noqa: E402
+
+WEIGHTS = [1, -3, -5, -2]
+SEQ1 = "ACGTACGTACGTACGT"
+PORT_RE = re.compile(r"serving on 127\.0\.0\.1:(\d+)")
+TELEM_RE = re.compile(r"telemetry on 127\.0\.0\.1:(\d+)")
+WORKER_LABEL_RE = re.compile(r'\{worker="(w\d+)"')
+
+
+def _client(port: int, rid: str, seq2: list[str], errors: list) -> None:
+    try:
+        with socket.create_connection(("127.0.0.1", port), timeout=60) as conn:
+            req = {"id": rid, "weights": WEIGHTS, "seq1": SEQ1, "seq2": seq2}
+            conn.sendall((json.dumps(req) + "\n").encode())
+            conn.settimeout(120)
+            buf = b""
+            while b'"done"' not in buf:
+                chunk = conn.recv(65536)
+                if not chunk:
+                    break
+                buf += chunk
+        recs = [json.loads(l) for l in buf.decode().splitlines() if l]
+        if not any(r.get("done") for r in recs):
+            errors.append(f"client {rid}: no done record in {recs}")
+    except Exception as e:
+        errors.append(f"client {rid}: {e}")
+
+
+def _wave(port: int, rids_seq2, errors: list) -> None:
+    """One wave of concurrent loopback clients, joined before return."""
+    threads = []
+    for rid, seq2 in rids_seq2:
+        t = threading.Thread(
+            target=_client, args=(port, rid, seq2, errors), daemon=True
+        )
+        t.start()
+        threads.append(t)
+    for t in threads:
+        t.join(300)
+
+
+def _spawn_worker(out_dir: str, board: str, tag: str, *, trace_out=None):
+    argv = [
+        sys.executable, "-m", "mpi_openmp_cuda_tpu",
+        "--fleet-worker", "--fleet-board", board,
+    ]
+    if trace_out:
+        argv += ["--trace-out", trace_out]
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    env.setdefault("SEQALIGN_BACKOFF_BASE", "0.01")
+    log = open(os.path.join(out_dir, f"{tag}.worker.log"), "w")
+    proc = subprocess.Popen(
+        argv, cwd=REPO, env=env, stdout=log, stderr=subprocess.STDOUT
+    )
+    return proc, log
+
+
+def _wait_registered(board: str, n: int, timeout_s: float = 90.0) -> bool:
+    wdir = os.path.join(board, "seqalign", "fleet", "worker")
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        try:
+            names = [f for f in os.listdir(wdir) if not f.startswith(".tmp.")]
+        except OSError:
+            names = []
+        if len(names) >= n:
+            return True
+        time.sleep(0.1)
+    return False
+
+
+def _scrape(telem_port: int) -> str:
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{telem_port}/metrics", timeout=30
+    ) as resp:
+        return resp.read().decode("utf-8")
+
+
+def _poll(predicate, timeout_s: float, interval_s: float = 0.25):
+    """Poll until ``predicate()`` returns a truthy value; None on
+    timeout."""
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        got = predicate()
+        if got:
+            return got
+        time.sleep(interval_s)
+    return None
+
+
+def _load_report(path: str, problems: list):
+    try:
+        with open(path, encoding="utf-8") as fh:
+            rec = json.load(fh)
+    except (OSError, json.JSONDecodeError) as e:
+        problems.append(f"no readable report at {path}: {e}")
+        return None
+    try:
+        validate_report(rec)
+    except ValueError as e:
+        problems.append(f"{os.path.basename(path)}: {e}")
+        return None
+    return rec
+
+
+def _phase_gates(ga: dict, wids: set, problems: list) -> None:
+    """The board-phase attribution contract, on either artifact's
+    ``gap_attribution`` section."""
+    rows = ga.get("board_phases", ())
+    if not rows:
+        problems.append("gap_attribution: no board_phases rows")
+        return
+    for row in rows:
+        if not row.get("traces"):
+            problems.append(f"board phase row without trace ids: {row}")
+        if row.get("worker") not in wids:
+            problems.append(
+                f"board phase row names unknown worker: {row.get('worker')} "
+                f"not in {sorted(wids)}"
+            )
+        phases = row.get("phases", {})
+        if set(phases) != set(BOARD_PHASES):
+            problems.append(
+                f"board phase row: want phases {sorted(BOARD_PHASES)}, got "
+                f"{sorted(phases)}"
+            )
+            continue
+        for name, v in phases.items():
+            if not isinstance(v, (int, float)) or not math.isfinite(v) or v < 0:
+                problems.append(f"board phase {name}: not finite: {row}")
+        want = sum(v for k, v in phases.items() if k != "total")
+        if abs(phases["total"] - want) > 1e-6:
+            problems.append(
+                f"board phase total {phases['total']} != sum of phases "
+                f"{want}: {row}"
+            )
+    totals = ga.get("board_phase_totals", {})
+    for name in BOARD_PHASES:
+        want = sum(r.get("phases", {}).get(name, 0.0) for r in rows)
+        if abs(totals.get(name, 0.0) - want) > 1e-6:
+            problems.append(
+                f"board_phase_totals.{name}={totals.get(name)} != sum of "
+                f"rows {want}"
+            )
+    if not ga.get("clock_offsets"):
+        problems.append("gap_attribution: clock_offsets section empty")
+
+
+def main() -> int:
+    out_dir = tempfile.mkdtemp(prefix="fleet_trace_smoke_")
+    board = os.path.join(out_dir, "board")
+    cache_dir = os.path.join(out_dir, "cache")
+    report_path = os.path.join(out_dir, "coordinator.report.json")
+    trace_path = os.path.join(out_dir, "coordinator.trace.json")
+    survivor_trace = os.path.join(out_dir, "survivor.trace.json")
+    problems: list[str] = []
+
+    survivor, survivor_log = _spawn_worker(
+        out_dir, board, "survivor", trace_out=survivor_trace
+    )
+    victim, victim_log = _spawn_worker(out_dir, board, "victim")
+    survivor_wid = f"w{survivor.pid}"
+    victim_wid = f"w{victim.pid}"
+    wids = {survivor_wid, victim_wid}
+
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    env.setdefault("SEQALIGN_BACKOFF_BASE", "0.01")
+    # The murdered worker's death verdict (and tape collection) must
+    # land within the run, and the flight-recorder dumps must land
+    # somewhere this script owns.
+    env["SEQALIGN_LEASE_S"] = "2"
+    env["SEQALIGN_FLEET_WORKERS"] = "2"
+    env["SEQALIGN_CACHE_DIR"] = cache_dir
+    env.pop("TPU_SEQALIGN_COMPILE_CACHE", None)
+    proc = subprocess.Popen(
+        [
+            sys.executable, "-m", "mpi_openmp_cuda_tpu",
+            "--serve", "--port", "0",
+            "--telemetry-port", "0",
+            "--fleet-board", board,
+            "--metrics-out", report_path,
+            "--trace-out", trace_path,
+        ],
+        stdin=subprocess.DEVNULL,
+        stdout=subprocess.DEVNULL,
+        stderr=subprocess.PIPE,
+        cwd=REPO,
+        env=env,
+        text=True,
+    )
+    federated = ""
+    rc = None
+    try:
+        if not _wait_registered(board, 2):
+            problems.append("workers never registered on the board")
+        port = telem_port = None
+        stderr_lines: list[str] = []
+        for line in proc.stderr:
+            stderr_lines.append(line)
+            m = TELEM_RE.search(line)
+            if m:
+                telem_port = int(m.group(1))
+            m = PORT_RE.search(line)
+            if m:
+                port = int(m.group(1))
+                break
+        if port is None or telem_port is None:
+            problems.append(
+                f"server announcements missing (serve={port}, "
+                f"telemetry={telem_port})"
+            )
+            sys.stderr.write("".join(stderr_lines))
+            return 1
+        drain = threading.Thread(
+            target=lambda: stderr_lines.extend(proc.stderr), daemon=True
+        )
+        drain.start()
+
+        # Wave 1: both workers live; fleet superblocks flow.
+        _wave(port, [("c0", ["ACGT", "TTTT"]), ("c1", ["GATTACA"])],
+              problems)
+
+        # The federation gate: scrape until BOTH workers' snapshot-fed
+        # families are exposed with worker labels.
+        def _both_exposed():
+            text = _scrape(telem_port)
+            return text if wids <= set(WORKER_LABEL_RE.findall(text)) else None
+
+        federated = _poll(_both_exposed, 60.0) or ""
+        if not federated:
+            problems.append(
+                f"/metrics never exposed worker-labelled families for both "
+                f"workers {sorted(wids)}"
+            )
+
+        # Murder one worker; its last posted tape must be collected once
+        # the membership declares it dead.
+        victim.send_signal(signal.SIGKILL)
+        victim_rc = victim.wait(timeout=60)
+        if victim_rc != -signal.SIGKILL:
+            problems.append(
+                f"victim worker: want SIGKILL death, got rc {victim_rc}"
+            )
+        tape_glob = os.path.join(
+            cache_dir, "flightrec", f"fleet-tape-{victim_wid}-*.json"
+        )
+        tapes = _poll(lambda: glob.glob(tape_glob), 60.0) or []
+        if not tapes:
+            problems.append(
+                f"dead worker's tape never collected under {tape_glob}"
+            )
+
+        # Wave 2: only the survivor is left to score — its trace
+        # artifact must show stamped fleet launches.
+        _wave(port, [("c2", ["GGGG"])], problems)
+
+        proc.send_signal(signal.SIGTERM)
+        rc = proc.wait(timeout=120)
+        drain.join(10)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=30)
+        try:
+            survivor_rc = survivor.wait(timeout=60)
+        except subprocess.TimeoutExpired:
+            survivor.kill()
+            survivor_rc = survivor.wait()
+            problems.append("survivor worker never saw the shutdown beacon")
+        survivor_log.close()
+        victim_log.close()
+
+    if rc != 75:
+        problems.append(f"coordinator exit code: want 75 (drained), got {rc}")
+    if survivor_rc != 0:
+        problems.append(f"survivor worker: want exit 0, got {survivor_rc}")
+    stderr_text = "".join(stderr_lines)
+    if "Traceback" in stderr_text:
+        problems.append("coordinator crashed (Traceback on stderr)")
+
+    # -- federation ---------------------------------------------------------
+    if federated:
+        for wid in sorted(wids):
+            if f'seqalign_uptime_seconds{{worker="{wid}"}}' not in federated:
+                problems.append(
+                    f"/metrics: federated uptime family missing for {wid}"
+                )
+        if "seqalign_serve_requests_total " not in federated:
+            problems.append(
+                "/metrics: local (unlabelled) plane missing from the "
+                "federated scrape"
+            )
+
+    # -- tape ---------------------------------------------------------------
+    if tapes:
+        tape = _load_report(tapes[0], problems)
+        if tape is not None:
+            if tape.get("worker") != victim_wid:
+                problems.append(
+                    f"tape worker: want {victim_wid}, got "
+                    f"{tape.get('worker')}"
+                )
+            if not tape.get("events"):
+                problems.append(f"collected tape is empty: {tapes[0]}")
+
+    # -- board phases + clock offsets (both artifacts agree) ----------------
+    report = _load_report(report_path, problems)
+    trace = _load_report(trace_path, problems)
+    for rec, tag in ((report, "report"), (trace, "trace")):
+        if rec is None:
+            problems.append(f"{tag}: gap_attribution missing")
+        elif "gap_attribution" not in rec:
+            problems.append(f"{tag}: gap_attribution missing")
+    if report is not None and trace is not None:
+        if report.get("gap_attribution") != trace.get("gap_attribution"):
+            problems.append("report gap_attribution != trace gap_attribution")
+    if trace is not None and "gap_attribution" in trace:
+        _phase_gates(trace["gap_attribution"], wids, problems)
+
+    # -- merged per-worker tracks -------------------------------------------
+    if trace is not None:
+        tracks = {
+            e["args"]["name"]
+            for e in trace.get("traceEvents", ())
+            if e.get("ph") == "M" and e.get("name") == "process_name"
+            and str(e.get("args", {}).get("name", "")).startswith(
+                "seqalign-worker"
+            )
+        }
+        if not tracks:
+            problems.append(
+                "merged trace: no seqalign-worker per-worker track metadata"
+            )
+
+    # -- trace propagation onto worker launches -----------------------------
+    wtrace = _load_report(survivor_trace, problems)
+    if wtrace is not None:
+        launches = [
+            e for e in wtrace.get("traceEvents", ())
+            if e.get("cat") == "launch"
+        ]
+        if not launches:
+            problems.append("survivor trace: no fleet launch events")
+        for ev in launches:
+            args = ev.get("args", {})
+            if not args.get("traces"):
+                problems.append(
+                    f"survivor launch without propagated trace ids: {ev}"
+                )
+            if args.get("worker") != survivor_wid:
+                problems.append(
+                    f"survivor launch without its worker stamp: {ev}"
+                )
+
+    if problems:
+        for p in problems:
+            print(f"fleet-trace-smoke: FAIL: {p}")
+        return 1
+    print(
+        "fleet-trace-smoke: OK (stamped fleet launches, five-phase board "
+        "attribution with matching totals, federated /metrics for "
+        f"{len(wids)} workers, dead worker's tape collected, merged "
+        f"per-worker tracks; artifacts={out_dir})"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -78,7 +78,7 @@ class StubPipeline:
     """Deterministic rows: row i scores (i, i, i) — enough to assert
     the demuxed payload came from the worker, not the fallback."""
 
-    def dispatch(self, seq1, codes, weights, budget):
+    def dispatch(self, seq1, codes, weights, budget, **kw):
         return len(codes)
 
     def materialise(self, promise, seq1, codes, weights, budget):

@@ -1161,3 +1161,101 @@ class TestSeq014BroadSwallows:
         # so it lives under the host role on purpose.
         roles = seqlint.module_roles("pkg/analysis/exitflow.py")
         assert roles == (seqlint.ROLE_HOST,)
+
+
+class TestSeq015WorkUnitTraceContext:
+    """Serve-plane board posts that carry a superblock (bid + rows)
+    must propagate trace context — a `traces` key (SEQ015)."""
+
+    def test_offer_shaped_payload_without_traces(self, tmp_path):
+        findings = _lint_snippet(
+            tmp_path,
+            "serve/foo.py",
+            """
+            import json
+
+            def post_offer(board, key, bid, block):
+                board.post(key, json.dumps({
+                    "bid": bid,
+                    "epoch": 0,
+                    "rows": [list(c) for c in block.codes],
+                }))
+            """,
+        )
+        assert [f.code for f in findings] == ["SEQ015"]
+
+    def test_result_shaped_payload_without_traces(self, tmp_path):
+        # The bare-name import spelling is the same post.
+        findings = _lint_snippet(
+            tmp_path,
+            "serve/foo.py",
+            """
+            from json import dumps
+
+            def post_result(board, key, bid, wid, rows):
+                board.post(key, dumps({
+                    "bid": bid,
+                    "wid": wid,
+                    "rows": rows.tolist(),
+                }))
+            """,
+        )
+        assert [f.code for f in findings] == ["SEQ015"]
+
+    def test_payload_with_traces_is_clean(self, tmp_path):
+        assert not _lint_snippet(
+            tmp_path,
+            "serve/foo.py",
+            """
+            import json
+
+            def post_offer(board, key, bid, block, traces):
+                board.post(key, json.dumps({
+                    "bid": bid,
+                    "rows": [list(c) for c in block.codes],
+                    "traces": traces,
+                }))
+            """,
+        )
+
+    def test_control_posts_are_out_of_scope(self, tmp_path):
+        # Claims/heartbeats/checkpoints carry no rows: not work units.
+        assert not _lint_snippet(
+            tmp_path,
+            "serve/foo.py",
+            """
+            import json
+
+            def post_claim(board, key, wid, epoch):
+                board.post(key, json.dumps({"wid": wid, "epoch": epoch}))
+            """,
+        )
+
+    def test_host_modules_are_out_of_scope(self, tmp_path):
+        # The rule polices the serving plane; a host-side tool writing
+        # a bid+rows blob to its own report is not a board post.
+        assert not _lint_snippet(
+            tmp_path,
+            "analysis/foo.py",
+            """
+            import json
+
+            def write(path, bid, rows):
+                open(path, "w").write(json.dumps({"bid": bid, "rows": rows}))
+            """,
+        )
+
+    def test_suppression_honoured(self, tmp_path):
+        assert not _lint_snippet(
+            tmp_path,
+            "serve/foo.py",
+            """
+            import json
+
+            def post_offer(board, key, bid, rows):
+                board.post(key, json.dumps({  # seqlint: disable=SEQ015
+                    "bid": bid,
+                    "rows": rows,
+                }))
+            """,
+        )
